@@ -1,0 +1,24 @@
+"""charon_tpu — a TPU-native distributed-validator framework.
+
+A ground-up rebuild of the capabilities of Obol Charon (the reference,
+an Ethereum DVT middleware): QBFT consensus on validator duties, threshold-BLS
+partial signing and Lagrange aggregation, a beacon-API intercepting validator
+API, peer-to-peer partial-signature exchange, DKG — with the crypto plane
+(BLS12-381 pairing, bulk partial-signature verification, threshold
+aggregation) executed as batched JAX kernels on TPU behind the pluggable
+`tbls` seam.
+
+Package layout:
+  crypto/    BLS12-381 primitives (pure-Python oracle)
+  tbls/      threshold-BLS facade + CPU and TPU backends
+  ops/       JAX/TPU batched kernels (limb arithmetic, curve ops, pairing)
+  core/      the duty pipeline (scheduler ... broadcaster) + QBFT
+  parallel/  device-mesh sharding of batched crypto
+  p2p/       peer networking
+  dkg/       distributed key generation ceremony
+  cluster/   cluster definition/lock config
+  utils/     infra (logging, lifecycle, retry, featureset, ...)
+  testutil/  beaconmock / validatormock / simnet helpers
+"""
+
+__version__ = "0.1.0"

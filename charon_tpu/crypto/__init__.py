@@ -1,0 +1,3 @@
+"""BLS12-381 primitives: pure-Python CPU reference + building blocks for the
+TPU (JAX) backend. See fields.py / curve.py / pairing.py / hash_to_curve.py /
+serialize.py."""

@@ -1,0 +1,360 @@
+"""BLS12-381 field tower arithmetic (pure Python, CPU reference backend).
+
+This is the correctness oracle for the TPU (JAX/Pallas) backend, playing the
+role the herumi C++ library plays in the reference (see reference
+tbls/herumi.go:12 — the cgo-wrapped native BLS backend). It is deliberately
+written in a simple functional style over Python ints and tuples: Python's
+arbitrary-precision integers make 381-bit modular arithmetic short and
+auditable, and `pow(x, -1, p)` gives fast modular inverses.
+
+Tower construction (the standard one, matching all production BLS12-381
+implementations so that pairing results and serializations agree):
+
+    Fq2  = Fq [u] / (u^2 + 1)
+    Fq6  = Fq2[v] / (v^3 - xi),  xi = u + 1
+    Fq12 = Fq6[w] / (w^2 - v)
+
+Representation:
+    Fq   : int in [0, P)
+    Fq2  : (c0, c1)            meaning c0 + c1*u
+    Fq6  : (a0, a1, a2)        meaning a0 + a1*v + a2*v^2,  ai in Fq2
+    Fq12 : (b0, b1)            meaning b0 + b1*w,           bi in Fq6
+"""
+
+from __future__ import annotations
+
+# Base field modulus (381 bits).
+P = 0x1A0111EA397FE69A4B1BA7B6434BACD764774B84F38512BF6730D2A0F6B0F6241EABFFFEB153FFFFB9FEFFFFFFFFAAAB
+# Subgroup order (scalar field, 255 bits).
+R = 0x73EDA753299D7D483339D80809A1D80553BDA402FFFE5BFEFFFFFFFF00000001
+# BLS parameter x (negative: x = -X_ABS).
+X_ABS = 0xD201000000010000
+
+# ---------------------------------------------------------------------------
+# Fq
+# ---------------------------------------------------------------------------
+
+def fq_add(a: int, b: int) -> int:
+    c = a + b
+    return c - P if c >= P else c
+
+
+def fq_sub(a: int, b: int) -> int:
+    c = a - b
+    return c + P if c < 0 else c
+
+
+def fq_mul(a: int, b: int) -> int:
+    return (a * b) % P
+
+
+def fq_neg(a: int) -> int:
+    return P - a if a else 0
+
+
+def fq_inv(a: int) -> int:
+    return pow(a, -1, P)
+
+
+def fq_sqrt(a: int) -> int | None:
+    """Square root in Fq. P % 4 == 3, so sqrt = a^((P+1)/4). Returns None if a is not a QR."""
+    s = pow(a, (P + 1) // 4, P)
+    return s if (s * s) % P == a else None
+
+
+# ---------------------------------------------------------------------------
+# Fq2 = Fq[u]/(u^2+1)
+# ---------------------------------------------------------------------------
+
+FQ2_ZERO = (0, 0)
+FQ2_ONE = (1, 0)
+
+
+def fq2_add(a, b):
+    return (fq_add(a[0], b[0]), fq_add(a[1], b[1]))
+
+
+def fq2_sub(a, b):
+    return (fq_sub(a[0], b[0]), fq_sub(a[1], b[1]))
+
+
+def fq2_neg(a):
+    return (fq_neg(a[0]), fq_neg(a[1]))
+
+
+def fq2_mul(a, b):
+    # (a0 + a1 u)(b0 + b1 u) = (a0 b0 - a1 b1) + (a0 b1 + a1 b0) u
+    a0, a1 = a
+    b0, b1 = b
+    t0 = a0 * b0
+    t1 = a1 * b1
+    t2 = (a0 + a1) * (b0 + b1)
+    return ((t0 - t1) % P, (t2 - t0 - t1) % P)
+
+
+def fq2_sqr(a):
+    a0, a1 = a
+    # (a0 + a1 u)^2 = (a0+a1)(a0-a1) + 2 a0 a1 u
+    t0 = (a0 + a1) * (a0 - a1)
+    t1 = 2 * a0 * a1
+    return (t0 % P, t1 % P)
+
+
+def fq2_mul_scalar(a, k: int):
+    return ((a[0] * k) % P, (a[1] * k) % P)
+
+
+def fq2_inv(a):
+    a0, a1 = a
+    # 1/(a0 + a1 u) = (a0 - a1 u) / (a0^2 + a1^2)
+    d = pow((a0 * a0 + a1 * a1) % P, -1, P)
+    return ((a0 * d) % P, (P - a1) * d % P if a1 else 0)
+
+
+def fq2_conj(a):
+    return (a[0], fq_neg(a[1]))
+
+
+def fq2_mul_xi(a):
+    """Multiply by xi = 1 + u (the Fq6 non-residue)."""
+    a0, a1 = a
+    return ((a0 - a1) % P, (a0 + a1) % P)
+
+
+def fq2_pow(a, e: int):
+    result = FQ2_ONE
+    base = a
+    while e:
+        if e & 1:
+            result = fq2_mul(result, base)
+        base = fq2_sqr(base)
+        e >>= 1
+    return result
+
+
+def fq2_sign(a) -> int:
+    """Lexicographic 'sign' used by ZCash/ETH2 compressed serialization:
+    a is 'negative' (sign bit set) iff c1 > (P-1)/2, or c1 == 0 and c0 > (P-1)/2.
+    Returns 1 if negative else 0."""
+    half = (P - 1) // 2
+    if a[1]:
+        return 1 if a[1] > half else 0
+    return 1 if a[0] > half else 0
+
+
+def fq2_sqrt(a):
+    """Square root in Fq2 via the complex method (P % 4 == 3). Returns None if non-QR."""
+    a0, a1 = a
+    if a1 == 0:
+        s = fq_sqrt(a0)
+        if s is not None:
+            return (s, 0)
+        # sqrt(a0) = sqrt(-a0) * sqrt(-1); -1 is a non-residue in Fq (P%4==3),
+        # so a0 = -n^2 means sqrt is n*u.
+        s = fq_sqrt(fq_neg(a0))
+        if s is None:
+            return None
+        return (0, s)
+    # norm = a0^2 + a1^2; alpha = sqrt(norm)
+    alpha = fq_sqrt((a0 * a0 + a1 * a1) % P)
+    if alpha is None:
+        return None
+    # delta = (a0 + alpha)/2 ; want x0 = sqrt(delta)
+    inv2 = (P + 1) // 2
+    delta = (a0 + alpha) * inv2 % P
+    x0 = fq_sqrt(delta)
+    if x0 is None:
+        delta = (a0 - alpha) * inv2 % P
+        x0 = fq_sqrt(delta)
+        if x0 is None:
+            return None
+    x1 = a1 * inv2 % P * pow(x0, -1, P) % P
+    cand = (x0, x1)
+    if fq2_sqr(cand) != (a0 % P, a1 % P):
+        return None
+    return cand
+
+
+# ---------------------------------------------------------------------------
+# Fq6 = Fq2[v]/(v^3 - xi)
+# ---------------------------------------------------------------------------
+
+FQ6_ZERO = (FQ2_ZERO, FQ2_ZERO, FQ2_ZERO)
+FQ6_ONE = (FQ2_ONE, FQ2_ZERO, FQ2_ZERO)
+
+
+def fq6_add(a, b):
+    return (fq2_add(a[0], b[0]), fq2_add(a[1], b[1]), fq2_add(a[2], b[2]))
+
+
+def fq6_sub(a, b):
+    return (fq2_sub(a[0], b[0]), fq2_sub(a[1], b[1]), fq2_sub(a[2], b[2]))
+
+
+def fq6_neg(a):
+    return (fq2_neg(a[0]), fq2_neg(a[1]), fq2_neg(a[2]))
+
+
+def fq6_mul(a, b):
+    a0, a1, a2 = a
+    b0, b1, b2 = b
+    t0 = fq2_mul(a0, b0)
+    t1 = fq2_mul(a1, b1)
+    t2 = fq2_mul(a2, b2)
+    # c0 = t0 + xi*((a1+a2)(b1+b2) - t1 - t2)
+    c0 = fq2_add(t0, fq2_mul_xi(fq2_sub(fq2_sub(fq2_mul(fq2_add(a1, a2), fq2_add(b1, b2)), t1), t2)))
+    # c1 = (a0+a1)(b0+b1) - t0 - t1 + xi*t2
+    c1 = fq2_add(fq2_sub(fq2_sub(fq2_mul(fq2_add(a0, a1), fq2_add(b0, b1)), t0), t1), fq2_mul_xi(t2))
+    # c2 = (a0+a2)(b0+b2) - t0 - t2 + t1
+    c2 = fq2_add(fq2_sub(fq2_sub(fq2_mul(fq2_add(a0, a2), fq2_add(b0, b2)), t0), t2), t1)
+    return (c0, c1, c2)
+
+
+def fq6_sqr(a):
+    return fq6_mul(a, a)
+
+
+def fq6_mul_v(a):
+    """Multiply by v: (a0 + a1 v + a2 v^2) * v = xi*a2 + a0 v + a1 v^2."""
+    return (fq2_mul_xi(a[2]), a[0], a[1])
+
+
+def fq6_inv(a):
+    a0, a1, a2 = a
+    c0 = fq2_sub(fq2_sqr(a0), fq2_mul_xi(fq2_mul(a1, a2)))
+    c1 = fq2_sub(fq2_mul_xi(fq2_sqr(a2)), fq2_mul(a0, a1))
+    c2 = fq2_sub(fq2_sqr(a1), fq2_mul(a0, a2))
+    # t = a0*c0 + xi*(a2*c1 + a1*c2)
+    t = fq2_add(fq2_mul(a0, c0), fq2_mul_xi(fq2_add(fq2_mul(a2, c1), fq2_mul(a1, c2))))
+    ti = fq2_inv(t)
+    return (fq2_mul(c0, ti), fq2_mul(c1, ti), fq2_mul(c2, ti))
+
+
+# ---------------------------------------------------------------------------
+# Fq12 = Fq6[w]/(w^2 - v)
+# ---------------------------------------------------------------------------
+
+FQ12_ZERO = (FQ6_ZERO, FQ6_ZERO)
+FQ12_ONE = (FQ6_ONE, FQ6_ZERO)
+
+
+def fq12_add(a, b):
+    return (fq6_add(a[0], b[0]), fq6_add(a[1], b[1]))
+
+
+def fq12_sub(a, b):
+    return (fq6_sub(a[0], b[0]), fq6_sub(a[1], b[1]))
+
+
+def fq12_neg(a):
+    return (fq6_neg(a[0]), fq6_neg(a[1]))
+
+
+def fq12_mul(a, b):
+    a0, a1 = a
+    b0, b1 = b
+    t0 = fq6_mul(a0, b0)
+    t1 = fq6_mul(a1, b1)
+    c0 = fq6_add(t0, fq6_mul_v(t1))
+    c1 = fq6_sub(fq6_sub(fq6_mul(fq6_add(a0, a1), fq6_add(b0, b1)), t0), t1)
+    return (c0, c1)
+
+
+def fq12_sqr(a):
+    return fq12_mul(a, a)
+
+
+def fq12_inv(a):
+    a0, a1 = a
+    # 1/(a0 + a1 w) = (a0 - a1 w) / (a0^2 - v a1^2)
+    t = fq6_sub(fq6_sqr(a0), fq6_mul_v(fq6_sqr(a1)))
+    ti = fq6_inv(t)
+    return (fq6_mul(a0, ti), fq6_neg(fq6_mul(a1, ti)))
+
+
+def fq12_conj(a):
+    """Conjugation a0 - a1 w == Frobenius^6 (inverse for cyclotomic elements)."""
+    return (a[0], fq6_neg(a[1]))
+
+
+def fq12_pow(a, e: int):
+    if e < 0:
+        a = fq12_inv(a)
+        e = -e
+    result = FQ12_ONE
+    base = a
+    while e:
+        if e & 1:
+            result = fq12_mul(result, base)
+        base = fq12_sqr(base)
+        e >>= 1
+    return result
+
+
+# --- Frobenius ---------------------------------------------------------------
+# frob(c0 + c1 u) = c0 - c1 u  (since u^P = -u: P % 4 == 3)
+# Precomputed Frobenius coefficients: gamma_1[i] = xi^((P-1)*i/6) for i=1..5 in Fq2.
+
+def _compute_frob_coeffs():
+    xi = (1, 1)
+    gammas = []
+    for i in range(1, 6):
+        gammas.append(fq2_pow(xi, (P - 1) * i // 6))
+    return gammas
+
+
+_GAMMA1 = _compute_frob_coeffs()  # xi^((P-1)/6 * i), i = 1..5
+
+
+def fq6_frobenius(a):
+    """a(v) -> a^P: conjugate coefficients, multiply a1 by gamma_1[1], a2 by gamma_1[3]... in Fq6 terms.
+    v^P = v * xi^((P-1)/3) = v * gamma2 where gamma2 = _GAMMA1[1] (i=2)."""
+    c0 = fq2_conj(a[0])
+    c1 = fq2_mul(fq2_conj(a[1]), _GAMMA1[1])  # xi^(2(P-1)/6) = xi^((P-1)/3)
+    c2 = fq2_mul(fq2_conj(a[2]), _GAMMA1[3])  # xi^(4(P-1)/6) = xi^(2(P-1)/3)
+    return (c0, c1, c2)
+
+
+def fq12_frobenius(a):
+    """a -> a^P. w^P = w * xi^((P-1)/6) = w * gamma_1[0]."""
+    a0, a1 = a
+    c0 = fq6_frobenius(a0)
+    t = fq6_frobenius(a1)
+    # multiply t (coefficient of w) by gamma_1[0] (an Fq2 scalar embedded in Fq6)
+    g = _GAMMA1[0]
+    c1 = (fq2_mul(t[0], g), fq2_mul(t[1], g), fq2_mul(t[2], g))
+    return (c0, c1)
+
+
+def fq12_frobenius_n(a, n: int):
+    for _ in range(n % 12):
+        a = fq12_frobenius(a)
+    return a
+
+
+# ---------------------------------------------------------------------------
+# Scalar field Fr helpers
+# ---------------------------------------------------------------------------
+
+def fr_inv(a: int) -> int:
+    return pow(a, -1, R)
+
+
+def lagrange_coefficients_at_zero(ids: list[int]) -> list[int]:
+    """Lagrange basis coefficients lambda_i evaluated at x=0 for the node set
+    `ids` (distinct share indices >= 1), over Fr.
+
+    sum_i lambda_i * f(id_i) = f(0) for any polynomial f of degree < len(ids).
+    Mirrors the interpolation inside the reference's ThresholdAggregate
+    (reference tbls/herumi.go:244-283, which delegates to herumi's Recover).
+    """
+    coeffs = []
+    for i in ids:
+        num, den = 1, 1
+        for j in ids:
+            if j == i:
+                continue
+            num = num * j % R
+            den = den * ((j - i) % R) % R
+        coeffs.append(num * fr_inv(den) % R)
+    return coeffs

@@ -1,0 +1,217 @@
+"""Hash-to-curve for BLS12-381 G2 (BLS12381G2_XMD:SHA-256_SSWU_RO_).
+
+RFC 9380 construction used by ETH2 BLS signatures (ciphersuite
+BLS_SIG_BLS12381G2_XMD:SHA-256_SSWU_RO_POP_), matching herumi's ETH mode
+(reference tbls/herumi.go:33 sets ETH serialization/hash modes):
+
+    hash_to_field (expand_message_xmd/SHA-256, L=64, m=2, count=2)
+    -> simplified SWU map onto the 3-isogenous curve E'
+    -> 3-isogeny map E' -> E
+    -> clear cofactor (h_eff scalar mul)
+
+The isogeny-map coefficients are the standard published constants
+(RFC 9380 Appendix E.3); tests/test_crypto.py::TestHashToCurve independently
+validates them structurally (the map must send points of E'_iso onto E —
+a single wrong bit in any coefficient fails that with overwhelming
+probability) and against the RFC 9380 J.10.1 known-answer vector.
+"""
+
+from __future__ import annotations
+
+import hashlib
+
+from . import fields as F
+from .curve import B_G2, Fq2Ops, g2_clear_cofactor, is_on_curve, jac_add, to_jacobian
+
+# --- expand_message_xmd (SHA-256) -------------------------------------------
+
+
+def expand_message_xmd(msg: bytes, dst: bytes, len_in_bytes: int) -> bytes:
+    if len(dst) > 255:
+        dst = hashlib.sha256(b"H2C-OVERSIZE-DST-" + dst).digest()
+    b_in_bytes = 32
+    r_in_bytes = 64
+    ell = (len_in_bytes + b_in_bytes - 1) // b_in_bytes
+    if ell > 255:
+        raise ValueError("expand_message_xmd: len too large")
+    dst_prime = dst + bytes([len(dst)])
+    z_pad = bytes(r_in_bytes)
+    l_i_b_str = len_in_bytes.to_bytes(2, "big")
+    b0 = hashlib.sha256(z_pad + msg + l_i_b_str + b"\x00" + dst_prime).digest()
+    b1 = hashlib.sha256(b0 + b"\x01" + dst_prime).digest()
+    out = [b1]
+    prev = b1
+    for i in range(2, ell + 1):
+        prev = hashlib.sha256(bytes(x ^ y for x, y in zip(b0, prev)) + bytes([i]) + dst_prime).digest()
+        out.append(prev)
+    return b"".join(out)[:len_in_bytes]
+
+
+def hash_to_field_fq2(msg: bytes, dst: bytes, count: int) -> list:
+    """count elements of Fq2, L=64 per base-field coordinate."""
+    L = 64
+    m = 2
+    uniform = expand_message_xmd(msg, dst, count * m * L)
+    out = []
+    for i in range(count):
+        coords = []
+        for j in range(m):
+            off = L * (j + i * m)
+            coords.append(int.from_bytes(uniform[off : off + L], "big") % F.P)
+        out.append(tuple(coords))
+    return out
+
+
+# --- simplified SWU on the isogenous curve E' --------------------------------
+# E': y^2 = x^3 + A' x + B' over Fq2 with
+A_ISO = (0, 240)
+B_ISO = (1012, 1012)
+Z_SSWU = (F.P - 2, F.P - 1)  # Z = -(2 + u)
+_NEG_B_OVER_A = F.fq2_mul(F.fq2_neg(B_ISO), F.fq2_inv(A_ISO))
+
+
+def _sgn0_fq2(x) -> int:
+    sign = 0
+    zero = 1
+    for c in x:
+        sign_i = c & 1
+        zero_i = 1 if c == 0 else 0
+        sign = sign | (zero & sign_i)
+        zero = zero & zero_i
+    return sign
+
+
+def _is_square_fq2(a) -> bool:
+    # a is a square iff a^((q^2-1)/2) != -1 ; compute via norm: a square in Fq2
+    # iff norm(a) = a0^2+a1^2 is a square in Fq... (norm is multiplicative and
+    # non-squares have non-square norm exactly when ... ) — use the direct
+    # exponent test for safety.
+    if a == F.FQ2_ZERO:
+        return True
+    r = F.fq2_pow(a, (F.P * F.P - 1) // 2)
+    return r == F.FQ2_ONE
+
+
+def map_to_curve_sswu(u):
+    """Simplified SWU: Fq2 element u -> affine point on E' (always succeeds)."""
+    # tv1 = 1 / (Z^2 u^4 + Z u^2)
+    u2 = F.fq2_sqr(u)
+    zu2 = F.fq2_mul(Z_SSWU, u2)
+    tv = F.fq2_add(F.fq2_sqr(zu2), zu2)
+    if tv == F.FQ2_ZERO:
+        # exceptional case: x1 = B / (Z A)
+        x1 = F.fq2_mul(B_ISO, F.fq2_inv(F.fq2_mul(Z_SSWU, A_ISO)))
+    else:
+        x1 = F.fq2_mul(_NEG_B_OVER_A, F.fq2_add(F.FQ2_ONE, F.fq2_inv(tv)))
+    gx1 = F.fq2_add(F.fq2_mul(F.fq2_add(F.fq2_sqr(x1), A_ISO), x1), B_ISO)
+    x2 = F.fq2_mul(zu2, x1)
+    gx2 = F.fq2_add(F.fq2_mul(F.fq2_add(F.fq2_sqr(x2), A_ISO), x2), B_ISO)
+    if _is_square_fq2(gx1):
+        x, y = x1, F.fq2_sqrt(gx1)
+    else:
+        x, y = x2, F.fq2_sqrt(gx2)
+    if _sgn0_fq2(u) != _sgn0_fq2(y):
+        y = F.fq2_neg(y)
+    return (x, y)
+
+
+# --- 3-isogeny map E' -> E ---------------------------------------------------
+# Coefficients from RFC 9380 Appendix E.3 (standard constants shared by all
+# BLS12-381 hash-to-G2 implementations). Structural validation in tests.
+
+_K1 = [  # x numerator, degree 3
+    (
+        0x05C759507E8E333EBB5B7A9A47D7ED8532C52D39FD3A042A88B58423C50AE15D5C2638E343D9C71C6238AAAAAAAA97D6,
+        0x05C759507E8E333EBB5B7A9A47D7ED8532C52D39FD3A042A88B58423C50AE15D5C2638E343D9C71C6238AAAAAAAA97D6,
+    ),
+    (
+        0,
+        0x11560BF17BAA99BC32126FCED787C88F984F87ADF7AE0C7F9A208C6B4F20A4181472AAA9CB8D555526A9FFFFFFFFC71A,
+    ),
+    (
+        0x11560BF17BAA99BC32126FCED787C88F984F87ADF7AE0C7F9A208C6B4F20A4181472AAA9CB8D555526A9FFFFFFFFC71E,
+        0x08AB05F8BDD54CDE190937E76BC3E447CC27C3D6FBD7063FCD104635A790520C0A395554E5C6AAAA9354FFFFFFFFE38D,
+    ),
+    (
+        0x171D6541FA38CCFAED6DEA691F5FB614CB14B4E7F4E810AA22D6108F142B85757098E38D0F671C7188E2AAAAAAAA5ED1,
+        0,
+    ),
+]
+_K2 = [  # x denominator, degree 2 + monic x^2
+    (
+        0,
+        0x1A0111EA397FE69A4B1BA7B6434BACD764774B84F38512BF6730D2A0F6B0F6241EABFFFEB153FFFFB9FEFFFFFFFFAA63,
+    ),
+    (
+        0xC,
+        0x1A0111EA397FE69A4B1BA7B6434BACD764774B84F38512BF6730D2A0F6B0F6241EABFFFEB153FFFFB9FEFFFFFFFFAA9F,
+    ),
+]
+_K3 = [  # y numerator, degree 3
+    (
+        0x1530477C7AB4113B59A4C18B076D11930F7DA5D4A07F649BF54439D87D27E500FC8C25EBF8C92F6812CFC71C71C6D706,
+        0x1530477C7AB4113B59A4C18B076D11930F7DA5D4A07F649BF54439D87D27E500FC8C25EBF8C92F6812CFC71C71C6D706,
+    ),
+    (
+        0,
+        0x05C759507E8E333EBB5B7A9A47D7ED8532C52D39FD3A042A88B58423C50AE15D5C2638E343D9C71C6238AAAAAAAA97BE,
+    ),
+    (
+        0x11560BF17BAA99BC32126FCED787C88F984F87ADF7AE0C7F9A208C6B4F20A4181472AAA9CB8D555526A9FFFFFFFFC71C,
+        0x08AB05F8BDD54CDE190937E76BC3E447CC27C3D6FBD7063FCD104635A790520C0A395554E5C6AAAA9354FFFFFFFFE38F,
+    ),
+    (
+        0x124C9AD43B6CF79BFBF7043DE3811AD0761B0F37A1E26286B0E977C69AA274524E79097A56DC4BD9E1B371C71C718B10,
+        0,
+    ),
+]
+_K4 = [  # y denominator, degree 3 + monic x^3
+    (
+        0x1A0111EA397FE69A4B1BA7B6434BACD764774B84F38512BF6730D2A0F6B0F6241EABFFFEB153FFFFB9FEFFFFFFFFA8FB,
+        0x1A0111EA397FE69A4B1BA7B6434BACD764774B84F38512BF6730D2A0F6B0F6241EABFFFEB153FFFFB9FEFFFFFFFFA8FB,
+    ),
+    (
+        0,
+        0x1A0111EA397FE69A4B1BA7B6434BACD764774B84F38512BF6730D2A0F6B0F6241EABFFFEB153FFFFB9FEFFFFFFFFA9D3,
+    ),
+    (
+        0x12,
+        0x1A0111EA397FE69A4B1BA7B6434BACD764774B84F38512BF6730D2A0F6B0F6241EABFFFEB153FFFFB9FEFFFFFFFFAA99,
+    ),
+]
+
+
+def _horner(coeffs, x):
+    """Evaluate sum coeffs[i] x^i (coeffs low->high) over Fq2."""
+    acc = F.FQ2_ZERO
+    for c in reversed(coeffs):
+        acc = F.fq2_add(F.fq2_mul(acc, x), c)
+    return acc
+
+
+def iso_map_g2(pt_affine):
+    """3-isogeny E'(Fq2) -> E(Fq2)."""
+    x, y = pt_affine
+    x_num = _horner(_K1, x)
+    x_den = _horner(_K2 + [F.FQ2_ONE], x)
+    y_num = _horner(_K3, x)
+    y_den = _horner(_K4 + [F.FQ2_ONE], x)
+    xo = F.fq2_mul(x_num, F.fq2_inv(x_den))
+    yo = F.fq2_mul(y, F.fq2_mul(y_num, F.fq2_inv(y_den)))
+    return (xo, yo)
+
+
+# --- full hash-to-curve ------------------------------------------------------
+
+# ETH2 BLS signature ciphersuite DST (proof-of-possession scheme).
+DST_ETH = b"BLS_SIG_BLS12381G2_XMD:SHA-256_SSWU_RO_POP_"
+
+
+def hash_to_g2(msg: bytes, dst: bytes = DST_ETH):
+    """Full hash_to_curve: returns a Jacobian point in the G2 subgroup."""
+    u0, u1 = hash_to_field_fq2(msg, dst, 2)
+    q0 = iso_map_g2(map_to_curve_sswu(u0))
+    q1 = iso_map_g2(map_to_curve_sswu(u1))
+    assert is_on_curve(Fq2Ops, q0, B_G2) and is_on_curve(Fq2Ops, q1, B_G2)
+    r = jac_add(Fq2Ops, to_jacobian(Fq2Ops, q0), to_jacobian(Fq2Ops, q1))
+    return g2_clear_cofactor(r)

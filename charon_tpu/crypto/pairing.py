@@ -1,0 +1,164 @@
+"""Optimal ate pairing for BLS12-381 (pure Python reference).
+
+Correctness-first implementation: G2 points are untwisted into E(Fq12) and the
+Miller loop runs with textbook affine line functions over Fq12. This is slower
+than the sparse-line twisted form used in production implementations (and in
+our TPU kernels), but it is hard to get wrong and serves as the oracle the
+optimized paths are validated against — the same role herumi's pairing plays
+for the reference's tbls (reference tbls/herumi.go:285-301 Verify = pairing
+check).
+
+e: G1 x G2 -> Fq12 (r-th roots of unity), e(aP, bQ) = e(P,Q)^(ab).
+"""
+
+from __future__ import annotations
+
+from . import fields as F
+from .curve import Fq12Ops, Fq2Ops, FqOps, to_affine
+
+# --- embeddings --------------------------------------------------------------
+
+
+def fq_to_fq12(a: int):
+    return (((a, 0), F.FQ2_ZERO, F.FQ2_ZERO), F.FQ6_ZERO)
+
+
+def fq2_to_fq12(a):
+    return ((a, F.FQ2_ZERO, F.FQ2_ZERO), F.FQ6_ZERO)
+
+
+# w as an Fq12 element (coefficient 1 of w).
+_W = (F.FQ6_ZERO, F.FQ6_ONE)
+_W2 = F.fq12_mul(_W, _W)
+_W3 = F.fq12_mul(_W2, _W)
+_W2_INV = F.fq12_inv(_W2)
+_W3_INV = F.fq12_inv(_W3)
+
+
+def untwist(q_affine_fq2):
+    """Map a point on the M-twist E'(Fq2) to E(Fq12): (x,y) -> (x/w^2, y/w^3).
+
+    With the tower w^2 = v, v^3 = xi this satisfies w^6 = xi, so the image lies
+    on y^2 = x^3 + 4 over Fq12.
+    """
+    x, y = q_affine_fq2
+    return (
+        F.fq12_mul(fq2_to_fq12(x), _W2_INV),
+        F.fq12_mul(fq2_to_fq12(y), _W3_INV),
+    )
+
+
+# --- Miller loop -------------------------------------------------------------
+
+
+def _line(t, q, p):
+    """Evaluate the line through points t, q (on E(Fq12), affine) at p.
+
+    If t == q uses the tangent; if x_t == x_q (and t != q) the vertical line.
+    Returns an Fq12 value.
+    """
+    xt, yt = t
+    xq, yq = q
+    xp, yp = p
+    if xt == xq and yt == yq:
+        # tangent: m = 3 x^2 / 2y
+        m = F.fq12_mul(
+            Fq12Ops.mul_small(F.fq12_sqr(xt), 3),
+            F.fq12_inv(Fq12Ops.mul_small(yt, 2)),
+        )
+    elif xt == xq:
+        # vertical line: x_p - x_t
+        return F.fq12_sub(xp, xt)
+    else:
+        m = F.fq12_mul(F.fq12_sub(yq, yt), F.fq12_inv(F.fq12_sub(xq, xt)))
+    # l(P) = y_p - y_t - m (x_p - x_t)
+    return F.fq12_sub(F.fq12_sub(yp, yt), F.fq12_mul(m, F.fq12_sub(xp, xt)))
+
+
+def _ec_add_affine(t, q):
+    """Affine addition on E(Fq12) (no special doubling: caller distinguishes)."""
+    xt, yt = t
+    xq, yq = q
+    if xt == xq and yt == yq:
+        m = F.fq12_mul(
+            Fq12Ops.mul_small(F.fq12_sqr(xt), 3),
+            F.fq12_inv(Fq12Ops.mul_small(yt, 2)),
+        )
+    elif xt == xq:
+        return None  # infinity
+    else:
+        m = F.fq12_mul(F.fq12_sub(yq, yt), F.fq12_inv(F.fq12_sub(xq, xt)))
+    x3 = F.fq12_sub(F.fq12_sub(F.fq12_sqr(m), xt), xq)
+    y3 = F.fq12_sub(F.fq12_mul(m, F.fq12_sub(xt, x3)), yt)
+    return (x3, y3)
+
+
+def miller_loop(p_affine_fq, q_affine_fq2):
+    """f_{|x|, Q}(P) with Q untwisted into E(Fq12); inverted at the end because
+    the BLS parameter x is negative."""
+    if p_affine_fq is None or q_affine_fq2 is None:
+        return F.FQ12_ONE
+    p12 = (fq_to_fq12(p_affine_fq[0]), fq_to_fq12(p_affine_fq[1]))
+    q12 = untwist(q_affine_fq2)
+
+    f = F.FQ12_ONE
+    t = q12
+    bits = bin(F.X_ABS)[3:]  # skip MSB
+    for bit in bits:
+        f = F.fq12_mul(F.fq12_sqr(f), _line(t, t, p12))
+        t = _ec_add_affine(t, t)
+        if bit == "1":
+            f = F.fq12_mul(f, _line(t, q12, p12))
+            t = _ec_add_affine(t, q12)
+    # x < 0: invert (vertical-line factors vanish after final exponentiation).
+    return F.fq12_conj(f)  # conj == inverse up to factors killed by final exp
+
+
+def final_exponentiation(f):
+    """f^((q^12-1)/r) via easy part (frobenius/conjugate) + naive hard part."""
+    # easy part: f^(q^6-1) then ^(q^2+1)
+    f1 = F.fq12_mul(F.fq12_conj(f), F.fq12_inv(f))  # f^(q^6 - 1)
+    f2 = F.fq12_mul(F.fq12_frobenius_n(f1, 2), f1)  # ^(q^2+1)
+    # hard part: ^(q^4 - q^2 + 1)/r
+    e = (F.P**4 - F.P**2 + 1) // F.R
+    return F.fq12_pow(f2, e)
+
+
+def pairing(p_jac_g1, q_jac_g2) -> tuple:
+    """Full pairing e(P, Q) for Jacobian inputs P in G1, Q in G2."""
+    p_aff = to_affine(FqOps, p_jac_g1)
+    q_aff = to_affine(Fq2Ops, q_jac_g2)
+    if p_aff is None or q_aff is None:
+        return F.FQ12_ONE
+    return final_exponentiation(miller_loop(p_aff, q_aff))
+
+
+def multi_pairing(pairs) -> tuple:
+    """prod_i e(P_i, Q_i) — shares the final exponentiation across pairs."""
+    f = F.FQ12_ONE
+    for p_jac, q_jac in pairs:
+        p_aff = to_affine(FqOps, p_jac)
+        q_aff = to_affine(Fq2Ops, q_jac)
+        if p_aff is None or q_aff is None:
+            continue
+        f = F.fq12_mul(f, miller_loop(p_aff, q_aff))
+    return final_exponentiation(f)
+
+
+def pairings_equal(pairs_left, pairs_right) -> bool:
+    """prod e(left) == prod e(right), via prod e(left) * prod e(-right) == 1."""
+    f = F.FQ12_ONE
+    for p_jac, q_jac in pairs_left:
+        p_aff = to_affine(FqOps, p_jac)
+        q_aff = to_affine(Fq2Ops, q_jac)
+        if p_aff is None or q_aff is None:
+            continue
+        f = F.fq12_mul(f, miller_loop(p_aff, q_aff))
+    for p_jac, q_jac in pairs_right:
+        p_aff = to_affine(FqOps, p_jac)
+        q_aff = to_affine(Fq2Ops, q_jac)
+        if p_aff is None or q_aff is None:
+            continue
+        p_aff = (p_aff[0], F.fq_neg(p_aff[1]))
+        f = F.fq12_mul(f, miller_loop(p_aff, q_aff))
+    return final_exponentiation(f) == F.FQ12_ONE

@@ -1,0 +1,109 @@
+"""ZCash/ETH2 compressed point serialization for BLS12-381.
+
+Format (the one herumi emits in ETH mode, reference tbls/herumi.go:33):
+  G1 compressed: 48 bytes, big-endian x with flag bits in the top byte.
+  G2 compressed: 96 bytes, c1 || c0 of x, flags in the top byte of c1.
+  Flags: bit7 = compression (always 1 here), bit6 = infinity, bit5 = y sign
+  (lexicographically-largest convention).
+"""
+
+from __future__ import annotations
+
+from . import fields as F
+from .curve import (
+    B_G1,
+    B_G2,
+    Fq2Ops,
+    FqOps,
+    g1_in_subgroup,
+    g2_in_subgroup,
+    jac_infinity,
+    to_affine,
+    to_jacobian,
+)
+
+_COMP = 0x80
+_INF = 0x40
+_SIGN = 0x20
+
+
+class DeserializationError(ValueError):
+    pass
+
+
+def g1_to_bytes(pt_jac) -> bytes:
+    aff = to_affine(FqOps, pt_jac)
+    if aff is None:
+        out = bytearray(48)
+        out[0] = _COMP | _INF
+        return bytes(out)
+    x, y = aff
+    flags = _COMP | (_SIGN if y > (F.P - 1) // 2 else 0)
+    out = bytearray(x.to_bytes(48, "big"))
+    out[0] |= flags
+    return bytes(out)
+
+
+def g1_from_bytes(data: bytes, subgroup_check: bool = True):
+    if len(data) != 48:
+        raise DeserializationError("G1 compressed must be 48 bytes")
+    flags = data[0]
+    if not flags & _COMP:
+        raise DeserializationError("uncompressed G1 not supported")
+    if flags & _INF:
+        if any(data[1:]) or flags & ~( _COMP | _INF):
+            raise DeserializationError("invalid infinity encoding")
+        return jac_infinity(FqOps)
+    x = int.from_bytes(bytes([data[0] & 0x1F]) + data[1:], "big")
+    if x >= F.P:
+        raise DeserializationError("x not in field")
+    y2 = (x * x % F.P * x + B_G1) % F.P
+    y = F.fq_sqrt(y2)
+    if y is None:
+        raise DeserializationError("x not on curve")
+    if (y > (F.P - 1) // 2) != bool(flags & _SIGN):
+        y = F.fq_neg(y)
+    pt = to_jacobian(FqOps, (x, y))
+    if subgroup_check and not g1_in_subgroup(pt):
+        raise DeserializationError("point not in G1 subgroup")
+    return pt
+
+
+def g2_to_bytes(pt_jac) -> bytes:
+    aff = to_affine(Fq2Ops, pt_jac)
+    if aff is None:
+        out = bytearray(96)
+        out[0] = _COMP | _INF
+        return bytes(out)
+    (x0, x1), y = aff
+    flags = _COMP | (_SIGN if F.fq2_sign(y) else 0)
+    out = bytearray(x1.to_bytes(48, "big") + x0.to_bytes(48, "big"))
+    out[0] |= flags
+    return bytes(out)
+
+
+def g2_from_bytes(data: bytes, subgroup_check: bool = True):
+    if len(data) != 96:
+        raise DeserializationError("G2 compressed must be 96 bytes")
+    flags = data[0]
+    if not flags & _COMP:
+        raise DeserializationError("uncompressed G2 not supported")
+    if flags & _INF:
+        if any(data[1:]) or flags & ~(_COMP | _INF):
+            raise DeserializationError("invalid infinity encoding")
+        return jac_infinity(Fq2Ops)
+    x1 = int.from_bytes(bytes([data[0] & 0x1F]) + data[1:48], "big")
+    x0 = int.from_bytes(data[48:], "big")
+    if x0 >= F.P or x1 >= F.P:
+        raise DeserializationError("x not in field")
+    x = (x0, x1)
+    y2 = F.fq2_add(F.fq2_mul(F.fq2_sqr(x), x), B_G2)
+    y = F.fq2_sqrt(y2)
+    if y is None:
+        raise DeserializationError("x not on curve")
+    if F.fq2_sign(y) != (1 if flags & _SIGN else 0):
+        y = F.fq2_neg(y)
+    pt = to_jacobian(Fq2Ops, (x, y))
+    if subgroup_check and not g2_in_subgroup(pt):
+        raise DeserializationError("point not in G2 subgroup")
+    return pt

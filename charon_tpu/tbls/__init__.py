@@ -1,0 +1,128 @@
+"""tbls — threshold-BLS facade with a pluggable backend.
+
+Mirrors the reference's seam exactly (reference tbls/tbls.go:11-76): package-
+level functions delegate to a swappable global Implementation so the duty
+pipeline is backend-agnostic. Backends:
+
+  * PythonImpl (python_impl.py) — CPU reference / correctness oracle
+    (the reference's herumi analogue).
+  * TPUImpl (tpu_impl.py)       — batched JAX kernels on TPU; the north-star
+    offload (bulk partial-sig verification + Lagrange threshold aggregation).
+
+Switch with `set_implementation`, feature-gated in app wiring via
+charon_tpu.utils.featureset (the reference gates backends the same way,
+app/featureset/featureset.go:10-75).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Protocol
+
+from .types import PrivateKey, PublicKey, Signature
+
+__all__ = [
+    "PrivateKey",
+    "PublicKey",
+    "Signature",
+    "set_implementation",
+    "get_implementation",
+    "generate_secret_key",
+    "secret_to_public_key",
+    "threshold_split",
+    "recover_secret",
+    "threshold_aggregate",
+    "threshold_aggregate_batch",
+    "sign",
+    "verify",
+    "verify_batch",
+    "aggregate",
+    "verify_aggregate",
+]
+
+
+class Implementation(Protocol):
+    """The tbls backend seam (reference tbls/tbls.go:28-69)."""
+
+    name: str
+
+    def generate_secret_key(self) -> PrivateKey: ...
+    def secret_to_public_key(self, secret: PrivateKey) -> PublicKey: ...
+    def threshold_split(self, secret: PrivateKey, total: int, threshold: int) -> dict[int, PrivateKey]: ...
+    def recover_secret(self, shares: dict[int, PrivateKey], total: int, threshold: int) -> PrivateKey: ...
+    def threshold_aggregate(self, partial_sigs: dict[int, Signature]) -> Signature: ...
+    def sign(self, private_key: PrivateKey, data: bytes) -> Signature: ...
+    def verify(self, public_key: PublicKey, data: bytes, signature: Signature) -> bool: ...
+    def aggregate(self, sigs: list[Signature]) -> Signature: ...
+    def verify_aggregate(self, public_keys: list[PublicKey], data: bytes, signature: Signature) -> bool: ...
+    def verify_batch(self, public_keys: list[PublicKey], datas: list[bytes], signatures: list[Signature]) -> bool: ...
+    def threshold_aggregate_batch(self, batches: list[dict[int, Signature]]) -> list[Signature]: ...
+
+
+_lock = threading.Lock()
+_impl: Implementation | None = None
+
+
+def _default() -> Implementation:
+    global _impl
+    with _lock:
+        if _impl is None:
+            from .python_impl import PythonImpl
+
+            _impl = PythonImpl()
+    return _impl
+
+
+def set_implementation(impl: Implementation) -> None:
+    """Swap the global backend (reference tbls/tbls.go:72 SetImplementation)."""
+    global _impl
+    with _lock:
+        _impl = impl
+
+
+def get_implementation() -> Implementation:
+    return _impl if _impl is not None else _default()
+
+
+def generate_secret_key() -> PrivateKey:
+    return get_implementation().generate_secret_key()
+
+
+def secret_to_public_key(secret: PrivateKey) -> PublicKey:
+    return get_implementation().secret_to_public_key(secret)
+
+
+def threshold_split(secret: PrivateKey, total: int, threshold: int) -> dict[int, PrivateKey]:
+    return get_implementation().threshold_split(secret, total, threshold)
+
+
+def recover_secret(shares: dict[int, PrivateKey], total: int, threshold: int) -> PrivateKey:
+    return get_implementation().recover_secret(shares, total, threshold)
+
+
+def threshold_aggregate(partial_sigs: dict[int, Signature]) -> Signature:
+    return get_implementation().threshold_aggregate(partial_sigs)
+
+
+def threshold_aggregate_batch(batches: list[dict[int, Signature]]) -> list[Signature]:
+    return get_implementation().threshold_aggregate_batch(batches)
+
+
+def sign(private_key: PrivateKey, data: bytes) -> Signature:
+    return get_implementation().sign(private_key, data)
+
+
+def verify(public_key: PublicKey, data: bytes, signature: Signature) -> bool:
+    return get_implementation().verify(public_key, data, signature)
+
+
+def verify_batch(public_keys: list[PublicKey], datas: list[bytes], signatures: list[Signature]) -> bool:
+    return get_implementation().verify_batch(public_keys, datas, signatures)
+
+
+def aggregate(sigs: list[Signature]) -> Signature:
+    return get_implementation().aggregate(sigs)
+
+
+def verify_aggregate(public_keys: list[PublicKey], data: bytes, signature: Signature) -> bool:
+    return get_implementation().verify_aggregate(public_keys, data, signature)

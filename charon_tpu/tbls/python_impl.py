@@ -1,0 +1,179 @@
+"""Pure-Python CPU backend for the tbls facade.
+
+This is the analogue of the reference's herumi backend (reference
+tbls/herumi.go:40-360): the production-correctness oracle every other backend
+(the TPU one in particular) must match bit-for-bit on aggregates and
+serializations.
+"""
+
+from __future__ import annotations
+
+import os
+import secrets
+
+from ..crypto import fields as F
+from ..crypto.curve import (
+    Fq2Ops,
+    FqOps,
+    g1_generator,
+    jac_add,
+    jac_infinity,
+    jac_is_infinity,
+    jac_mul,
+)
+from ..crypto.hash_to_curve import DST_ETH, hash_to_g2
+from ..crypto.pairing import pairings_equal
+from ..crypto.serialize import (
+    DeserializationError,
+    g1_from_bytes,
+    g1_to_bytes,
+    g2_from_bytes,
+    g2_to_bytes,
+)
+from .types import PrivateKey, PublicKey, Signature
+
+
+class PythonImpl:
+    """CPU reference implementation of the tbls Implementation seam
+    (reference tbls/tbls.go:28-69)."""
+
+    name = "python-cpu"
+
+    # -- key generation ------------------------------------------------------
+
+    def generate_secret_key(self) -> PrivateKey:
+        while True:
+            k = secrets.randbelow(F.R)
+            if k != 0:
+                return PrivateKey(k.to_bytes(32, "big"))
+
+    def secret_to_public_key(self, secret: PrivateKey) -> PublicKey:
+        k = self._scalar(secret)
+        return PublicKey(g1_to_bytes(jac_mul(FqOps, g1_generator(), k)))
+
+    # -- threshold scheme ----------------------------------------------------
+
+    def threshold_split(self, secret: PrivateKey, total: int, threshold: int) -> dict[int, PrivateKey]:
+        """Shamir split over Fr; shares evaluated at x = 1..total
+        (reference tbls/herumi.go:134-178)."""
+        if not 1 <= threshold <= total:
+            raise ValueError("invalid threshold/total")
+        coeffs = [self._scalar(secret)] + [secrets.randbelow(F.R) for _ in range(threshold - 1)]
+        shares = {}
+        for i in range(1, total + 1):
+            acc = 0
+            for c in reversed(coeffs):
+                acc = (acc * i + c) % F.R
+            shares[i] = PrivateKey(acc.to_bytes(32, "big"))
+        return shares
+
+    def recover_secret(self, shares: dict[int, PrivateKey], total: int, threshold: int) -> PrivateKey:
+        ids = sorted(shares)
+        if len(ids) < threshold:
+            raise ValueError("insufficient shares")
+        ids = ids[:threshold]
+        lam = F.lagrange_coefficients_at_zero(ids)
+        acc = 0
+        for i, l in zip(ids, lam):
+            acc = (acc + l * self._scalar(shares[i])) % F.R
+        return PrivateKey(acc.to_bytes(32, "big"))
+
+    def threshold_aggregate(self, partial_sigs: dict[int, Signature]) -> Signature:
+        """Lagrange-combine partial signatures into the root signature
+        (reference tbls/herumi.go:244-283). Bit-identical to a direct signature
+        by the un-split key."""
+        if not partial_sigs:
+            raise ValueError("no partial signatures to aggregate")
+        ids = sorted(partial_sigs)
+        lam = F.lagrange_coefficients_at_zero(ids)
+        acc = jac_infinity(Fq2Ops)
+        for i, l in zip(ids, lam):
+            pt = g2_from_bytes(bytes(partial_sigs[i]), subgroup_check=False)
+            acc = jac_add(Fq2Ops, acc, jac_mul(Fq2Ops, pt, l))
+        return Signature(g2_to_bytes(acc))
+
+    # -- signing / verification ---------------------------------------------
+
+    def sign(self, private_key: PrivateKey, data: bytes) -> Signature:
+        k = self._scalar(private_key)
+        h = hash_to_g2(data, DST_ETH)
+        return Signature(g2_to_bytes(jac_mul(Fq2Ops, h, k)))
+
+    def verify(self, public_key: PublicKey, data: bytes, signature: Signature) -> bool:
+        try:
+            pk = g1_from_bytes(bytes(public_key))
+            sig = g2_from_bytes(bytes(signature))
+        except DeserializationError:
+            return False
+        if jac_is_infinity(FqOps, pk):
+            return False
+        h = hash_to_g2(data, DST_ETH)
+        # e(pk, H(m)) == e(G1, sig)
+        return pairings_equal([(pk, h)], [(g1_generator(), sig)])
+
+    def aggregate(self, sigs: list[Signature]) -> Signature:
+        if not sigs:
+            raise ValueError("no signatures to aggregate")
+        acc = jac_infinity(Fq2Ops)
+        for s in sigs:
+            acc = jac_add(Fq2Ops, acc, g2_from_bytes(bytes(s), subgroup_check=False))
+        return Signature(g2_to_bytes(acc))
+
+    def verify_aggregate(self, public_keys: list[PublicKey], data: bytes, signature: Signature) -> bool:
+        """FastAggregateVerify: all keys signed the same message."""
+        if not public_keys:
+            return False
+        try:
+            acc = jac_infinity(FqOps)
+            for pk in public_keys:
+                p = g1_from_bytes(bytes(pk))
+                if jac_is_infinity(FqOps, p):
+                    return False
+                acc = jac_add(FqOps, acc, p)
+            sig = g2_from_bytes(bytes(signature))
+        except DeserializationError:
+            return False
+        h = hash_to_g2(data, DST_ETH)
+        return pairings_equal([(acc, h)], [(g1_generator(), sig)])
+
+    # -- batched extensions (the TPU backend's fast path; CPU fallback loops) -
+
+    def verify_batch(self, public_keys: list[PublicKey], datas: list[bytes], signatures: list[Signature]) -> bool:
+        """All-or-nothing batch verification via random linear combination:
+        prod e(c_i pk_i, H(m_i)) == e(G1, sum c_i sig_i). On failure the caller
+        falls back to per-signature verify to identify culprits."""
+        if not (len(public_keys) == len(datas) == len(signatures)):
+            raise ValueError("length mismatch")
+        if not public_keys:
+            return True
+        try:
+            pks = [g1_from_bytes(bytes(pk)) for pk in public_keys]
+            sigs = [g2_from_bytes(bytes(s)) for s in signatures]
+        except DeserializationError:
+            return False
+        if any(jac_is_infinity(FqOps, pk) for pk in pks):
+            return False
+        # Deterministic per-call randomness is NOT ok (adversary could craft);
+        # use fresh CSPRNG scalars. 128-bit scalars suffice for soundness.
+        cs = [int.from_bytes(os.urandom(16), "big") | 1 for _ in sigs]
+        hs = {}
+        for d in datas:
+            if d not in hs:
+                hs[d] = hash_to_g2(d, DST_ETH)
+        sig_acc = jac_infinity(Fq2Ops)
+        for c, s in zip(cs, sigs):
+            sig_acc = jac_add(Fq2Ops, sig_acc, jac_mul(Fq2Ops, s, c))
+        left = [(jac_mul(FqOps, pk, c), hs[d]) for pk, c, d in zip(pks, cs, datas)]
+        return pairings_equal(left, [(g1_generator(), sig_acc)])
+
+    def threshold_aggregate_batch(self, batches: list[dict[int, Signature]]) -> list[Signature]:
+        return [self.threshold_aggregate(b) for b in batches]
+
+    # -- helpers -------------------------------------------------------------
+
+    @staticmethod
+    def _scalar(secret: PrivateKey) -> int:
+        k = int.from_bytes(bytes(secret), "big")
+        if k == 0 or k >= F.R:
+            raise ValueError("invalid secret scalar")
+        return k

@@ -1,0 +1,32 @@
+"""Threshold-BLS value types, mirroring the reference's fixed-size byte types
+(reference tbls/tbls.go:17-24: PublicKey [48]byte, PrivateKey [32]byte,
+Signature [96]byte)."""
+
+from __future__ import annotations
+
+
+class PrivateKey(bytes):
+    SIZE = 32
+
+    def __new__(cls, data: bytes):
+        if len(data) != cls.SIZE:
+            raise ValueError(f"PrivateKey must be {cls.SIZE} bytes, got {len(data)}")
+        return super().__new__(cls, data)
+
+
+class PublicKey(bytes):
+    SIZE = 48
+
+    def __new__(cls, data: bytes):
+        if len(data) != cls.SIZE:
+            raise ValueError(f"PublicKey must be {cls.SIZE} bytes, got {len(data)}")
+        return super().__new__(cls, data)
+
+
+class Signature(bytes):
+    SIZE = 96
+
+    def __new__(cls, data: bytes):
+        if len(data) != cls.SIZE:
+            raise ValueError(f"Signature must be {cls.SIZE} bytes, got {len(data)}")
+        return super().__new__(cls, data)

@@ -1,0 +1,208 @@
+"""Low-level BLS12-381 primitive tests: field tower, curve groups, pairing,
+hash-to-curve (RFC 9380 known-answer vector), serialization."""
+
+import secrets
+
+import pytest
+
+from charon_tpu.crypto import fields as F
+from charon_tpu.crypto.curve import (
+    B_G1,
+    B_G2,
+    Fq2Ops,
+    FqOps,
+    g1_generator,
+    g1_in_subgroup,
+    g2_generator,
+    g2_in_subgroup,
+    is_on_curve,
+    jac_add,
+    jac_double,
+    jac_mul,
+    jac_neg,
+    to_affine,
+)
+from charon_tpu.crypto.hash_to_curve import (
+    A_ISO,
+    B_ISO,
+    expand_message_xmd,
+    hash_to_field_fq2,
+    hash_to_g2,
+    iso_map_g2,
+    map_to_curve_sswu,
+)
+from charon_tpu.crypto.pairing import pairing, untwist, fq_to_fq12
+from charon_tpu.crypto.serialize import (
+    DeserializationError,
+    g1_from_bytes,
+    g1_to_bytes,
+    g2_from_bytes,
+    g2_to_bytes,
+)
+
+
+def _rand_fq2():
+    return (secrets.randbelow(F.P), secrets.randbelow(F.P))
+
+
+class TestFields:
+    def test_fq2_mul_inv(self):
+        for _ in range(20):
+            a = _rand_fq2()
+            if a == F.FQ2_ZERO:
+                continue
+            assert F.fq2_mul(a, F.fq2_inv(a)) == F.FQ2_ONE
+
+    def test_fq2_sqrt(self):
+        for _ in range(10):
+            a = _rand_fq2()
+            sq = F.fq2_sqr(a)
+            s = F.fq2_sqrt(sq)
+            assert s is not None
+            assert F.fq2_sqr(s) == sq
+
+    def test_fq6_mul_inv(self):
+        a = (_rand_fq2(), _rand_fq2(), _rand_fq2())
+        assert F.fq6_mul(a, F.fq6_inv(a)) == F.FQ6_ONE
+
+    def test_fq12_mul_inv(self):
+        a = ((_rand_fq2(), _rand_fq2(), _rand_fq2()), (_rand_fq2(), _rand_fq2(), _rand_fq2()))
+        assert F.fq12_mul(a, F.fq12_inv(a)) == F.FQ12_ONE
+
+    def test_fq12_frobenius_matches_pow(self):
+        a = ((_rand_fq2(), _rand_fq2(), _rand_fq2()), (_rand_fq2(), _rand_fq2(), _rand_fq2()))
+        assert F.fq12_frobenius(a) == F.fq12_pow(a, F.P)
+
+    def test_lagrange_identity(self):
+        # interpolating f(x)=c0+c1 x+c2 x^2 at x=0 from 3 points
+        c = [secrets.randbelow(F.R) for _ in range(3)]
+        ids = [2, 5, 7]
+        vals = [(c[0] + c[1] * i + c[2] * i * i) % F.R for i in ids]
+        lam = F.lagrange_coefficients_at_zero(ids)
+        acc = sum(l * v for l, v in zip(lam, vals)) % F.R
+        assert acc == c[0]
+
+
+class TestCurve:
+    def test_generators(self):
+        assert is_on_curve(FqOps, to_affine(FqOps, g1_generator()), B_G1)
+        assert is_on_curve(Fq2Ops, to_affine(Fq2Ops, g2_generator()), B_G2)
+        assert g1_in_subgroup(g1_generator())
+        assert g2_in_subgroup(g2_generator())
+
+    def test_group_laws_g1(self):
+        g = g1_generator()
+        a = jac_mul(FqOps, g, 1234567)
+        b = jac_mul(FqOps, g, 7654321)
+        ab = jac_add(FqOps, a, b)
+        assert to_affine(FqOps, ab) == to_affine(FqOps, jac_mul(FqOps, g, 1234567 + 7654321))
+        assert to_affine(FqOps, jac_add(FqOps, a, jac_neg(FqOps, a))) is None
+        assert to_affine(FqOps, jac_double(FqOps, a)) == to_affine(FqOps, jac_mul(FqOps, g, 2 * 1234567))
+
+    def test_group_laws_g2(self):
+        g = g2_generator()
+        a = jac_mul(Fq2Ops, g, 999)
+        b = jac_mul(Fq2Ops, g, 1001)
+        assert to_affine(Fq2Ops, jac_add(Fq2Ops, a, b)) == to_affine(Fq2Ops, jac_mul(Fq2Ops, g, 2000))
+
+
+class TestPairing:
+    def test_bilinearity(self):
+        e = pairing(g1_generator(), g2_generator())
+        assert e != F.FQ12_ONE
+        assert F.fq12_pow(e, F.R) == F.FQ12_ONE
+        a, b = 31337, 271828
+        eab = pairing(jac_mul(FqOps, g1_generator(), a), jac_mul(Fq2Ops, g2_generator(), b))
+        assert eab == F.fq12_pow(e, a * b)
+
+    def test_untwist_on_curve(self):
+        from charon_tpu.crypto.curve import G2_GEN
+
+        x12, y12 = untwist(G2_GEN)
+        assert F.fq12_sqr(y12) == F.fq12_add(F.fq12_mul(F.fq12_sqr(x12), x12), fq_to_fq12(4))
+
+
+class TestHashToCurve:
+    def test_rfc9380_vector_empty_msg(self):
+        """RFC 9380 J.10.1, BLS12381G2_XMD:SHA-256_SSWU_RO_, msg=''."""
+        dst = b"QUUX-V01-CS02-with-BLS12381G2_XMD:SHA-256_SSWU_RO_"
+        p = to_affine(Fq2Ops, hash_to_g2(b"", dst))
+        assert p[0] == (
+            0x0141EBFBDCA40EB85B87142E130AB689C673CF60F1A3E98D69335266F30D9B8D4AC44C1038E9DCDD5393FAF5C41FB78A,
+            0x05CB8437535E20ECFFAEF7752BADDF98034139C38452458BAEEFAB379BA13DFF5BF5DD71B72418717047F5B0F37DA03D,
+        )
+        assert p[1] == (
+            0x0503921D7F6A12805E72940B963C0CF3471C7B2A524950CA195D11062EE75EC076DAF2D4BC358C4B190C0C98064FDD92,
+            0x12424AC32561493F3FE3C260708A12B7C620E7BE00099A974E259DDC7D1F6395C3C811CDD19F1E8DBF3E9ECFDCBAB8D6,
+        )
+
+    def test_sswu_lands_on_iso_curve(self):
+        u = hash_to_field_fq2(b"structural", b"TEST-DST", 1)[0]
+        x, y = map_to_curve_sswu(u)
+        assert F.fq2_sqr(y) == F.fq2_add(F.fq2_add(F.fq2_mul(F.fq2_sqr(x), x), F.fq2_mul(A_ISO, x)), B_ISO)
+
+    def test_iso_map_lands_on_e(self):
+        for i in range(4):
+            u = hash_to_field_fq2(b"iso-%d" % i, b"TEST-DST", 1)[0]
+            q = iso_map_g2(map_to_curve_sswu(u))
+            assert is_on_curve(Fq2Ops, q, B_G2)
+
+    def test_output_in_subgroup(self):
+        p = hash_to_g2(b"subgroup check")
+        assert g2_in_subgroup(p)
+
+    def test_expand_message_basics(self):
+        out = expand_message_xmd(b"abc", b"DST", 96)
+        assert len(out) == 96
+        assert out != expand_message_xmd(b"abd", b"DST", 96)
+        assert out[:32] != out[32:64]
+
+
+class TestSerialization:
+    def test_g1_roundtrip(self):
+        for k in (1, 2, 31337, F.R - 1):
+            p = jac_mul(FqOps, g1_generator(), k)
+            b = g1_to_bytes(p)
+            assert len(b) == 48
+            assert to_affine(FqOps, g1_from_bytes(b)) == to_affine(FqOps, p)
+
+    def test_g2_roundtrip(self):
+        for k in (1, 2, 31337, F.R - 1):
+            p = jac_mul(Fq2Ops, g2_generator(), k)
+            b = g2_to_bytes(p)
+            assert len(b) == 96
+            assert to_affine(Fq2Ops, g2_from_bytes(b)) == to_affine(Fq2Ops, p)
+
+    def test_known_generator_encodings(self):
+        # Well-known compressed encodings of the standard generators.
+        assert g1_to_bytes(g1_generator()).hex() == (
+            "97f1d3a73197d7942695638c4fa9ac0fc3688c4f9774b905a14e3a3f171bac58"
+            "6c55e83ff97a1aeffb3af00adb22c6bb"
+        )
+        assert g2_to_bytes(g2_generator()).hex() == (
+            "93e02b6052719f607dacd3a088274f65596bd0d09920b61ab5da61bbdc7f5049"
+            "334cf11213945d57e5ac7d055d042b7e024aa2b2f08f0a91260805272dc51051"
+            "c6e47ad4fa403b02b4510b647ae3d1770bac0326a805bbefd48056c8c121bdb8"
+        )
+
+    def test_infinity_roundtrip(self):
+        from charon_tpu.crypto.curve import jac_infinity
+
+        b1 = g1_to_bytes(jac_infinity(FqOps))
+        assert b1[0] == 0xC0 and not any(b1[1:])
+        assert to_affine(FqOps, g1_from_bytes(b1)) is None
+        b2 = g2_to_bytes(jac_infinity(Fq2Ops))
+        assert to_affine(Fq2Ops, g2_from_bytes(b2)) is None
+
+    def test_rejects_bad_input(self):
+        with pytest.raises(DeserializationError):
+            g1_from_bytes(bytes(48))  # no compression bit
+        with pytest.raises(DeserializationError):
+            g1_from_bytes(b"\xff" * 48)  # infinity flag with nonzero payload
+        # non-canonical x >= P with valid compression flags must be rejected
+        bad_x = bytearray((F.P + 1).to_bytes(48, "big"))
+        bad_x[0] |= 0x80
+        with pytest.raises(DeserializationError):
+            g1_from_bytes(bytes(bad_x))
+        with pytest.raises(DeserializationError):
+            g2_from_bytes(bytes(96))

@@ -1,0 +1,122 @@
+"""Implementation-parameterized threshold-BLS test suite.
+
+Mirrors the reference's strategy (reference tbls/tbls_test.go:17-178): one
+suite run against every backend, plus the split->sign->aggregate ==
+direct-sign bit-identity that is the cross-backend oracle
+(reference tbls/tbls_test.go:73-98).
+"""
+
+import pytest
+
+from charon_tpu import tbls
+from charon_tpu.tbls.python_impl import PythonImpl
+from charon_tpu.tbls.types import PrivateKey, PublicKey, Signature
+
+
+@pytest.fixture(scope="module")
+def impl():
+    return PythonImpl()
+
+
+@pytest.fixture(scope="module")
+def keypair(impl):
+    sk = impl.generate_secret_key()
+    return sk, impl.secret_to_public_key(sk)
+
+
+def test_generate_secret_key(impl):
+    a = impl.generate_secret_key()
+    b = impl.generate_secret_key()
+    assert len(a) == 32 and len(b) == 32
+    assert a != b
+
+
+def test_sign_verify_roundtrip(impl, keypair):
+    sk, pk = keypair
+    msg = b"test duty data"
+    sig = impl.sign(sk, msg)
+    assert len(sig) == 96
+    assert impl.verify(pk, msg, sig)
+    assert not impl.verify(pk, b"other message", sig)
+
+
+def test_verify_rejects_wrong_key(impl, keypair):
+    sk, _ = keypair
+    msg = b"test duty data"
+    sig = impl.sign(sk, msg)
+    sk2 = impl.generate_secret_key()
+    pk2 = impl.secret_to_public_key(sk2)
+    assert not impl.verify(pk2, msg, sig)
+
+
+def test_verify_rejects_garbage_sig(impl, keypair):
+    _, pk = keypair
+    assert not impl.verify(pk, b"msg", Signature(bytes(96)))
+    assert not impl.verify(pk, b"msg", Signature(b"\xff" * 96))
+
+
+def test_threshold_split_recover(impl, keypair):
+    sk, _ = keypair
+    shares = impl.threshold_split(sk, total=5, threshold=3)
+    assert set(shares) == {1, 2, 3, 4, 5}
+    # any 3 shares recover the secret exactly
+    sub = {i: shares[i] for i in (2, 4, 5)}
+    rec = impl.recover_secret(sub, total=5, threshold=3)
+    assert rec == sk
+    with pytest.raises(ValueError):
+        impl.recover_secret({1: shares[1]}, total=5, threshold=3)
+
+
+def test_threshold_aggregate_bit_identical(impl, keypair):
+    """The oracle property (reference tbls/tbls_test.go:73-98): t partial sigs
+    Lagrange-aggregate into EXACTLY the signature the un-split key makes."""
+    sk, pk = keypair
+    msg = b"attestation data root"
+    direct = impl.sign(sk, msg)
+    shares = impl.threshold_split(sk, total=6, threshold=4)
+    partials = {i: impl.sign(shares[i], msg) for i in (1, 3, 5, 6)}
+    agg = impl.threshold_aggregate(partials)
+    assert bytes(agg) == bytes(direct)
+    assert impl.verify(pk, msg, agg)
+    # a different 4-subset gives the same aggregate
+    partials2 = {i: impl.sign(shares[i], msg) for i in (2, 3, 4, 5)}
+    assert bytes(impl.threshold_aggregate(partials2)) == bytes(direct)
+
+
+def test_partial_sig_verifies_against_share_pubkey(impl, keypair):
+    sk, _ = keypair
+    msg = b"duty"
+    shares = impl.threshold_split(sk, total=4, threshold=3)
+    share_pk = impl.secret_to_public_key(shares[2])
+    psig = impl.sign(shares[2], msg)
+    assert impl.verify(share_pk, msg, psig)
+
+
+def test_aggregate_and_verify_aggregate(impl):
+    msg = b"shared message"
+    sks = [impl.generate_secret_key() for _ in range(3)]
+    pks = [impl.secret_to_public_key(sk) for sk in sks]
+    sigs = [impl.sign(sk, msg) for sk in sks]
+    agg = impl.aggregate(sigs)
+    assert impl.verify_aggregate(pks, msg, agg)
+    assert not impl.verify_aggregate(pks[:2], msg, agg)
+
+
+def test_verify_batch(impl):
+    msgs = [b"m1", b"m2", b"m1"]
+    sks = [impl.generate_secret_key() for _ in msgs]
+    pks = [impl.secret_to_public_key(sk) for sk in sks]
+    sigs = [impl.sign(sk, m) for sk, m in zip(sks, msgs)]
+    assert impl.verify_batch(pks, msgs, sigs)
+    # single bad signature fails the whole batch
+    bad = list(sigs)
+    bad[1] = sigs[0]
+    assert not impl.verify_batch(pks, msgs, bad)
+
+
+def test_facade_delegates(impl):
+    tbls.set_implementation(impl)
+    sk = tbls.generate_secret_key()
+    pk = tbls.secret_to_public_key(sk)
+    sig = tbls.sign(sk, b"x")
+    assert tbls.verify(pk, b"x", sig)
