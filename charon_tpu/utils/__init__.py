@@ -1,0 +1,6 @@
+"""Infra libraries (reference layer L0, app/{log,errors,lifecycle,retry,
+expbackoff,forkjoin,featureset,promauto,version,health}).
+
+Everything above (crypto plane, core duty pipeline, p2p, dkg, app shell)
+builds on these; they depend only on the stdlib.
+"""

@@ -1,0 +1,64 @@
+"""Structured errors with context fields and one-shot stack capture.
+
+Mirrors the reference's app/errors package (errors/errors.go): errors carry
+key=value context fields that merge up the call chain, wrap causes, and
+capture a traceback only once (at the innermost wrap) so logs show the origin.
+"""
+
+from __future__ import annotations
+
+import traceback
+from typing import Any
+
+
+class CharonError(Exception):
+    """Error with structured context fields and an optional wrapped cause.
+
+    Reference app/errors/errors.go: New/Wrap attach z.Field context; the stack
+    is captured once at the first wrap.
+    """
+
+    def __init__(self, msg: str, cause: BaseException | None = None, **fields: Any):
+        super().__init__(msg)
+        self.msg = msg
+        self.cause = cause
+        self.fields = dict(fields)
+        if isinstance(cause, CharonError):
+            # Merge inner fields; inner values win (closest to the origin).
+            merged = dict(fields)
+            merged.update(cause.fields)
+            self.fields = merged
+            self.stack = cause.stack
+        elif cause is not None:
+            self.stack = "".join(
+                traceback.format_exception(type(cause), cause, cause.__traceback__)
+            )
+        else:
+            self.stack = "".join(traceback.format_stack()[:-1])
+
+    def __str__(self) -> str:
+        parts = [self.msg]
+        if self.fields:
+            parts.append(" ".join(f"{k}={v!r}" for k, v in sorted(self.fields.items())))
+        if self.cause is not None:
+            parts.append(f"cause: {self.cause}")
+        return ": ".join(parts)
+
+
+def new(msg: str, **fields: Any) -> CharonError:
+    return CharonError(msg, **fields)
+
+
+def wrap(err: BaseException, msg: str, **fields: Any) -> CharonError:
+    """Wrap an error with an additional message and context fields."""
+    return CharonError(msg, cause=err, **fields)
+
+
+def is_error(err: BaseException | None, sentinel: BaseException) -> bool:
+    """errors.Is analogue: walk the cause chain looking for the sentinel."""
+    cur: BaseException | None = err
+    while cur is not None:
+        if cur is sentinel:
+            return True
+        cur = cur.cause if isinstance(cur, CharonError) else cur.__cause__
+    return False

@@ -1,0 +1,51 @@
+"""Jittered exponential backoff (reference app/expbackoff/expbackoff.go).
+
+Usage:
+    backoff = Backoff()
+    while not ok:
+        await backoff.wait()   # sleeps 1s, 2s, 4s ... capped, +/- jitter
+"""
+
+from __future__ import annotations
+
+import asyncio
+import random
+import time
+
+
+class Config:
+    def __init__(self, base: float = 1.0, multiplier: float = 2.0,
+                 jitter: float = 0.1, max_delay: float = 60.0):
+        self.base = base
+        self.multiplier = multiplier
+        self.jitter = jitter
+        self.max_delay = max_delay
+
+
+DEFAULT = Config()
+FAST = Config(base=0.1, max_delay=5.0)
+
+
+class Backoff:
+    """Stateful backoff: each wait() sleeps longer, with jitter."""
+
+    def __init__(self, config: Config = DEFAULT):
+        self.config = config
+        self.retries = 0
+
+    def next_delay(self) -> float:
+        c = self.config
+        delay = min(c.base * (c.multiplier ** self.retries), c.max_delay)
+        self.retries += 1
+        if c.jitter > 0:
+            delay *= 1 + random.uniform(-c.jitter, c.jitter)
+        return delay
+
+    async def wait(self) -> None:
+        await asyncio.sleep(self.next_delay())
+
+    def wait_sync(self) -> None:
+        time.sleep(self.next_delay())
+
+    def reset(self) -> None:
+        self.retries = 0

@@ -1,0 +1,78 @@
+"""Feature-rollout flags with alpha/beta/stable statuses.
+
+Mirrors the reference's app/featureset (featureset.go:10-75): features are
+registered with a maturity status; a global minimum status enables everything
+at-or-above it; individual features can be force-enabled/disabled by config.
+The TPU crypto backend is gated here, exactly as the reference designates the
+featureset as the gate for in-progress backends.
+"""
+
+from __future__ import annotations
+
+import threading
+
+# Statuses, ordered (reference featureset.go:14-24).
+ALPHA, BETA, STABLE = 0, 1, 2
+_STATUS_NAMES = {"alpha": ALPHA, "beta": BETA, "stable": STABLE}
+
+# Feature registry: name -> maturity status (reference featureset.go:27-58).
+TPU_BLS = "tpu_bls"                  # JAX/TPU tbls backend (the north star)
+EAGER_DOUBLE_LINEAR = "eager_double_linear"  # consensus round-timer A/B
+QBFT_CONSENSUS = "qbft_consensus"    # QBFT vs leadercast
+AGG_SIG_DB_V2 = "agg_sig_db_v2"
+JSON_REQUESTS = "json_requests"
+
+_features: dict[str, int] = {
+    TPU_BLS: ALPHA,
+    EAGER_DOUBLE_LINEAR: ALPHA,
+    QBFT_CONSENSUS: STABLE,
+    AGG_SIG_DB_V2: ALPHA,
+    JSON_REQUESTS: ALPHA,
+}
+
+_lock = threading.Lock()
+_min_status = STABLE
+_enabled_overrides: set[str] = set()
+_disabled_overrides: set[str] = set()
+
+
+def init(min_status_name: str = "stable", enabled: list[str] | None = None,
+         disabled: list[str] | None = None) -> None:
+    """Initialise from config (reference app/featureset/config.go, flags
+    --feature-set / --feature-set-enable / --feature-set-disable)."""
+    global _min_status
+    # Validate everything before mutating any global state, so a config error
+    # cannot leave a half-applied featureset behind.
+    if min_status_name not in _STATUS_NAMES:
+        raise ValueError(f"unknown feature status {min_status_name!r}")
+    for f in (enabled or []) + (disabled or []):
+        if f not in _features:
+            raise ValueError(f"unknown feature {f!r}")
+    with _lock:
+        _min_status = _STATUS_NAMES[min_status_name]
+        _enabled_overrides.clear()
+        _disabled_overrides.clear()
+        _enabled_overrides.update(enabled or [])
+        _disabled_overrides.update(disabled or [])
+
+
+def enabled(feature: str) -> bool:
+    with _lock:
+        if feature in _disabled_overrides:
+            return False
+        if feature in _enabled_overrides:
+            return True
+        return _features.get(feature, ALPHA) >= _min_status
+
+
+def enable_for_t(feature: str) -> None:
+    """Test helper: force-enable a feature."""
+    with _lock:
+        _enabled_overrides.add(feature)
+        _disabled_overrides.discard(feature)
+
+
+def disable_for_t(feature: str) -> None:
+    with _lock:
+        _disabled_overrides.add(feature)
+        _enabled_overrides.discard(feature)

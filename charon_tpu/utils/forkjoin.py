@@ -1,0 +1,52 @@
+"""Generic fork-join fan-out/fan-in (reference app/forkjoin/forkjoin.go:148).
+
+Runs one async worker per input with bounded concurrency, gathers
+(input, output | error) results, and offers flatten() which returns all
+outputs or the first error — the reference's Flatten (forkjoin.go:253).
+"""
+
+from __future__ import annotations
+
+import asyncio
+from dataclasses import dataclass
+from typing import Awaitable, Callable, Generic, TypeVar
+
+I = TypeVar("I")
+O = TypeVar("O")
+
+DEFAULT_WORKERS = 8
+
+
+@dataclass
+class Result(Generic[I, O]):
+    input: I
+    output: O | None
+    err: BaseException | None
+
+
+async def fork_join(
+    inputs: list[I],
+    work: Callable[[I], Awaitable[O]],
+    workers: int = DEFAULT_WORKERS,
+) -> list[Result[I, O]]:
+    sem = asyncio.Semaphore(max(1, workers))
+
+    async def _one(inp: I) -> Result[I, O]:
+        async with sem:
+            try:
+                return Result(inp, await work(inp), None)
+            except Exception as exc:  # noqa: BLE001 — collected, not swallowed
+                return Result(inp, None, exc)
+
+    return list(await asyncio.gather(*(_one(i) for i in inputs)))
+
+
+def flatten(results: list[Result[I, O]]) -> list[O]:
+    """All outputs in input order, or raise the first error
+    (reference forkjoin.go:253 Flatten)."""
+    outs: list[O] = []
+    for r in results:
+        if r.err is not None:
+            raise r.err
+        outs.append(r.output)  # type: ignore[arg-type]
+    return outs

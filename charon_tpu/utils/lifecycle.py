@@ -1,0 +1,97 @@
+"""Ordered start/stop hook manager for the application process.
+
+Mirrors the reference's app/lifecycle (manager.go:23-100, order.go:15-34):
+components register start hooks (with an explicit order) and stop hooks; Run
+starts hooks in order, waits for shutdown, then stops in reverse order. Hooks
+come in two flavours, matching the reference:
+
+  * APP_CTX    — run with the application context; cancelled on shutdown.
+  * BACKGROUND — fire-and-forget async task, also cancelled on shutdown.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import enum
+from typing import Awaitable, Callable
+
+from . import log
+
+_log = log.with_topic("life")
+
+
+# Start order (reference app/lifecycle/order.go:15-34): lower starts first.
+class Order(enum.IntEnum):
+    START_TRACKER = 1
+    START_AGG_SIG_DB = 2
+    START_RELAYS = 3
+    START_MONITORING_API = 4
+    START_VALIDATOR_API = 5
+    START_P2P_PING = 6
+    START_FORCE_DIRECT_CONNS = 7
+    START_PARSIGDB = 8
+    START_PEER_INFO = 9
+    START_CONSENSUS = 10
+    START_SIM_VALIDATOR_MOCK = 11
+    START_SCHEDULER = 12
+
+
+HookFunc = Callable[[], Awaitable[None]]
+
+
+class Manager:
+    """Collects hooks before Run; executes them in declared order."""
+
+    def __init__(self):
+        self._start_hooks: list[tuple[int, str, HookFunc]] = []
+        self._stop_hooks: list[tuple[str, HookFunc]] = []
+        self._started = False
+
+    def register_start(self, order: int, label: str, hook: HookFunc) -> None:
+        if self._started:
+            raise RuntimeError("lifecycle already started")
+        self._start_hooks.append((int(order), label, hook))
+
+    def register_stop(self, label: str, hook: HookFunc) -> None:
+        if self._started:
+            raise RuntimeError("lifecycle already started")
+        self._stop_hooks.append((label, hook))
+
+    async def run(self, stop_event: asyncio.Event | None = None) -> None:
+        """Start all hooks in order as background tasks; on stop_event (or
+        cancellation) cancel them and run stop hooks in reverse order."""
+        self._started = True
+        stop_event = stop_event or asyncio.Event()
+        tasks: list[asyncio.Task] = []
+        errors: list[BaseException] = []
+
+        def _on_done(label: str):
+            def cb(t: asyncio.Task):
+                if t.cancelled():
+                    return
+                exc = t.exception()
+                if exc is not None:
+                    _log.error("lifecycle hook failed", err=exc, hook=label)
+                    errors.append(exc)
+                    stop_event.set()
+            return cb
+
+        try:
+            for order, label, hook in sorted(self._start_hooks, key=lambda h: h[0]):
+                _log.debug("starting hook", hook=label, order=order)
+                task = asyncio.create_task(hook(), name=f"life:{label}")
+                task.add_done_callback(_on_done(label))
+                tasks.append(task)
+            await stop_event.wait()
+        finally:
+            for task in tasks:
+                task.cancel()
+            if tasks:
+                await asyncio.gather(*tasks, return_exceptions=True)
+            for label, hook in reversed(self._stop_hooks):
+                try:
+                    await asyncio.wait_for(hook(), timeout=10)
+                except Exception as exc:  # noqa: BLE001 — stop hooks must not cascade
+                    _log.warn("stop hook failed", err=exc, hook=label)
+        if errors:
+            raise errors[0]

@@ -1,0 +1,108 @@
+"""Structured logging with topics, levels, and error/warn counters.
+
+Mirrors the reference's app/log (log/log.go:78-150): loggers are bound to a
+"topic" (component name), emit structured key=value fields, support console /
+logfmt / json formats, and count errors+warnings into metrics that feed the
+health checker (app/log/metrics.go).
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import threading
+import time
+from typing import Any, TextIO
+
+from .errors import CharonError
+
+DEBUG, INFO, WARN, ERROR = 10, 20, 30, 40
+_LEVEL_NAMES = {DEBUG: "DEBG", INFO: "INFO", WARN: "WARN", ERROR: "ERRO"}
+
+# Error/warn counters by topic, scraped by the health checker
+# (reference app/log/metrics.go feeding app/health/checks.go:41).
+_counters_lock = threading.Lock()
+log_error_total: dict[str, int] = {}
+log_warn_total: dict[str, int] = {}
+
+
+class _Config:
+    level: int = INFO
+    fmt: str = "console"  # console | logfmt | json
+    out: TextIO = sys.stderr
+    topic_filter: set[str] | None = None  # None = all topics
+
+
+_config = _Config()
+
+
+def init(level: int = INFO, fmt: str = "console", out: TextIO | None = None,
+         topics: list[str] | None = None) -> None:
+    """Initialise global logging config (reference app/log/config.go)."""
+    _config.level = level
+    _config.fmt = fmt
+    if out is not None:
+        _config.out = out
+    _config.topic_filter = set(topics) if topics else None
+
+
+class Logger:
+    """A topic-bound structured logger (reference log.WithTopic, log.go:43)."""
+
+    def __init__(self, topic: str, **fields: Any):
+        self.topic = topic
+        self.fields = fields
+
+    def with_fields(self, **fields: Any) -> "Logger":
+        merged = dict(self.fields)
+        merged.update(fields)
+        return Logger(self.topic, **merged)
+
+    def debug(self, msg: str, **fields: Any) -> None:
+        self._emit(DEBUG, msg, None, fields)
+
+    def info(self, msg: str, **fields: Any) -> None:
+        self._emit(INFO, msg, None, fields)
+
+    def warn(self, msg: str, err: BaseException | None = None, **fields: Any) -> None:
+        with _counters_lock:
+            log_warn_total[self.topic] = log_warn_total.get(self.topic, 0) + 1
+        self._emit(WARN, msg, err, fields)
+
+    def error(self, msg: str, err: BaseException | None = None, **fields: Any) -> None:
+        with _counters_lock:
+            log_error_total[self.topic] = log_error_total.get(self.topic, 0) + 1
+        self._emit(ERROR, msg, err, fields)
+
+    def _emit(self, level: int, msg: str, err: BaseException | None,
+              fields: dict[str, Any]) -> None:
+        if level < _config.level:
+            return
+        if _config.topic_filter is not None and self.topic not in _config.topic_filter:
+            return
+        all_fields = dict(self.fields)
+        all_fields.update(fields)
+        if err is not None:
+            all_fields["err"] = str(err)
+            if isinstance(err, CharonError):
+                all_fields.update(err.fields)
+        ts = time.time()
+        if _config.fmt == "json":
+            rec = {"ts": ts, "level": _LEVEL_NAMES[level].strip().lower(),
+                   "topic": self.topic, "msg": msg, **{k: repr(v) for k, v in all_fields.items()}}
+            line = json.dumps(rec, default=str)
+        elif _config.fmt == "logfmt":
+            kv = " ".join(f"{k}={v!r}" for k, v in all_fields.items())
+            line = f'ts={ts:.3f} level={_LEVEL_NAMES[level].strip().lower()} topic={self.topic} msg="{msg}" {kv}'.rstrip()
+        else:  # console
+            tstr = time.strftime("%H:%M:%S", time.localtime(ts))
+            kv = " ".join(f"{{{k}: {v}}}" for k, v in all_fields.items())
+            line = f"{tstr} {_LEVEL_NAMES[level]} {self.topic:<12} {msg} {kv}".rstrip()
+        try:
+            print(line, file=_config.out, flush=True)
+        except ValueError:
+            pass  # closed stream during interpreter shutdown
+
+
+def with_topic(topic: str, **fields: Any) -> Logger:
+    return Logger(topic, **fields)

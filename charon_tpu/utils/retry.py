@@ -1,0 +1,95 @@
+"""Deadline-bounded async retry with backoff.
+
+Mirrors the reference's app/retry (retry.go:93-156,229): a Retryer bound to a
+per-duty deadline function re-runs an async operation on *temporary* errors
+(network blips, upstream unavailability) with expbackoff, until it succeeds or
+the duty's deadline expires. Used by the core workflow's WithAsyncRetry wire
+option so slow steps never block the pipeline.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from typing import Awaitable, Callable, TypeVar
+
+from . import expbackoff, log
+
+T = TypeVar("T")
+
+_log = log.with_topic("retry")
+
+
+class TemporaryError(Exception):
+    """Marker for retryable errors (reference retry.go isTemporaryError)."""
+
+
+def is_temporary(err: BaseException) -> bool:
+    # Narrow set, matching the reference (retry.go isTemporaryError): timeouts
+    # and connection-level failures only. Notably NOT all OSError — permanent
+    # errors like FileNotFoundError/PermissionError must fail fast.
+    cur: BaseException | None = err
+    while cur is not None:
+        if isinstance(cur, (TemporaryError, asyncio.TimeoutError, TimeoutError, ConnectionError)):
+            return True
+        cur = getattr(cur, "cause", None) or cur.__cause__
+    return False
+
+
+class Retryer:
+    """Retry async ops until a deadline (reference retry.go:93 New)."""
+
+    def __init__(self, deadline_func: Callable[[object], float | None],
+                 backoff_config: expbackoff.Config = expbackoff.FAST):
+        # deadline_func maps a duty (or None) to an absolute unix deadline.
+        self._deadline_func = deadline_func
+        self._backoff_config = backoff_config
+        self._active: set[asyncio.Task] = set()
+
+    async def do_async(self, duty: object, label: str,
+                       fn: Callable[[], Awaitable[T]]) -> T:
+        """Run fn, retrying temporary errors until the duty deadline
+        (reference retry.go:156 DoAsync)."""
+        deadline = self._deadline_func(duty)
+        backoff = expbackoff.Backoff(self._backoff_config)
+        attempt = 0
+        while True:
+            attempt += 1
+            try:
+                if deadline is None:
+                    return await fn()
+                remaining = deadline - time.time()
+                if remaining <= 0:
+                    raise asyncio.TimeoutError(f"{label}: duty deadline expired")
+                return await asyncio.wait_for(fn(), timeout=remaining)
+            except Exception as exc:  # noqa: BLE001 — filtered below
+                if deadline is not None and time.time() >= deadline:
+                    _log.warn("retries exhausted at deadline", err=exc,
+                              label=label, attempt=attempt)
+                    raise
+                if not is_temporary(exc):
+                    raise
+                _log.debug("retrying temporary error", label=label,
+                           attempt=attempt, err=str(exc))
+                await backoff.wait()
+
+    def spawn(self, duty: object, label: str,
+              fn: Callable[[], Awaitable[None]]) -> asyncio.Task:
+        """Fire-and-forget retried task (the async part of WithAsyncRetry)."""
+        async def _run():
+            try:
+                await self.do_async(duty, label, fn)
+            except asyncio.CancelledError:
+                raise
+            except Exception as exc:  # noqa: BLE001 — logged, duty-scoped
+                _log.warn("async retried op failed", err=exc, label=label)
+
+        task = asyncio.create_task(_run(), name=f"retry:{label}")
+        self._active.add(task)
+        task.add_done_callback(self._active.discard)
+        return task
+
+    async def wait_idle(self) -> None:
+        """Test helper: wait for all spawned tasks to finish."""
+        while self._active:
+            await asyncio.gather(*list(self._active), return_exceptions=True)

@@ -1,0 +1,89 @@
+"""Lightweight tracing: spans with deterministic duty-derived trace IDs.
+
+Mirrors the reference's app/tracer (trace.go:27-123) + core/tracing.go:21-39:
+every duty gets a trace ID derived deterministically from {slot, type} so all
+peers' spans join into one cluster-wide trace. Spans are recorded in-process
+(inspectable in tests, dumpable as JSON) rather than exported to Jaeger; the
+exporter seam is a callback.
+"""
+
+from __future__ import annotations
+
+import contextvars
+import hashlib
+import threading
+import time
+from contextlib import contextmanager
+from dataclasses import dataclass, field
+from typing import Any, Callable
+
+_current_trace: contextvars.ContextVar[str | None] = contextvars.ContextVar(
+    "charon_trace_id", default=None)
+_current_span: contextvars.ContextVar[str | None] = contextvars.ContextVar(
+    "charon_span_id", default=None)
+
+
+@dataclass
+class Span:
+    trace_id: str
+    span_id: str
+    parent_id: str | None
+    name: str
+    start: float
+    end: float = 0.0
+    attrs: dict[str, Any] = field(default_factory=dict)
+
+
+_lock = threading.Lock()
+_finished: list[Span] = []
+_exporter: Callable[[Span], None] | None = None
+_MAX_BUFFER = 10_000
+
+
+def set_exporter(exporter: Callable[[Span], None] | None) -> None:
+    global _exporter
+    _exporter = exporter
+
+
+def rooted_ctx(duty_slot: int, duty_type: str) -> str:
+    """Deterministic trace root for a duty (reference core/tracing.go:21):
+    identical on every peer, so cluster-wide spans join."""
+    h = hashlib.sha256(f"charon/duty/{duty_slot}/{duty_type}".encode()).hexdigest()
+    trace_id = h[:32]
+    _current_trace.set(trace_id)
+    _current_span.set(None)
+    return trace_id
+
+
+@contextmanager
+def start_span(name: str, **attrs: Any):
+    trace_id = _current_trace.get()
+    if trace_id is None:
+        trace_id = hashlib.sha256(f"{name}{time.time_ns()}".encode()).hexdigest()[:32]
+        _current_trace.set(trace_id)
+    parent = _current_span.get()
+    span_id = hashlib.sha256(
+        f"{trace_id}{parent}{name}{time.monotonic_ns()}".encode()).hexdigest()[:16]
+    span = Span(trace_id, span_id, parent, name, time.time(), attrs=dict(attrs))
+    token = _current_span.set(span_id)
+    try:
+        yield span
+    finally:
+        span.end = time.time()
+        _current_span.reset(token)
+        with _lock:
+            _finished.append(span)
+            if len(_finished) > _MAX_BUFFER:
+                del _finished[: _MAX_BUFFER // 2]
+        if _exporter is not None:
+            _exporter(span)
+
+
+def finished_spans() -> list[Span]:
+    with _lock:
+        return list(_finished)
+
+
+def reset_for_t() -> None:
+    with _lock:
+        _finished.clear()

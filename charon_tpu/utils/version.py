@@ -1,0 +1,24 @@
+"""Version metadata (reference app/version/version.go:18)."""
+
+from __future__ import annotations
+
+VERSION = "0.1.0"
+
+# Minimum cluster-definition/lock versions supported (reference
+# cluster/version.go-style compatibility surface).
+SUPPORTED_CLUSTER_VERSIONS = ("v1.5.0", "v1.6.0", "v1.7.0")
+
+
+def git_commit() -> str:
+    """Best-effort short git hash of the build tree."""
+    import pathlib
+    import subprocess
+
+    try:
+        root = pathlib.Path(__file__).resolve().parents[2]
+        out = subprocess.run(
+            ["git", "-C", str(root), "rev-parse", "--short=7", "HEAD"],
+            capture_output=True, text=True, timeout=5, check=True)
+        return out.stdout.strip()
+    except Exception:  # noqa: BLE001 — version info is best-effort
+        return "unknown"

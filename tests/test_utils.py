@@ -1,0 +1,191 @@
+"""Unit tests for the infra utils layer (reference app/{errors,log,expbackoff,
+forkjoin,featureset,lifecycle,retry,promauto} test shapes)."""
+
+import asyncio
+import io
+
+import pytest
+
+from charon_tpu.utils import (
+    errors,
+    expbackoff,
+    featureset,
+    forkjoin,
+    lifecycle,
+    log,
+    metrics,
+    retry,
+    tracer,
+)
+
+
+def test_errors_wrap_fields_merge():
+    inner = errors.new("db fail", key="inner", shared="inner-wins")
+    outer = errors.wrap(inner, "fetch failed", shared="outer", extra=1)
+    assert outer.fields["key"] == "inner"
+    assert outer.fields["shared"] == "inner-wins"
+    assert outer.fields["extra"] == 1
+    assert "db fail" in str(outer)
+    assert errors.is_error(outer, inner)
+    assert not errors.is_error(outer, errors.new("other"))
+
+
+def test_log_formats_and_counters():
+    buf = io.StringIO()
+    log.init(level=log.DEBUG, fmt="logfmt", out=buf)
+    lg = log.with_topic("testtopic", peer="node0")
+    before = log.log_error_total.get("testtopic", 0)
+    lg.info("hello", slot=5)
+    lg.error("boom", err=errors.new("bad", code=7))
+    out = buf.getvalue()
+    assert "testtopic" in out and "slot=5" in out
+    assert log.log_error_total["testtopic"] == before + 1
+    log.init(level=log.INFO, fmt="console")  # restore
+
+
+def test_expbackoff_grows_and_caps():
+    b = expbackoff.Backoff(expbackoff.Config(base=1, multiplier=2, jitter=0, max_delay=5))
+    assert [b.next_delay() for _ in range(4)] == [1, 2, 4, 5]
+    b.reset()
+    assert b.next_delay() == 1
+
+
+def test_featureset_statuses_and_overrides():
+    featureset.init("stable")
+    assert featureset.enabled(featureset.QBFT_CONSENSUS)
+    assert not featureset.enabled(featureset.TPU_BLS)
+    featureset.init("alpha")
+    assert featureset.enabled(featureset.TPU_BLS)
+    featureset.init("stable", enabled=[featureset.TPU_BLS])
+    assert featureset.enabled(featureset.TPU_BLS)
+    featureset.init("alpha", disabled=[featureset.TPU_BLS])
+    assert not featureset.enabled(featureset.TPU_BLS)
+    with pytest.raises(ValueError):
+        featureset.init("stable", enabled=["nope"])
+    featureset.init("stable")
+
+
+def test_forkjoin_flatten_and_errors():
+    async def run():
+        async def work(i):
+            if i == 3:
+                raise ValueError("bad input")
+            return i * 2
+
+        results = await forkjoin.fork_join([1, 2, 4], work, workers=2)
+        assert forkjoin.flatten(results) == [2, 4, 8]
+
+        results = await forkjoin.fork_join([1, 3], work)
+        with pytest.raises(ValueError):
+            forkjoin.flatten(results)
+
+    asyncio.run(run())
+
+
+def test_lifecycle_order_and_stop():
+    events = []
+
+    async def run():
+        mgr = lifecycle.Manager()
+        stop = asyncio.Event()
+
+        async def hook_a():
+            events.append("start-a")
+            await asyncio.Event().wait()  # run forever until cancelled
+
+        async def hook_b():
+            events.append("start-b")
+            stop.set()
+
+        async def stop_hook():
+            events.append("stopped")
+
+        mgr.register_start(lifecycle.Order.START_SCHEDULER, "a", hook_a)
+        mgr.register_start(lifecycle.Order.START_AGG_SIG_DB, "b", hook_b)
+        mgr.register_stop("s", stop_hook)
+        await mgr.run(stop)
+
+    asyncio.run(run())
+    # b has lower order so starts first; stop hooks run at shutdown.
+    assert events == ["start-b", "start-a", "stopped"]
+
+
+def test_retryer_retries_temporary_until_success():
+    async def run():
+        attempts = []
+
+        async def flaky():
+            attempts.append(1)
+            if len(attempts) < 3:
+                raise retry.TemporaryError("blip")
+            return "ok"
+
+        r = retry.Retryer(lambda duty: None,
+                          expbackoff.Config(base=0.001, jitter=0, max_delay=0.01))
+        assert await r.do_async(None, "flaky", flaky) == "ok"
+        assert len(attempts) == 3
+
+        async def fatal():
+            raise ValueError("permanent")
+
+        with pytest.raises(ValueError):
+            await r.do_async(None, "fatal", fatal)
+
+    asyncio.run(run())
+
+
+def test_retryer_respects_deadline():
+    async def run():
+        import time
+
+        deadline = time.time() + 0.05
+
+        async def always_fails():
+            raise retry.TemporaryError("never")
+
+        r = retry.Retryer(lambda duty: deadline,
+                          expbackoff.Config(base=0.01, jitter=0, max_delay=0.01))
+        with pytest.raises(Exception):
+            await r.do_async(object(), "never", always_fails)
+        assert time.time() >= deadline
+
+    asyncio.run(run())
+
+
+def test_metrics_counter_gauge_histogram_expose():
+    reg = metrics.Registry()
+    reg.set_const_labels(cluster_name="test")
+    c = reg.counter("duties_total", "duties", ("duty",))
+    c.inc("attester")
+    c.inc("attester")
+    g = reg.gauge("peers", "connected peers")
+    g.set(3)
+    h = reg.histogram("latency_seconds", "latency", ("step",))
+    h.observe(0.02, "fetch")
+    h.observe(0.3, "fetch")
+    assert c.value("attester") == 2
+    assert g.value() == 3
+    assert h.quantile(0.5, "fetch") in (0.025, 0.05)
+    text = reg.expose_text()
+    assert 'duties_total{cluster_name="test",duty="attester"} 2' in text
+    assert "latency_seconds_bucket" in text
+    # Re-registering returns the same child.
+    assert reg.counter("duties_total", "duties", ("duty",)) is c
+
+
+def test_tracer_deterministic_duty_roots_and_nesting():
+    tracer.reset_for_t()
+    t1 = tracer.rooted_ctx(42, "attester")
+    t2 = tracer.rooted_ctx(42, "attester")
+    assert t1 == t2  # identical across peers
+    assert tracer.rooted_ctx(43, "attester") != t1
+
+    tracer.rooted_ctx(42, "attester")
+    with tracer.start_span("outer") as outer:
+        with tracer.start_span("inner", slot=42) as inner:
+            pass
+    spans = tracer.finished_spans()
+    assert [s.name for s in spans] == ["inner", "outer"]
+    assert inner.parent_id == outer.span_id
+    assert inner.trace_id == outer.trace_id == t1
+    assert inner.attrs["slot"] == 42
