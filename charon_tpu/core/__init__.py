@@ -1,0 +1,21 @@
+"""Core duty workflow (reference layer L6, core/): the event pipeline
+
+  Scheduler → Fetcher → Consensus → DutyDB ⇄ ValidatorAPI → ParSigDB ⇄ ParSigEx
+                                           → ParSigDB —(threshold)→ SigAgg → AggSigDB
+                                                                    SigAgg → Broadcaster
+
+Components are actors consuming and producing immutable duty-scoped values,
+stitched together by `wire()` (reference core/interfaces.go:252-330).
+"""
+
+from .types import (  # noqa: F401
+    Duty,
+    DutyType,
+    ParSignedData,
+    ParSignedDataSet,
+    PubKey,
+    SignedDataSet,
+    UnsignedDataSet,
+    pubkey_from_bytes,
+    pubkey_to_bytes,
+)

@@ -1,0 +1,62 @@
+"""AggSigDB — in-memory store of final aggregate signatures with blocking
+awaits (reference core/aggsigdb/memory.go).
+
+Consumed by the Fetcher (aggregated randao for proposals, combined selection
+proofs for aggregation duties) and the ValidatorAPI (serving combined
+selections). The reference serializes access through a single-goroutine
+command loop (memory.go:116-160); here asyncio's single-threaded event loop
+gives the same discipline, with futures for the blocking Await.
+"""
+
+from __future__ import annotations
+
+import asyncio
+
+from ..utils import errors, log
+from .deadline import Deadliner
+from .types import Duty, PubKey, SignedData, SignedDataSet
+
+_log = log.with_topic("aggsigdb")
+
+
+class MemDB:
+    """reference aggsigdb.NewMemDB; Store memory.go:44, Await memory.go:86."""
+
+    def __init__(self, deadliner: Deadliner | None = None):
+        self._data: dict[tuple[Duty, PubKey], SignedData] = {}
+        self._waiters: dict[tuple[Duty, PubKey], list[asyncio.Future]] = {}
+        self._deadliner = deadliner
+
+    async def run_gc(self) -> None:
+        if self._deadliner is None:
+            return
+        async for duty in self._deadliner.expired():
+            self._data = {k: v for k, v in self._data.items() if k[0] != duty}
+            self._waiters = {k: v for k, v in self._waiters.items() if k[0] != duty}
+
+    async def store(self, duty: Duty, signed: SignedDataSet) -> None:
+        """Store aggregates, resolving blocked awaits (memory.go:44)."""
+        if self._deadliner is not None and not self._deadliner.add(duty):
+            _log.debug("dropping expired duty aggregate", duty=str(duty))
+            return
+        for pubkey, data in signed.items():
+            key = (duty, pubkey)
+            existing = self._data.get(key)
+            if existing is not None:
+                if bytes(existing.signature()) != bytes(data.signature()):
+                    raise errors.new("conflicting aggregate signature",
+                                     duty=str(duty), pubkey=pubkey[:10])
+                continue
+            self._data[key] = data.clone()
+            for fut in self._waiters.pop(key, []):
+                if not fut.done():
+                    fut.set_result(data.clone())
+
+    async def await_(self, duty: Duty, pubkey: PubKey) -> SignedData:
+        """Block until the aggregate for (duty, pubkey) exists (memory.go:86)."""
+        key = (duty, pubkey)
+        if key in self._data:
+            return self._data[key].clone()
+        fut = asyncio.get_running_loop().create_future()
+        self._waiters.setdefault(key, []).append(fut)
+        return await fut

@@ -1,0 +1,112 @@
+"""Broadcaster — pushes aggregate SignedDataSets to the beacon node
+(reference core/bcast/bcast.go:42,199-284) with per-type conversion and
+broadcast-delay metrics (bcast.go:286).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+
+from ..eth2.beacon import BeaconNode
+from ..eth2.spec import ChainSpec, SignedBeaconBlock
+from ..utils import errors, log, metrics
+from .signeddata import (
+    SignedAggregateAndProof,
+    SignedAttestation,
+    SignedExit,
+    SignedProposal,
+    SignedRegistration,
+    SignedSyncContributionAndProof,
+    SignedSyncMessage,
+)
+from .types import Duty, DutyType, SignedDataSet
+
+_log = log.with_topic("bcast")
+
+_bcast_counter = metrics.counter(
+    "core_bcast_broadcast_total", "Broadcasts to the beacon node", ("duty",))
+_bcast_delay = metrics.histogram(
+    "core_bcast_delay_seconds", "Broadcast delay since slot start", ("duty",))
+
+
+class Broadcaster:
+    """reference bcast.New / Broadcast (bcast.go:42)."""
+
+    def __init__(self, beacon: BeaconNode, chain: ChainSpec):
+        self._beacon = beacon
+        self._chain = chain
+
+    async def broadcast(self, duty: Duty, signed: SignedDataSet) -> None:
+        if not signed:
+            return
+        if duty.type == DutyType.ATTESTER:
+            atts = [d.att for d in signed.values()
+                    if isinstance(d, SignedAttestation)]
+            await self._beacon.submit_attestations(atts)
+        elif duty.type == DutyType.PROPOSER:
+            for d in signed.values():
+                if isinstance(d, SignedProposal):
+                    await self._beacon.submit_block(
+                        SignedBeaconBlock(dataclasses.replace(d.block), d.sig))
+        elif duty.type == DutyType.AGGREGATOR:
+            aggs = [_to_spec_agg(d) for d in signed.values()
+                    if isinstance(d, SignedAggregateAndProof)]
+            if aggs:
+                await self._beacon.submit_aggregate_and_proofs(aggs)
+        elif duty.type == DutyType.SYNC_MESSAGE:
+            msgs = [d.msg for d in signed.values()
+                    if isinstance(d, SignedSyncMessage)]
+            await self._beacon.submit_sync_messages(msgs)
+        elif duty.type == DutyType.SYNC_CONTRIBUTION:
+            contribs = [_to_spec_contrib(d) for d in signed.values()
+                        if isinstance(d, SignedSyncContributionAndProof)]
+            if contribs:
+                await self._beacon.submit_contribution_and_proofs(contribs)
+        elif duty.type == DutyType.BUILDER_REGISTRATION:
+            regs = [_to_spec_reg(d) for d in signed.values()
+                    if isinstance(d, SignedRegistration)]
+            if regs:
+                await self._beacon.submit_validator_registrations(regs)
+        elif duty.type == DutyType.EXIT:
+            for d in signed.values():
+                if isinstance(d, SignedExit):
+                    await self._beacon.submit_voluntary_exit(_to_spec_exit(d))
+        elif duty.type in (DutyType.RANDAO, DutyType.PREPARE_AGGREGATOR,
+                           DutyType.PREPARE_SYNC_CONTRIBUTION,
+                           DutyType.SIGNATURE):
+            # Internal duties: aggregates only feed other duties, nothing to
+            # broadcast (reference bcast.go ignores them the same way).
+            return
+        else:
+            raise errors.new("unsupported broadcast duty", duty=str(duty))
+
+        _bcast_counter.inc(str(duty.type))
+        delay = time.time() - self._chain.slot_start_time(duty.slot)
+        _bcast_delay.observe(delay, str(duty.type))
+        _log.info("broadcast duty to beacon node", duty=str(duty),
+                  validators=len(signed), delay_sec=round(delay, 3))
+
+
+def _to_spec_agg(d: SignedAggregateAndProof):
+    from ..eth2 import spec
+
+    return spec.SignedAggregateAndProof(d.message, d.sig)
+
+
+def _to_spec_contrib(d: SignedSyncContributionAndProof):
+    from ..eth2 import spec
+
+    return spec.SignedContributionAndProof(d.message, d.sig)
+
+
+def _to_spec_reg(d: SignedRegistration):
+    from ..eth2 import spec
+
+    return spec.SignedValidatorRegistration(d.registration, d.sig)
+
+
+def _to_spec_exit(d: SignedExit):
+    from ..eth2 import spec
+
+    return spec.SignedVoluntaryExit(d.exit, d.sig)

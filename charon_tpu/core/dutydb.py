@@ -1,0 +1,179 @@
+"""DutyDB — in-memory store of consensus-agreed unsigned data
+(reference core/dutydb/memory.go).
+
+Acts as the slashing-protection unique index: exactly one unsigned datum per
+duty+validator (memory.go:76-157 dedup checks); conflicting stores error.
+Queries are *blocking awaits* resolved as data arrives (AwaitAttestation:209,
+AwaitBeaconBlock:159, AwaitAggAttestation:238, AwaitSyncContribution:278,
+PubKeyByAttestation:307). Per-duty GC via the Deadliner (memory.go:637).
+"""
+
+from __future__ import annotations
+
+import asyncio
+
+from ..eth2 import spec
+from ..utils import errors, log
+from .deadline import Deadliner
+from .types import Duty, DutyType, PubKey, UnsignedDataSet
+from .unsigneddata import (
+    AggregatedAttestationUnsigned,
+    AttestationDataUnsigned,
+    ProposalUnsigned,
+    SyncContributionUnsigned,
+)
+
+_log = log.with_topic("dutydb")
+
+
+class _AwaitMap:
+    """key -> resolved value, with pending futures for blocking awaits."""
+
+    def __init__(self):
+        self._values: dict = {}
+        self._waiters: dict[object, list[asyncio.Future]] = {}
+
+    def resolve(self, key, value) -> None:
+        self._values[key] = value
+        for fut in self._waiters.pop(key, []):
+            if not fut.done():
+                fut.set_result(value)
+
+    async def await_(self, key):
+        if key in self._values:
+            return self._values[key]
+        fut = asyncio.get_running_loop().create_future()
+        self._waiters.setdefault(key, []).append(fut)
+        return await fut
+
+    def get(self, key):
+        return self._values.get(key)
+
+    def drop(self, pred) -> None:
+        self._values = {k: v for k, v in self._values.items() if not pred(k)}
+        # Waiters for dropped keys stay pending until their duty deadline
+        # cancels the caller (matching the reference's blocking queries).
+
+
+class MemDB:
+    """reference dutydb.NewMemDB (memory.go:20)."""
+
+    def __init__(self, deadliner: Deadliner | None = None):
+        self._att_data = _AwaitMap()        # (slot, commidx) -> AttestationData
+        self._att_pubkeys: dict[tuple, PubKey] = {}  # (slot, commidx, valcommidx)
+        self._att_duties: dict[tuple, spec.AttesterDuty] = {}
+        self._blocks = _AwaitMap()          # slot -> BeaconBlock
+        self._block_pubkeys: dict[int, PubKey] = {}
+        self._agg_atts = _AwaitMap()        # (slot, att_root) -> Attestation
+        self._contribs = _AwaitMap()        # (slot, subcmt, root) -> contribution
+        self._stored: dict[tuple[Duty, PubKey], bytes] = {}  # unique index
+        self._deadliner = deadliner
+        self._gc_task: asyncio.Task | None = None
+
+    async def run_gc(self) -> None:
+        """GC duties as they expire (reference memory.go:637)."""
+        if self._deadliner is None:
+            return
+        async for duty in self._deadliner.expired():
+            self._gc(duty)
+
+    async def store(self, duty: Duty, unsigned: UnsignedDataSet) -> None:
+        """Store agreed unsigned data, resolving blocked queries
+        (reference memory.go:76 Store)."""
+        if self._deadliner is not None and not self._deadliner.add(duty):
+            _log.debug("ignoring expired duty", duty=str(duty))
+            return
+        for pubkey, data in unsigned.items():
+            self._check_unique(duty, pubkey, data)
+            if duty.type == DutyType.ATTESTER and isinstance(data, AttestationDataUnsigned):
+                self._store_attestation(duty, pubkey, data)
+            elif duty.type == DutyType.PROPOSER and isinstance(data, ProposalUnsigned):
+                self._store_block(duty, pubkey, data)
+            elif duty.type == DutyType.AGGREGATOR and isinstance(data, AggregatedAttestationUnsigned):
+                self._agg_atts.resolve((duty.slot, data.att.data.hash_tree_root()),
+                                       data.att)
+            elif duty.type == DutyType.SYNC_CONTRIBUTION and isinstance(data, SyncContributionUnsigned):
+                c = data.contribution
+                self._contribs.resolve(
+                    (duty.slot, c.subcommittee_index, bytes(c.beacon_block_root)), c)
+            else:
+                raise errors.new("unsupported dutydb store",
+                                 duty=str(duty), kind=type(data).__name__)
+
+    def _check_unique(self, duty: Duty, pubkey: PubKey, data) -> None:
+        """One unsigned datum per duty+validator — the slashing-protection
+        unique index (reference memory.go:76-157)."""
+        root = data.hash_root()
+        key = (duty, pubkey)
+        prev = self._stored.get(key)
+        if prev is not None and prev != root:
+            raise errors.new("conflicting unsigned data for duty (slashing protection)",
+                             duty=str(duty), pubkey=pubkey[:10])
+        self._stored[key] = root
+
+    def _store_attestation(self, duty: Duty, pubkey: PubKey,
+                           data: AttestationDataUnsigned) -> None:
+        ad = data.duty
+        att_key = (duty.slot, ad.committee_index)
+        existing = self._att_data.get(att_key)
+        if existing is not None and existing.hash_tree_root() != data.data.hash_tree_root():
+            raise errors.new("conflicting attestation data for committee",
+                             slot=duty.slot, committee=ad.committee_index)
+        self._att_data.resolve(att_key, data.data)
+        self._att_pubkeys[(duty.slot, ad.committee_index,
+                           ad.validator_committee_index)] = pubkey
+        self._att_duties[(duty.slot, ad.committee_index,
+                          ad.validator_committee_index)] = ad
+
+    def _store_block(self, duty: Duty, pubkey: PubKey, data: ProposalUnsigned) -> None:
+        prev_pk = self._block_pubkeys.get(duty.slot)
+        if prev_pk is not None and prev_pk != pubkey:
+            raise errors.new("conflicting block proposer", slot=duty.slot)
+        self._block_pubkeys[duty.slot] = pubkey
+        self._blocks.resolve(duty.slot, data.block)
+
+    # -- blocking queries (ValidatorAPI + Fetcher) --------------------------
+
+    async def await_attestation(self, slot: int, committee_index: int) -> spec.AttestationData:
+        """reference memory.go:209 AwaitAttestation."""
+        return await self._att_data.await_((slot, committee_index))
+
+    async def await_beacon_block(self, slot: int) -> spec.BeaconBlock:
+        """reference memory.go:159 AwaitBeaconBlock."""
+        return await self._blocks.await_(slot)
+
+    async def await_agg_attestation(self, slot: int, att_root: bytes) -> spec.Attestation:
+        """reference memory.go:238 AwaitAggAttestation."""
+        return await self._agg_atts.await_((slot, bytes(att_root)))
+
+    async def await_sync_contribution(self, slot: int, subcommittee_index: int,
+                                      beacon_block_root: bytes) -> spec.SyncCommitteeContribution:
+        """reference memory.go:278 AwaitSyncContribution."""
+        return await self._contribs.await_((slot, subcommittee_index,
+                                            bytes(beacon_block_root)))
+
+    def pubkey_by_attestation(self, slot: int, committee_index: int,
+                              validator_committee_index: int) -> PubKey:
+        """Identify the validator that produced an attestation
+        (reference memory.go:307 PubKeyByAttestation)."""
+        key = (slot, committee_index, validator_committee_index)
+        pubkey = self._att_pubkeys.get(key)
+        if pubkey is None:
+            raise errors.new("unknown attestation", slot=slot,
+                             committee=committee_index,
+                             validator_committee_index=validator_committee_index)
+        return pubkey
+
+    def proposer_pubkey(self, slot: int) -> PubKey | None:
+        return self._block_pubkeys.get(slot)
+
+    def _gc(self, duty: Duty) -> None:
+        slot = duty.slot
+        self._att_data.drop(lambda k: k[0] == slot)
+        self._blocks.drop(lambda k: k == slot)
+        self._agg_atts.drop(lambda k: k[0] == slot)
+        self._contribs.drop(lambda k: k[0] == slot)
+        self._att_pubkeys = {k: v for k, v in self._att_pubkeys.items() if k[0] != slot}
+        self._att_duties = {k: v for k, v in self._att_duties.items() if k[0] != slot}
+        self._block_pubkeys.pop(slot, None)
+        self._stored = {k: v for k, v in self._stored.items() if k[0] != duty}

@@ -1,0 +1,204 @@
+"""Fetcher — stateless fetch of unsigned duty data from the beacon node
+(reference core/fetcher/fetcher.go).
+
+Attestation data comes straight from the BN (fetcher.go:114); aggregate
+attestations need the duty's attestation root (from DutyDB) plus the
+cluster-combined selection proofs (from AggSigDB) (fetcher.go:151); block
+proposals need the aggregated randao reveal from AggSigDB (fetcher.go:223);
+sync contributions need the combined sync selection proofs (fetcher.go:296).
+"""
+
+from __future__ import annotations
+
+from typing import Awaitable, Callable
+
+from ..eth2.beacon import BeaconNode
+from ..utils import errors, log
+from .signeddata import BeaconCommitteeSelection, SignedRandao, SyncCommitteeSelection
+from .types import (
+    Duty,
+    DutyDefinitionSet,
+    DutyType,
+    PubKey,
+    SignedData,
+    UnsignedDataSet,
+)
+from .unsigneddata import (
+    AggregatedAttestationUnsigned,
+    AttestationDataUnsigned,
+    AttesterDefinition,
+    ProposalUnsigned,
+    ProposerDefinition,
+    SyncCommitteeDefinition,
+    SyncContributionUnsigned,
+)
+
+_log = log.with_topic("fetcher")
+
+# AggSigDB blocking await: (duty, pubkey) -> aggregate SignedData.
+AggSigDBAwaitFunc = Callable[[Duty, PubKey], Awaitable[SignedData]]
+# DutyDB attestation await: (slot, committee_index) -> AttestationData.
+AwaitAttFunc = Callable[[int, int], Awaitable[object]]
+
+
+class Fetcher:
+    """reference fetcher.New/Fetch (fetcher.go:47)."""
+
+    def __init__(self, beacon: BeaconNode, graffiti: bytes = b"charon-tpu"):
+        self._beacon = beacon
+        self._graffiti = graffiti
+        self._subs = []
+        self._agg_sig_db_await: AggSigDBAwaitFunc | None = None
+        self._await_att_data: AwaitAttFunc | None = None
+        self._builder_enabled: Callable[[int], bool] = lambda slot: False
+
+    def subscribe(self, fn) -> None:
+        self._subs.append(fn)
+
+    def register_agg_sig_db(self, fn: AggSigDBAwaitFunc) -> None:
+        """reference fetcher.RegisterAggSigDB."""
+        self._agg_sig_db_await = fn
+
+    def register_await_attestation_data(self, fn: AwaitAttFunc) -> None:
+        """reference fetcher.RegisterAwaitAttData (DutyDB query seam)."""
+        self._await_att_data = fn
+
+    def register_builder_enabled(self, fn: Callable[[int], bool]) -> None:
+        self._builder_enabled = fn
+
+    async def fetch(self, duty: Duty, defset: DutyDefinitionSet) -> None:
+        """Fetch unsigned data for the duty and emit to subscribers
+        (reference fetcher.go:47-112 Fetch)."""
+        if duty.type == DutyType.ATTESTER:
+            unsigned = await self._fetch_attester(duty, defset)
+        elif duty.type == DutyType.AGGREGATOR:
+            unsigned = await self._fetch_aggregator(duty, defset)
+        elif duty.type == DutyType.PROPOSER:
+            unsigned = await self._fetch_proposer(duty, defset)
+        elif duty.type == DutyType.SYNC_CONTRIBUTION:
+            unsigned = await self._fetch_sync_contribution(duty, defset)
+        else:
+            raise errors.new("unsupported fetch duty type", duty=str(duty))
+        if not unsigned:
+            return
+        for fn in self._subs:
+            await fn(duty, {k: v.clone() for k, v in unsigned.items()})
+
+    async def _fetch_attester(self, duty: Duty,
+                              defset: DutyDefinitionSet) -> UnsignedDataSet:
+        """One BN attestation-data request per distinct committee; all
+        validators of the slot batch into one set (fetcher.go:114-149)."""
+        by_committee: dict[int, object] = {}
+        unsigned: UnsignedDataSet = {}
+        for pubkey, defn in defset.items():
+            if not isinstance(defn, AttesterDefinition):
+                continue
+            ad = defn.duty
+            if ad.committee_index not in by_committee:
+                by_committee[ad.committee_index] = await self._beacon.attestation_data(
+                    duty.slot, ad.committee_index)
+            unsigned[pubkey] = AttestationDataUnsigned(
+                by_committee[ad.committee_index], ad)
+        return unsigned
+
+    async def _fetch_aggregator(self, duty: Duty,
+                                defset: DutyDefinitionSet) -> UnsignedDataSet:
+        """Aggregate attestations for validators whose combined selection
+        proof makes them aggregators (fetcher.go:151-221): needs the
+        cluster-combined selection proof (AggSigDB, duty PREPARE_AGGREGATOR)
+        and the agreed attestation data root (DutyDB)."""
+        if self._agg_sig_db_await is None or self._await_att_data is None:
+            raise errors.new("fetcher aggsigdb/dutydb not registered")
+        unsigned: UnsignedDataSet = {}
+        for pubkey, defn in defset.items():
+            if not isinstance(defn, AttesterDefinition):
+                continue
+            prep_duty = Duty(duty.slot, DutyType.PREPARE_AGGREGATOR)
+            selection = await self._agg_sig_db_await(prep_duty, pubkey)
+            if not isinstance(selection, BeaconCommitteeSelection):
+                continue
+            if not _is_agg(bytes(selection.sig), defn.duty.committee_length):
+                continue
+            att_data = await self._await_att_data(duty.slot, defn.duty.committee_index)
+            root = att_data.hash_tree_root()
+            agg_att = await self._beacon.aggregate_attestation(duty.slot, root)
+            unsigned[pubkey] = AggregatedAttestationUnsigned(agg_att)
+        return unsigned
+
+    async def _fetch_proposer(self, duty: Duty,
+                              defset: DutyDefinitionSet) -> UnsignedDataSet:
+        """Block proposal: blocks until the cluster's aggregated randao
+        reveal lands in AggSigDB (fetcher.go:223-256)."""
+        if self._agg_sig_db_await is None:
+            raise errors.new("fetcher aggsigdb not registered")
+        unsigned: UnsignedDataSet = {}
+        for pubkey, defn in defset.items():
+            if not isinstance(defn, ProposerDefinition):
+                continue
+            randao_duty = Duty(duty.slot, DutyType.RANDAO)
+            randao = await self._agg_sig_db_await(randao_duty, pubkey)
+            if not isinstance(randao, SignedRandao):
+                raise errors.new("unexpected randao type", duty=str(duty))
+            block = await self._beacon.block_proposal(
+                duty.slot, bytes(randao.sig), self._graffiti,
+                blinded=self._builder_enabled(duty.slot))
+            unsigned[pubkey] = ProposalUnsigned(block)
+        return unsigned
+
+    async def _fetch_sync_contribution(self, duty: Duty,
+                                       defset: DutyDefinitionSet) -> UnsignedDataSet:
+        """Sync contributions for selected sync aggregators (fetcher.go:296)."""
+        if self._agg_sig_db_await is None:
+            raise errors.new("fetcher aggsigdb not registered")
+        unsigned: UnsignedDataSet = {}
+        for pubkey, defn in defset.items():
+            if not isinstance(defn, SyncCommitteeDefinition):
+                continue
+            for subcmt in _subcommittees(defn.duty):
+                prep = Duty(duty.slot, DutyType.PREPARE_SYNC_CONTRIBUTION)
+                selection = await self._agg_sig_db_await(prep, pubkey)
+                if not isinstance(selection, SyncCommitteeSelection):
+                    continue
+                if selection.subcommittee_index != subcmt:
+                    continue
+                if not _is_sync_agg(bytes(selection.sig)):
+                    continue
+                block_root = (await self._beacon.attestation_data(duty.slot, 0)
+                              ).beacon_block_root
+                contrib = await self._beacon.sync_committee_contribution(
+                    duty.slot, subcmt, block_root)
+                unsigned[pubkey] = SyncContributionUnsigned(contrib)
+        return unsigned
+
+
+def _subcommittees(duty) -> list[int]:
+    """Distinct sync subcommittee indices for a validator's sync-committee
+    positions (consensus-spec: position // (SYNC_COMMITTEE_SIZE / SUBNET_COUNT))."""
+    from ..eth2 import spec as eth2spec
+
+    per_subnet = eth2spec.SYNC_COMMITTEE_SIZE // eth2spec.SYNC_COMMITTEE_SUBNET_COUNT
+    return sorted({pos // per_subnet
+                   for pos in duty.validator_sync_committee_indices})
+
+
+def _is_agg(proof: bytes, committee_length: int) -> bool:
+    """consensus-spec is_aggregator: hash(proof) mod max(1, len/16) == 0."""
+    import hashlib
+
+    modulo = max(1, committee_length // 16)
+    h = hashlib.sha256(proof).digest()
+    return int.from_bytes(h[:8], "little") % modulo == 0
+
+
+def _is_sync_agg(proof: bytes) -> bool:
+    """consensus-spec is_sync_committee_aggregator (modulus from spec
+    constants: 512 / 4 / 16 = 8)."""
+    import hashlib
+
+    from ..eth2 import spec as eth2spec
+
+    modulo = max(1, eth2spec.SYNC_COMMITTEE_SIZE
+                 // eth2spec.SYNC_COMMITTEE_SUBNET_COUNT
+                 // eth2spec.TARGET_AGGREGATORS_PER_COMMITTEE)
+    h = hashlib.sha256(proof).digest()
+    return int.from_bytes(h[:8], "little") % modulo == 0

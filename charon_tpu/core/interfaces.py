@@ -1,0 +1,191 @@
+"""Component interfaces and pipeline wiring (reference core/interfaces.go).
+
+`wire()` stitches the 10 core components into the duty event pipeline by
+registering subscriber callbacks (reference core/interfaces.go:308-329), with
+cross-cutting wire options layered on every boundary:
+
+  with_tracing     — wrap each component call in a tracer span
+                     (reference core/tracing.go:52)
+  with_tracking    — report each event + error to the tracker
+                     (reference core/tracking.go:12)
+  with_async_retry — decouple slow steps: run subscriber callbacks as
+                     deadline-bounded retried background tasks
+                     (reference core/retry.go:12)
+"""
+
+from __future__ import annotations
+
+from typing import Any, Awaitable, Callable, Protocol, runtime_checkable
+
+from ..utils import log, retry, tracer
+from .types import (
+    Duty,
+    DutyDefinitionSet,
+    ParSignedData,
+    ParSignedDataSet,
+    PubKey,
+    SignedDataSet,
+    UnsignedDataSet,
+)
+
+_log = log.with_topic("wire")
+
+# Subscriber callback shapes.
+DutiesSub = Callable[[Duty, DutyDefinitionSet], Awaitable[None]]
+UnsignedSub = Callable[[Duty, UnsignedDataSet], Awaitable[None]]
+ParSignedSetSub = Callable[[Duty, ParSignedDataSet], Awaitable[None]]
+ThresholdSub = Callable[[Duty, dict[PubKey, list[ParSignedData]]], Awaitable[None]]
+SignedSetSub = Callable[[Duty, SignedDataSet], Awaitable[None]]
+SlotSub = Callable[[Any], Awaitable[None]]
+
+
+@runtime_checkable
+class Scheduler(Protocol):
+    def subscribe_duties(self, fn: DutiesSub) -> None: ...
+    def subscribe_slots(self, fn: SlotSub) -> None: ...
+    async def run(self) -> None: ...
+
+
+@runtime_checkable
+class Fetcher(Protocol):
+    async def fetch(self, duty: Duty, defset: DutyDefinitionSet) -> None: ...
+    def subscribe(self, fn: UnsignedSub) -> None: ...
+
+
+@runtime_checkable
+class Consensus(Protocol):
+    async def propose(self, duty: Duty, data: UnsignedDataSet) -> None: ...
+    async def participate(self, duty: Duty) -> None: ...
+    def subscribe(self, fn: UnsignedSub) -> None: ...
+
+
+@runtime_checkable
+class DutyDB(Protocol):
+    async def store(self, duty: Duty, unsigned: UnsignedDataSet) -> None: ...
+
+
+@runtime_checkable
+class ValidatorAPI(Protocol):
+    def subscribe(self, fn: ParSignedSetSub) -> None: ...
+
+
+@runtime_checkable
+class ParSigDB(Protocol):
+    async def store_internal(self, duty: Duty, parsigs: ParSignedDataSet) -> None: ...
+    async def store_external(self, duty: Duty, parsigs: ParSignedDataSet) -> None: ...
+    def subscribe_internal(self, fn: ParSignedSetSub) -> None: ...
+    def subscribe_threshold(self, fn: ThresholdSub) -> None: ...
+
+
+@runtime_checkable
+class ParSigEx(Protocol):
+    async def broadcast(self, duty: Duty, parsigs: ParSignedDataSet) -> None: ...
+    def subscribe(self, fn: ParSignedSetSub) -> None: ...
+
+
+@runtime_checkable
+class SigAgg(Protocol):
+    async def aggregate(self, duty: Duty,
+                        parsigs: dict[PubKey, list[ParSignedData]]) -> None: ...
+    def subscribe(self, fn: SignedSetSub) -> None: ...
+
+
+@runtime_checkable
+class AggSigDB(Protocol):
+    async def store(self, duty: Duty, signed: SignedDataSet) -> None: ...
+
+
+@runtime_checkable
+class Broadcaster(Protocol):
+    async def broadcast(self, duty: Duty, signed: SignedDataSet) -> None: ...
+
+
+class WireOption:
+    """Wraps every pipeline boundary call. component = the *target* name."""
+
+    def wrap(self, component: str, fn: Callable[..., Awaitable[None]],
+             ) -> Callable[..., Awaitable[None]]:
+        raise NotImplementedError
+
+
+class WithTracing(WireOption):
+    """Span per component call with the duty's deterministic trace root
+    (reference core/tracing.go:52)."""
+
+    def wrap(self, component, fn):
+        async def traced(duty: Duty, *args):
+            tracer.rooted_ctx(duty.slot, str(duty.type))
+            with tracer.start_span(f"core/{component}", duty=str(duty)):
+                await fn(duty, *args)
+        return traced
+
+
+class WithTracking(WireOption):
+    """Report each boundary event to the tracker (reference core/tracking.go:12)."""
+
+    def __init__(self, tracker):
+        self.tracker = tracker
+
+    def wrap(self, component, fn):
+        async def tracked(duty: Duty, *args):
+            err: BaseException | None = None
+            try:
+                await fn(duty, *args)
+            except Exception as exc:  # noqa: BLE001 — reported then re-raised
+                err = exc
+                raise
+            finally:
+                data = args[0] if args else None
+                await self.tracker.report_event(component, duty, data, err)
+        return tracked
+
+
+class WithAsyncRetry(WireOption):
+    """Run subscriber callbacks as retried background tasks so a slow step
+    never blocks its upstream (reference core/retry.go:12). Errors are logged
+    by the retryer; the boundary call itself returns immediately."""
+
+    def __init__(self, retryer: retry.Retryer):
+        self.retryer = retryer
+
+    def wrap(self, component, fn):
+        async def retried(duty: Duty, *args):
+            self.retryer.spawn(duty, component, lambda: fn(duty, *args))
+        return retried
+
+
+def wire(
+    scheduler: Scheduler,
+    fetcher: Fetcher,
+    consensus: Consensus,
+    dutydb: DutyDB,
+    validatorapi: ValidatorAPI,
+    parsigdb: ParSigDB,
+    parsigex: ParSigEx,
+    sigagg: SigAgg,
+    aggsigdb: AggSigDB,
+    bcast: Broadcaster,
+    options: list[WireOption] | None = None,
+) -> None:
+    """Stitch the pipeline (reference core/interfaces.go:308-329):
+
+    scheduler → fetcher → consensus → dutydb ⇄ validatorapi → parsigdb ⇄ parsigex
+                                              → parsigdb —(threshold)→ sigagg
+                                              sigagg → aggsigdb + bcast
+    """
+    options = options or []
+
+    def wrapped(component: str, fn):
+        for opt in reversed(options):
+            fn = opt.wrap(component, fn)
+        return fn
+
+    scheduler.subscribe_duties(wrapped("fetcher", fetcher.fetch))
+    fetcher.subscribe(wrapped("consensus", consensus.propose))
+    consensus.subscribe(wrapped("dutydb", dutydb.store))
+    validatorapi.subscribe(wrapped("parsigdb_internal", parsigdb.store_internal))
+    parsigdb.subscribe_internal(wrapped("parsigex", parsigex.broadcast))
+    parsigex.subscribe(wrapped("parsigdb_external", parsigdb.store_external))
+    parsigdb.subscribe_threshold(wrapped("sigagg", sigagg.aggregate))
+    sigagg.subscribe(wrapped("aggsigdb", aggsigdb.store))
+    sigagg.subscribe(wrapped("bcast", bcast.broadcast))

@@ -1,0 +1,81 @@
+"""Cluster key-share topology — who holds which share of which DV.
+
+Derived from the cluster lock (reference builds these maps in app wiring,
+app/app.go:339-383): for each distributed validator, the DV root public key
+plus the n share public keys (1-indexed by operator), and this node's own
+share index and secrets (secrets only in test/vmock contexts).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from .. import tbls
+from ..utils import errors
+from .types import PubKey, pubkey_from_bytes
+
+
+@dataclass
+class KeyShares:
+    """Share topology for one node of the cluster."""
+
+    my_share_idx: int                                  # 1-indexed operator idx
+    threshold: int
+    # DV root pubkey -> share_idx -> share public key.
+    share_pubkeys: dict[PubKey, dict[int, tbls.PublicKey]] = field(default_factory=dict)
+    # This node's share secrets (held by its VC; present in vmock/test setups).
+    my_share_secrets: dict[PubKey, tbls.PrivateKey] = field(default_factory=dict)
+
+    @property
+    def root_pubkeys(self) -> list[PubKey]:
+        return list(self.share_pubkeys)
+
+    @property
+    def num_shares(self) -> int:
+        if not self.share_pubkeys:
+            return 0
+        return len(next(iter(self.share_pubkeys.values())))
+
+    def my_share_pubkey(self, root: PubKey) -> tbls.PublicKey:
+        return self.share_pubkey(root, self.my_share_idx)
+
+    def share_pubkey(self, root: PubKey, share_idx: int) -> tbls.PublicKey:
+        shares = self.share_pubkeys.get(root)
+        if shares is None or share_idx not in shares:
+            raise errors.new("unknown share", pubkey=root[:10], share_idx=share_idx)
+        return shares[share_idx]
+
+    def root_by_share_pubkey(self, share_pk: bytes) -> PubKey:
+        """Map a VC's share pubkey back to the DV root
+        (reference validatorapi.go:978-1005 pubkey mapping)."""
+        share_pk = bytes(share_pk)
+        for root, shares in self.share_pubkeys.items():
+            if bytes(shares[self.my_share_idx]) == share_pk:
+                return root
+        raise errors.new("unknown share pubkey", share=share_pk[:8].hex())
+
+
+def new_cluster_for_t(num_validators: int, threshold: int, num_nodes: int,
+                      ) -> tuple[list[tbls.PrivateKey], list[KeyShares]]:
+    """Test helper (reference cluster.NewForT): generates DV root keys, splits
+    them, and returns per-node KeyShares views. Returns (root_secrets, nodes)."""
+    root_secrets: list[tbls.PrivateKey] = []
+    share_pubkeys: dict[PubKey, dict[int, tbls.PublicKey]] = {}
+    share_secrets: dict[PubKey, dict[int, tbls.PrivateKey]] = {}
+    for _ in range(num_validators):
+        secret = tbls.generate_secret_key()
+        root_pk = pubkey_from_bytes(tbls.secret_to_public_key(secret))
+        shares = tbls.threshold_split(secret, num_nodes, threshold)
+        root_secrets.append(secret)
+        share_pubkeys[root_pk] = {
+            i: tbls.secret_to_public_key(s) for i, s in shares.items()}
+        share_secrets[root_pk] = shares
+    nodes = []
+    for node_idx in range(1, num_nodes + 1):
+        nodes.append(KeyShares(
+            my_share_idx=node_idx,
+            threshold=threshold,
+            share_pubkeys={r: dict(s) for r, s in share_pubkeys.items()},
+            my_share_secrets={r: share_secrets[r][node_idx] for r in share_pubkeys},
+        ))
+    return root_secrets, nodes

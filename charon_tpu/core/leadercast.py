@@ -1,0 +1,74 @@
+"""Leadercast — deterministic-leader consensus (reference core/leadercast).
+
+The reference's bootstrap/test consensus: the deterministic leader for a duty
+broadcasts its proposal and everyone accepts it (leadercast.go:18,86,109). Not
+byzantine-fault tolerant — QBFT (core/qbft.py) is the production protocol; the
+wiring seam (`Consensus` protocol) is identical so they swap freely.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Callable
+
+from ..utils import log
+from .types import Duty, UnsignedDataSet, clone_set
+
+_log = log.with_topic("lcast")
+
+
+def resolve_leader(duty: Duty, num_nodes: int) -> int:
+    """Deterministic leader index for a duty (reference leadercast.go:109)."""
+    return (duty.slot + int(duty.type)) % num_nodes
+
+
+class LeaderCast:
+    """reference leadercast.New (leadercast.go:18)."""
+
+    def __init__(self, transport, peer_idx: int, num_nodes: int):
+        self._transport = transport
+        self._peer_idx = peer_idx
+        self._num_nodes = num_nodes
+        self._subs = []
+        transport.register(peer_idx, self._handle)
+
+    def subscribe(self, fn) -> None:
+        self._subs.append(fn)
+
+    async def propose(self, duty: Duty, data: UnsignedDataSet) -> None:
+        """If we lead this duty, broadcast our value; else wait for the
+        leader's (reference leadercast.go:86 Propose)."""
+        if resolve_leader(duty, self._num_nodes) != self._peer_idx:
+            return  # non-leaders simply wait for the leader's broadcast
+        await self._transport.broadcast(self._peer_idx, duty, data)
+        await self._deliver(duty, data)
+
+    async def participate(self, duty: Duty) -> None:
+        """Leadercast has no eager participation phase."""
+
+    async def _handle(self, duty: Duty, data: UnsignedDataSet) -> None:
+        if resolve_leader(duty, self._num_nodes) == self._peer_idx:
+            return  # our own broadcast already delivered locally
+        await self._deliver(duty, data)
+
+    async def _deliver(self, duty: Duty, data: UnsignedDataSet) -> None:
+        _log.debug("leadercast decided", duty=str(duty),
+                   leader=resolve_leader(duty, self._num_nodes))
+        for fn in self._subs:
+            await fn(duty, clone_set(data))
+
+
+class MemTransport:
+    """In-memory leadercast fabric (reference core/leadercast/transport.go)."""
+
+    def __init__(self):
+        self._handlers: dict[int, Callable] = {}
+
+    def register(self, peer_idx: int, handler) -> None:
+        self._handlers[peer_idx] = handler
+
+    async def broadcast(self, from_idx: int, duty: Duty,
+                        data: UnsignedDataSet) -> None:
+        await asyncio.gather(*(
+            handler(duty, clone_set(data))
+            for idx, handler in list(self._handlers.items()) if idx != from_idx))

@@ -1,0 +1,120 @@
+"""ParSigDB — in-memory partial-signature store (reference core/parsigdb/memory.go).
+
+StoreInternal (from the local VC) fans out to internal subscribers — the
+ParSigEx broadcast (memory.go:57-77). StoreExternal (from peers) dedups by
+share index, errors on equivocation (same share, different sig, memory.go:145-
+177), and when exactly `threshold` partials with a *matching message root*
+exist for a duty+validator, fires the threshold subscribers → SigAgg
+(memory.go:100-122, getThresholdMatching:198). Trimmed by the Deadliner.
+"""
+
+from __future__ import annotations
+
+from collections import defaultdict
+
+from ..utils import errors, log, metrics
+from .deadline import Deadliner
+from .types import Duty, ParSignedData, ParSignedDataSet, PubKey
+
+_log = log.with_topic("parsigdb")
+
+_store_counter = metrics.counter(
+    "core_parsigdb_store_total", "Partial signatures stored", ("source",))
+
+
+class MemDB:
+    """reference parsigdb.NewMemDB (memory.go:18)."""
+
+    def __init__(self, threshold: int, deadliner: Deadliner | None = None):
+        self._threshold = threshold
+        self._deadliner = deadliner
+        # (duty, pubkey) -> share_idx -> ParSignedData
+        self._sigs: dict[tuple[Duty, PubKey], dict[int, ParSignedData]] = defaultdict(dict)
+        self._fired: set[tuple[Duty, PubKey]] = set()
+        self._internal_subs = []
+        self._threshold_subs = []
+
+    def subscribe_internal(self, fn) -> None:
+        self._internal_subs.append(fn)
+
+    def subscribe_threshold(self, fn) -> None:
+        self._threshold_subs.append(fn)
+
+    async def run_trim(self) -> None:
+        """GC expired duties (reference memory.go:127 Trim)."""
+        if self._deadliner is None:
+            return
+        async for duty in self._deadliner.expired():
+            for key in [k for k in self._sigs if k[0] == duty]:
+                del self._sigs[key]
+            self._fired = {k for k in self._fired if k[0] != duty}
+
+    async def store_internal(self, duty: Duty, parsigs: ParSignedDataSet) -> None:
+        """Store our own VC's partials and fan out to internal subscribers
+        (ParSigEx broadcast; reference memory.go:57-77)."""
+        _store_counter.inc("internal", amount=len(parsigs))
+        threshold_hits = await self._store(duty, parsigs)
+        for fn in self._internal_subs:
+            await fn(duty, {k: v.clone() for k, v in parsigs.items()})
+        await self._fire_threshold(duty, threshold_hits)
+
+    async def store_external(self, duty: Duty, parsigs: ParSignedDataSet) -> None:
+        """Store peer partials (already verified by ParSigEx;
+        reference memory.go:80-122 StoreExternal)."""
+        _store_counter.inc("external", amount=len(parsigs))
+        threshold_hits = await self._store(duty, parsigs)
+        await self._fire_threshold(duty, threshold_hits)
+
+    async def _store(self, duty: Duty,
+                     parsigs: ParSignedDataSet) -> dict[PubKey, list[ParSignedData]]:
+        if self._deadliner is not None and not self._deadliner.add(duty):
+            _log.debug("dropping expired duty partials", duty=str(duty))
+            return {}
+        hits: dict[PubKey, list[ParSignedData]] = {}
+        equivocation: BaseException | None = None
+        for pubkey, psd in parsigs.items():
+            key = (duty, pubkey)
+            existing = self._sigs[key].get(psd.share_idx)
+            if existing is not None:
+                if bytes(existing.signature()) != bytes(psd.signature()):
+                    # Equivocation: same share signed two different things
+                    # (reference memory.go:145-177). Record it but keep
+                    # processing the rest of the batch — one faulty peer must
+                    # not suppress other validators' threshold hits.
+                    equivocation = errors.new("equivocating partial signature",
+                                              duty=str(duty),
+                                              share_idx=psd.share_idx)
+                continue  # duplicate, drop
+            self._sigs[key][psd.share_idx] = psd.clone()
+            if key in self._fired:
+                continue
+            matching = self._threshold_matching(key)
+            # Fire exactly once per duty+validator, when the matching-root
+            # group reaches threshold (reference memory.go:100-122).
+            if len(matching) >= self._threshold:
+                self._fired.add(key)
+                hits[pubkey] = matching[: self._threshold]
+        if equivocation is not None:
+            _log.warn("equivocating partial in batch", err=equivocation,
+                      duty=str(duty))
+        return hits
+
+    def _threshold_matching(self, key) -> list[ParSignedData]:
+        """Largest group of partials with identical message roots
+        (reference getThresholdMatching memory.go:198)."""
+        groups: dict[bytes, list[ParSignedData]] = defaultdict(list)
+        for psd in self._sigs[key].values():
+            groups[psd.message_root()].append(psd)
+        if not groups:
+            return []
+        best = max(groups.values(), key=len)
+        return best
+
+    async def _fire_threshold(self, duty: Duty,
+                              hits: dict[PubKey, list[ParSignedData]]) -> None:
+        if not hits:
+            return
+        _log.debug("threshold reached", duty=str(duty), pubkeys=len(hits))
+        payload = {pk: [p.clone() for p in sigs] for pk, sigs in hits.items()}
+        for fn in self._threshold_subs:
+            await fn(duty, payload)

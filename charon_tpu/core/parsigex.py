@@ -1,0 +1,138 @@
+"""ParSigEx — partial-signature exchange between peers
+(reference core/parsigex/parsigex.go).
+
+Direct n² broadcast to all peers — latency over bandwidth
+(docs/architecture.md:544-549). Inbound partials pass the duty gater then
+**every partial signature is verified** against its share public key before
+acceptance (parsigex.go:61-102) — the bulk-verification hot path the TPU
+backend batches (north-star parsigex config: 500 DVs mixed duties).
+
+MemTransport here is the in-memory test fabric (reference
+parsigex/memory.go); the TCP fabric lives in charon_tpu.p2p.
+"""
+
+from __future__ import annotations
+
+from typing import Awaitable, Callable
+
+from .. import tbls
+from ..eth2.spec import ChainSpec
+from ..utils import errors, log, metrics
+from .gater import DutyGaterFunc
+from .keyshares import KeyShares
+from .signeddata import _Eth2Signed
+from .types import Duty, ParSignedData, ParSignedDataSet, PubKey
+
+_log = log.with_topic("parsigex")
+
+_recv_counter = metrics.counter(
+    "core_parsigex_received_total", "Partials received from peers", ("verified",))
+
+VerifyFunc = Callable[[Duty, PubKey, ParSignedData], Awaitable[None]]
+
+
+def new_eth2_verifier(chain: ChainSpec, keys: KeyShares) -> VerifyFunc:
+    """Verify a peer's partial sig against that share's public key
+    (reference parsigex.go:139 NewEth2Verifier)."""
+
+    async def verify(duty: Duty, pubkey: PubKey, psd: ParSignedData) -> None:
+        data = psd.data
+        if not isinstance(data, _Eth2Signed):
+            raise errors.new("unverifiable partial data type",
+                             kind=type(data).__name__)
+        share_pk = keys.share_pubkey(pubkey, psd.share_idx)
+        if not data.verify(chain, share_pk):
+            raise errors.new("invalid partial signature", duty=str(duty),
+                             pubkey=pubkey[:10], share_idx=psd.share_idx)
+
+    return verify
+
+
+def new_batch_eth2_verifier(chain: ChainSpec, keys: KeyShares):
+    """Batched variant: verify a whole inbound set in one tbls.verify_batch
+    call (the TPU fast path); falls back to per-sig verify to identify
+    culprits on failure (north-star parsigex batching)."""
+
+    async def verify_set(duty: Duty, parsigs: ParSignedDataSet) -> None:
+        pks: list[tbls.PublicKey] = []
+        roots: list[bytes] = []
+        sigs: list[tbls.Signature] = []
+        for pubkey, psd in parsigs.items():
+            data = psd.data
+            if not isinstance(data, _Eth2Signed):
+                raise errors.new("unverifiable partial data type",
+                                 kind=type(data).__name__)
+            pks.append(keys.share_pubkey(pubkey, psd.share_idx))
+            roots.append(data.signing_root(chain))
+            sigs.append(psd.signature())
+        if tbls.verify_batch(pks, roots, sigs):
+            return
+        # Batch failed: identify culprit(s) individually.
+        for (pubkey, psd), pk, root, sig in zip(parsigs.items(), pks, roots, sigs):
+            if not tbls.verify(pk, root, sig):
+                raise errors.new("invalid partial signature", duty=str(duty),
+                                 pubkey=pubkey[:10], share_idx=psd.share_idx)
+
+    return verify_set
+
+
+class ParSigEx:
+    """Peer partial-sig exchange over a pluggable transport
+    (reference parsigex.go:105 Broadcast, :61 handle)."""
+
+    def __init__(self, transport, peer_idx: int, gater: DutyGaterFunc,
+                 verify_set=None):
+        self._transport = transport
+        self._peer_idx = peer_idx
+        self._gater = gater
+        self._verify_set = verify_set
+        self._subs = []
+        transport.register(peer_idx, self._handle)
+
+    def subscribe(self, fn) -> None:
+        self._subs.append(fn)
+
+    async def broadcast(self, duty: Duty, parsigs: ParSignedDataSet) -> None:
+        """Send our partials to every peer directly (parsigex.go:105-130)."""
+        await self._transport.broadcast(self._peer_idx, duty, parsigs)
+
+    async def _handle(self, duty: Duty, parsigs: ParSignedDataSet) -> None:
+        """Inbound from a peer: gate, verify every partial, then hand to
+        subscribers (ParSigDB.StoreExternal) (parsigex.go:61-102)."""
+        if not self._gater(duty):
+            _recv_counter.inc("gated", amount=len(parsigs))
+            _log.warn("dropping gated duty from peer", duty=str(duty))
+            return
+        if self._verify_set is not None:
+            try:
+                await self._verify_set(duty, parsigs)
+            except Exception as exc:  # noqa: BLE001 — invalid peer data dropped
+                _recv_counter.inc("invalid", amount=len(parsigs))
+                _log.warn("dropping invalid peer partials", err=exc, duty=str(duty))
+                return
+        _recv_counter.inc("ok", amount=len(parsigs))
+        for fn in self._subs:
+            await fn(duty, {k: v.clone() for k, v in parsigs.items()})
+
+
+class MemTransport:
+    """In-memory n-node fabric for tests (reference core/parsigex/memory.go
+    NewMemTransport): broadcast delivers to every *other* registered node."""
+
+    def __init__(self):
+        self._handlers: dict[int, Callable] = {}
+
+    def register(self, peer_idx: int, handler) -> None:
+        self._handlers[peer_idx] = handler
+
+    async def broadcast(self, from_idx: int, duty: Duty,
+                        parsigs: ParSignedDataSet) -> None:
+        # Fire-and-forget like the reference's SendAsync (p2p/sender.go:107):
+        # the sender never blocks on peers' verification work.
+        import asyncio
+
+        for idx, handler in list(self._handlers.items()):
+            if idx == from_idx:
+                continue
+            asyncio.create_task(
+                handler(duty, {k: v.clone() for k, v in parsigs.items()}))

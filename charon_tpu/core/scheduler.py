@@ -1,0 +1,211 @@
+"""Scheduler — slot ticker + per-epoch duty resolution
+(reference core/scheduler/scheduler.go).
+
+Waits for chain start and BN sync (scheduler.go:101-102,649,674), ticks slots
+(newSlotTicker:541), resolves attester/proposer/sync-committee duties from the
+BN at epoch boundaries (resolveDuties:248), emits duty-definition sets to
+subscribers at each duty's slot (with per-type intra-slot offsets, offset.go),
+and trims state after TRIM_EPOCH_OFFSET epochs (scheduler.go:24).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from dataclasses import dataclass
+from typing import Callable
+
+from ..eth2.beacon import BeaconNode, ValidatorCache
+from ..utils import log, metrics
+from .types import (
+    Duty,
+    DutyDefinitionSet,
+    DutyType,
+    PubKey,
+    pubkey_from_bytes,
+)
+from .unsigneddata import (
+    AttesterDefinition,
+    ProposerDefinition,
+    SyncCommitteeDefinition,
+)
+
+_log = log.with_topic("sched")
+
+TRIM_EPOCH_OFFSET = 3
+
+_duty_counter = metrics.counter(
+    "core_scheduler_duty_total", "Duties scheduled by type", ("duty",))
+
+# Fraction of the slot to wait before emitting each duty type
+# (reference core/scheduler/offset.go): attestation data is fetched early,
+# aggregations need 2/3 slot so attestations exist to aggregate.
+_SLOT_OFFSETS: dict[DutyType, float] = {
+    DutyType.PROPOSER: 0.0,
+    DutyType.ATTESTER: 0.0,
+    DutyType.SYNC_MESSAGE: 0.0,
+    DutyType.AGGREGATOR: 2 / 3,
+    DutyType.SYNC_CONTRIBUTION: 2 / 3,
+}
+
+
+@dataclass(frozen=True)
+class Slot:
+    """A slot tick (reference core/scheduler.go Slot)."""
+
+    slot: int
+    time: float
+    slots_per_epoch: int
+
+    @property
+    def epoch(self) -> int:
+        return self.slot // self.slots_per_epoch
+
+    @property
+    def first_in_epoch(self) -> bool:
+        return self.slot % self.slots_per_epoch == 0
+
+
+class Scheduler:
+    """Resolves and emits duties (reference scheduler.go:96 Run)."""
+
+    def __init__(self, beacon: BeaconNode, valcache: ValidatorCache,
+                 clock: Callable[[], float] = time.time,
+                 delay_startup_epoch: bool = False):
+        self._beacon = beacon
+        self._valcache = valcache
+        self._clock = clock
+        self._duty_subs: list = []
+        self._slot_subs: list = []
+        self._duties: dict[Duty, DutyDefinitionSet] = {}
+        self._resolved_epochs: set[int] = set()
+        self._slots_per_epoch = 32  # replaced by the chain spec in run()
+        self._stop = asyncio.Event()
+        self._delay_startup_epoch = delay_startup_epoch
+
+    def subscribe_duties(self, fn) -> None:
+        self._duty_subs.append(fn)
+
+    def subscribe_slots(self, fn) -> None:
+        self._slot_subs.append(fn)
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    def get_duty_definition(self, duty: Duty) -> DutyDefinitionSet | None:
+        """Resolved definitions for a duty (reference scheduler.go
+        GetDutyDefinition, used by the consensus participate path)."""
+        return self._duties.get(duty)
+
+    async def run(self) -> None:
+        """Tick slots until stopped (reference scheduler.go:96-120)."""
+        spec = await self._beacon.spec()
+        self._slots_per_epoch = spec.slots_per_epoch
+
+        # Wait for chain start (scheduler.go:649 waitChainStart).
+        while (now := self._clock()) < spec.genesis_time:
+            await asyncio.sleep(min(spec.genesis_time - now, 1.0))
+        # Wait for beacon node sync (scheduler.go:674 waitBeaconSync).
+        while await self._beacon.node_syncing():
+            _log.info("beacon node syncing; waiting")
+            await asyncio.sleep(spec.seconds_per_slot)
+
+        while not self._stop.is_set():
+            slot_num = spec.slot_at(self._clock())
+            slot = Slot(slot_num, spec.slot_start_time(slot_num),
+                        spec.slots_per_epoch)
+
+            await self._resolve_epoch_duties(slot.epoch)
+            # Resolve the next epoch ahead of time too (resolveDuties:248
+            # schedules current + next epoch).
+            await self._resolve_epoch_duties(slot.epoch + 1)
+
+            # Slot subscribers (vmock, infosync, recaster) may block on
+            # pipeline results — run them as tasks, never in the tick loop.
+            for fn in self._slot_subs:
+                asyncio.create_task(self._emit_safe(fn, slot))
+
+            await self._emit_slot_duties(spec, slot)
+            self._trim(slot.epoch)
+
+            next_start = spec.slot_start_time(slot_num + 1)
+            delay = next_start - self._clock()
+            if delay > 0:
+                try:
+                    await asyncio.wait_for(self._stop.wait(), timeout=delay)
+                except asyncio.TimeoutError:
+                    pass
+
+    async def _emit_slot_duties(self, spec, slot: Slot) -> None:
+        """Emit this slot's duties ordered by intra-slot offset."""
+        pending: list[tuple[float, Duty, DutyDefinitionSet]] = []
+        for dtype, frac in _SLOT_OFFSETS.items():
+            duty = Duty(slot.slot, dtype)
+            defset = self._duties.get(duty)
+            if defset:
+                pending.append((slot.time + frac * spec.seconds_per_slot,
+                                duty, defset))
+        for at, duty, defset in sorted(pending, key=lambda p: p[0]):
+            delay = at - self._clock()
+            if delay > 0:
+                await asyncio.sleep(delay)
+            _duty_counter.inc(str(duty.type))
+            _log.debug("emitting duty", duty=str(duty), validators=len(defset))
+            for fn in self._duty_subs:
+                await self._emit_safe(fn, duty, dict(defset))
+
+    async def _resolve_epoch_duties(self, epoch: int) -> None:
+        """Resolve all duty definitions for an epoch from the BN
+        (reference resolveDuties:248, resolveAttDuties:285,
+        resolveProDuties:359, resolveSyncCommDuties:412)."""
+        if epoch in self._resolved_epochs:
+            return
+        idx_to_pk = await self._valcache.active_indices(epoch)
+        if not idx_to_pk:
+            return
+        indices = sorted(idx_to_pk)
+
+        for duty_obj in await self._beacon.attester_duties(epoch, indices):
+            duty = Duty(duty_obj.slot, DutyType.ATTESTER)
+            pk: PubKey = pubkey_from_bytes(duty_obj.pubkey)
+            self._duties.setdefault(duty, {})[pk] = AttesterDefinition(duty_obj)
+            # Aggregation duty shares the attester definition
+            # (scheduler resolves both from the same response).
+            agg_duty = Duty(duty_obj.slot, DutyType.AGGREGATOR)
+            self._duties.setdefault(agg_duty, {})[pk] = AttesterDefinition(duty_obj)
+
+        for duty_obj in await self._beacon.proposer_duties(epoch, indices):
+            duty = Duty(duty_obj.slot, DutyType.PROPOSER)
+            pk = pubkey_from_bytes(duty_obj.pubkey)
+            self._duties.setdefault(duty, {})[pk] = ProposerDefinition(duty_obj)
+
+        for duty_obj in await self._beacon.sync_committee_duties(epoch, indices):
+            # Sync messages are due every slot of the epoch.
+            pk = pubkey_from_bytes(duty_obj.pubkey)
+            spec = await self._beacon.spec()
+            for s in range(epoch * spec.slots_per_epoch,
+                           (epoch + 1) * spec.slots_per_epoch):
+                duty = Duty(s, DutyType.SYNC_MESSAGE)
+                self._duties.setdefault(duty, {})[pk] = SyncCommitteeDefinition(duty_obj)
+
+        self._resolved_epochs.add(epoch)
+        spec = await self._beacon.spec()
+        _log.debug("resolved epoch duties", epoch=epoch,
+                   duties=sum(1 for d in self._duties
+                              if d.slot // spec.slots_per_epoch == epoch))
+
+    def _trim(self, current_epoch: int) -> None:
+        """Drop duties older than TRIM_EPOCH_OFFSET epochs (scheduler.go:24)."""
+        cutoff = current_epoch - TRIM_EPOCH_OFFSET
+        if cutoff < 0:
+            return
+        self._duties = {d: s for d, s in self._duties.items()
+                        if d.slot >= cutoff * self._slots_per_epoch}
+        self._resolved_epochs = {e for e in self._resolved_epochs if e >= cutoff}
+
+    @staticmethod
+    async def _emit_safe(fn, *args) -> None:
+        try:
+            await fn(*args)
+        except Exception as exc:  # noqa: BLE001 — subscriber errors are logged
+            _log.error("duty subscriber failed", err=exc)

@@ -1,0 +1,92 @@
+"""SigAgg — stateless threshold aggregation (reference core/sigagg/sigagg.go).
+
+Per validator: Lagrange-combine `threshold` matching partials into the root
+signature (sigagg.go:89-151, tbls.ThresholdAggregate at :144), inject it into
+the SignedData, then verify the aggregate against the DV root public key
+(sigagg.go:159, NewVerifier:167). All validators of the duty aggregate in ONE
+batched tbls call (threshold_aggregate_batch) and verify in one verify_batch —
+the primary TPU dispatch of the whole pipeline (north-star sigagg config:
+100-1000 validators per slot batch).
+"""
+
+from __future__ import annotations
+
+from .. import tbls
+from ..eth2.spec import ChainSpec
+from ..utils import errors, log, metrics, tracer
+from .keyshares import KeyShares
+from .signeddata import _Eth2Signed
+from .types import Duty, ParSignedData, PubKey, SignedDataSet, pubkey_to_bytes
+
+_log = log.with_topic("sigagg")
+
+_agg_hist = metrics.histogram(
+    "core_sigagg_duration_seconds", "Threshold aggregation latency", ("duty",))
+
+
+class SigAgg:
+    """reference sigagg.New / Aggregate (sigagg.go:48)."""
+
+    def __init__(self, keys: KeyShares, chain: ChainSpec, verify: bool = True):
+        self._keys = keys
+        self._chain = chain
+        self._verify = verify
+        self._subs = []
+
+    def subscribe(self, fn) -> None:
+        self._subs.append(fn)
+
+    async def aggregate(self, duty: Duty,
+                        parsigs: dict[PubKey, list[ParSignedData]]) -> None:
+        """Aggregate threshold partials for all validators of the duty in one
+        batched device call, verify, and emit the SignedDataSet."""
+        if not parsigs:
+            return
+        threshold = self._keys.threshold
+
+        batches: list[dict[int, tbls.Signature]] = []
+        pubkeys: list[PubKey] = []
+        templates: list[ParSignedData] = []
+        for pubkey, sigs in parsigs.items():
+            if len(sigs) < threshold:
+                raise errors.new("insufficient partial signatures",
+                                 duty=str(duty), got=len(sigs), need=threshold)
+            chosen = sorted(sigs, key=lambda p: p.share_idx)[:threshold]
+            batches.append({p.share_idx: p.signature() for p in chosen})
+            pubkeys.append(pubkey)
+            templates.append(chosen[0])
+
+        with _agg_hist.time(str(duty.type)), \
+                tracer.start_span("sigagg/aggregate", duty=str(duty),
+                                  batch=len(batches)):
+            agg_sigs = tbls.threshold_aggregate_batch(batches)
+
+        signed: SignedDataSet = {}
+        verify_pks: list[tbls.PublicKey] = []
+        verify_roots: list[bytes] = []
+        for pubkey, template, agg in zip(pubkeys, templates, agg_sigs):
+            data = template.data.set_signature(agg)
+            signed[pubkey] = data
+            if self._verify and isinstance(data, _Eth2Signed):
+                verify_pks.append(pubkey_to_bytes(pubkey))
+                verify_roots.append(data.signing_root(self._chain))
+
+        if verify_pks:
+            ok = tbls.verify_batch(
+                verify_pks, verify_roots,
+                [signed[pk].signature() for pk in pubkeys
+                 if isinstance(signed[pk], _Eth2Signed)])
+            if not ok:
+                # Identify the failing aggregate individually.
+                for pubkey in pubkeys:
+                    data = signed[pubkey]
+                    if isinstance(data, _Eth2Signed) and not data.verify(
+                            self._chain, pubkey_to_bytes(pubkey)):
+                        raise errors.new("aggregate signature verification failed",
+                                         duty=str(duty), pubkey=pubkey[:10])
+                raise errors.new("batch aggregate verification failed", duty=str(duty))
+
+        _log.debug("aggregated threshold signatures", duty=str(duty),
+                   validators=len(signed))
+        for fn in self._subs:
+            await fn(duty, {k: v.clone() for k, v in signed.items()})

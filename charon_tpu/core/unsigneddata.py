@@ -1,0 +1,234 @@
+"""UnsignedData and DutyDefinition implementations (reference
+core/unsigneddata.go, core/dutydef.go).
+
+Unsigned values expose hash_root() — a deterministic content hash used as the
+consensus value identity (the reference hashes marshalled protobufs,
+core/consensus/component.go:311-318; here it is the SSZ object root).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass
+
+from ..eth2 import spec
+from .types import hx, register_definition, register_unsigned, unhx
+
+
+# ---------------------------------------------------------------------------
+# Duty definitions (what the scheduler resolves per validator)
+# ---------------------------------------------------------------------------
+
+
+@register_definition("attester")
+@dataclass(frozen=True)
+class AttesterDefinition:
+    """Attester duty definition (reference core/dutydef.go NewAttesterDefinition)."""
+
+    duty: spec.AttesterDuty
+
+    def clone(self) -> "AttesterDefinition":
+        return AttesterDefinition(dataclasses.replace(self.duty))
+
+    def to_json(self) -> dict:
+        d = self.duty
+        return {"pubkey": hx(d.pubkey), "slot": d.slot,
+                "validator_index": d.validator_index,
+                "committee_index": d.committee_index,
+                "committee_length": d.committee_length,
+                "committees_at_slot": d.committees_at_slot,
+                "validator_committee_index": d.validator_committee_index}
+
+    @staticmethod
+    def from_json(obj: dict) -> "AttesterDefinition":
+        return AttesterDefinition(spec.AttesterDuty(
+            pubkey=unhx(obj["pubkey"]), slot=int(obj["slot"]),
+            validator_index=int(obj["validator_index"]),
+            committee_index=int(obj["committee_index"]),
+            committee_length=int(obj["committee_length"]),
+            committees_at_slot=int(obj["committees_at_slot"]),
+            validator_committee_index=int(obj["validator_committee_index"])))
+
+
+@register_definition("proposer")
+@dataclass(frozen=True)
+class ProposerDefinition:
+    duty: spec.ProposerDuty
+
+    def clone(self) -> "ProposerDefinition":
+        return ProposerDefinition(dataclasses.replace(self.duty))
+
+    def to_json(self) -> dict:
+        d = self.duty
+        return {"pubkey": hx(d.pubkey), "slot": d.slot,
+                "validator_index": d.validator_index}
+
+    @staticmethod
+    def from_json(obj: dict) -> "ProposerDefinition":
+        return ProposerDefinition(spec.ProposerDuty(
+            pubkey=unhx(obj["pubkey"]), slot=int(obj["slot"]),
+            validator_index=int(obj["validator_index"])))
+
+
+@register_definition("sync_committee")
+@dataclass(frozen=True)
+class SyncCommitteeDefinition:
+    duty: spec.SyncCommitteeDuty
+
+    def clone(self) -> "SyncCommitteeDefinition":
+        return SyncCommitteeDefinition(dataclasses.replace(
+            self.duty, validator_sync_committee_indices=list(
+                self.duty.validator_sync_committee_indices)))
+
+    def to_json(self) -> dict:
+        d = self.duty
+        return {"pubkey": hx(d.pubkey), "validator_index": d.validator_index,
+                "validator_sync_committee_indices":
+                    list(d.validator_sync_committee_indices)}
+
+    @staticmethod
+    def from_json(obj: dict) -> "SyncCommitteeDefinition":
+        return SyncCommitteeDefinition(spec.SyncCommitteeDuty(
+            pubkey=unhx(obj["pubkey"]),
+            validator_index=int(obj["validator_index"]),
+            validator_sync_committee_indices=[
+                int(i) for i in obj["validator_sync_committee_indices"]]))
+
+
+# ---------------------------------------------------------------------------
+# Unsigned data
+# ---------------------------------------------------------------------------
+
+
+@register_unsigned("attestation_data")
+@dataclass(frozen=True)
+class AttestationDataUnsigned:
+    """Attestation data to sign + the resolving duty (reference
+    core/unsigneddata.go AttestationData: data and duty travel together so
+    ValidatorAPI can serve committee info)."""
+
+    data: spec.AttestationData
+    duty: spec.AttesterDuty
+
+    def clone(self) -> "AttestationDataUnsigned":
+        return AttestationDataUnsigned(
+            dataclasses.replace(self.data,
+                                source=dataclasses.replace(self.data.source),
+                                target=dataclasses.replace(self.data.target)),
+            dataclasses.replace(self.duty))
+
+    def hash_root(self) -> bytes:
+        return self.data.hash_tree_root()
+
+    def to_json(self) -> dict:
+        d = self.data
+        return {
+            "data": {
+                "slot": d.slot, "index": d.index,
+                "beacon_block_root": hx(d.beacon_block_root),
+                "source": {"epoch": d.source.epoch, "root": hx(d.source.root)},
+                "target": {"epoch": d.target.epoch, "root": hx(d.target.root)},
+            },
+            "duty": AttesterDefinition(self.duty).to_json(),
+        }
+
+    @staticmethod
+    def from_json(obj: dict) -> "AttestationDataUnsigned":
+        d = obj["data"]
+        data = spec.AttestationData(
+            slot=int(d["slot"]), index=int(d["index"]),
+            beacon_block_root=unhx(d["beacon_block_root"]),
+            source=spec.Checkpoint(int(d["source"]["epoch"]), unhx(d["source"]["root"])),
+            target=spec.Checkpoint(int(d["target"]["epoch"]), unhx(d["target"]["root"])))
+        return AttestationDataUnsigned(data,
+                                       AttesterDefinition.from_json(obj["duty"]).duty)
+
+
+@register_unsigned("proposal")
+@dataclass(frozen=True)
+class ProposalUnsigned:
+    """Unsigned (possibly blinded) block proposal
+    (reference core/unsigneddata.go VersionedBeaconBlock)."""
+
+    block: spec.BeaconBlock
+
+    def clone(self) -> "ProposalUnsigned":
+        return ProposalUnsigned(dataclasses.replace(self.block))
+
+    def hash_root(self) -> bytes:
+        return self.block.hash_tree_root()
+
+    def to_json(self) -> dict:
+        b = self.block
+        return {"block": {
+            "slot": b.slot, "proposer_index": b.proposer_index,
+            "parent_root": hx(b.parent_root), "state_root": hx(b.state_root),
+            "body_root": hx(b.body_root), "blinded": b.blinded,
+        }}
+
+    @staticmethod
+    def from_json(obj: dict) -> "ProposalUnsigned":
+        b = obj["block"]
+        return ProposalUnsigned(spec.BeaconBlock(
+            slot=int(b["slot"]), proposer_index=int(b["proposer_index"]),
+            parent_root=unhx(b["parent_root"]), state_root=unhx(b["state_root"]),
+            body_root=unhx(b["body_root"]), blinded=bool(b.get("blinded", False))))
+
+
+@register_unsigned("aggregated_attestation")
+@dataclass(frozen=True)
+class AggregatedAttestationUnsigned:
+    """Aggregated attestation for the AGGREGATOR duty
+    (reference core/unsigneddata.go AggregatedAttestation)."""
+
+    att: spec.Attestation
+
+    def clone(self) -> "AggregatedAttestationUnsigned":
+        return AggregatedAttestationUnsigned(dataclasses.replace(
+            self.att, aggregation_bits=list(self.att.aggregation_bits)))
+
+    def hash_root(self) -> bytes:
+        return self.att.hash_tree_root()
+
+    def to_json(self) -> dict:
+        from .signeddata import SignedAttestation
+        return {"attestation": SignedAttestation(self.att).to_json()}
+
+    @staticmethod
+    def from_json(obj: dict) -> "AggregatedAttestationUnsigned":
+        from .signeddata import SignedAttestation
+        return AggregatedAttestationUnsigned(
+            SignedAttestation.from_json(obj["attestation"]).att)
+
+
+@register_unsigned("sync_contribution")
+@dataclass(frozen=True)
+class SyncContributionUnsigned:
+    """Sync-committee contribution (reference core/unsigneddata.go
+    SyncContribution)."""
+
+    contribution: spec.SyncCommitteeContribution
+
+    def clone(self) -> "SyncContributionUnsigned":
+        return SyncContributionUnsigned(dataclasses.replace(
+            self.contribution,
+            aggregation_bits=list(self.contribution.aggregation_bits)))
+
+    def hash_root(self) -> bytes:
+        return self.contribution.hash_tree_root()
+
+    def to_json(self) -> dict:
+        c = self.contribution
+        return {"contribution": {
+            "slot": c.slot, "beacon_block_root": hx(c.beacon_block_root),
+            "subcommittee_index": c.subcommittee_index,
+            "aggregation_bits": c.aggregation_bits,
+            "signature": hx(c.signature)}}
+
+    @staticmethod
+    def from_json(obj: dict) -> "SyncContributionUnsigned":
+        c = obj["contribution"]
+        return SyncContributionUnsigned(spec.SyncCommitteeContribution(
+            int(c["slot"]), unhx(c["beacon_block_root"]),
+            int(c["subcommittee_index"]),
+            [bool(b) for b in c["aggregation_bits"]], unhx(c["signature"])))

@@ -1,0 +1,334 @@
+"""ValidatorAPI — the beacon-API surface served to the downstream validator
+client (reference core/validatorapi/validatorapi.go).
+
+The VC only knows its *share* keys; this component maps share pubkeys ⇄ DV
+root pubkeys both directions (validatorapi.go:978-1007), serves
+consensus-agreed unsigned data from DutyDB, verifies every submitted partial
+signature against the share public key (verifyPartialSig:1063), wraps
+submissions as ParSignedData and emits them to ParSigDB. Aggregation selection
+proofs are combined cluster-wide via the DVT-specific selections endpoints
+(AggregateBeaconCommitteeSelections:628, eth2util/eth2exp).
+
+This is the in-process component; the HTTP router (reference router.go) wraps
+it for real VCs in charon_tpu.app.vapi_router.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Awaitable, Callable
+
+from .. import tbls
+from ..eth2 import spec
+from ..eth2.beacon import BeaconNode
+from ..eth2.spec import ChainSpec
+from ..utils import errors, log, metrics
+from .aggsigdb import MemDB as AggSigDB
+from .dutydb import MemDB as DutyDB
+from .keyshares import KeyShares
+from .signeddata import (
+    BeaconCommitteeSelection,
+    SignedAggregateAndProof,
+    SignedAttestation,
+    SignedExit,
+    SignedProposal,
+    SignedRandao,
+    SignedRegistration,
+    SignedSyncContributionAndProof,
+    SignedSyncMessage,
+    SyncCommitteeSelection,
+    _Eth2Signed,
+)
+from .types import (
+    Duty,
+    DutyType,
+    ParSignedData,
+    ParSignedDataSet,
+    PubKey,
+    pubkey_from_bytes,
+    pubkey_to_bytes,
+)
+
+_log = log.with_topic("vapi")
+
+_submit_counter = metrics.counter(
+    "core_validatorapi_submissions_total", "VC submissions", ("kind",))
+
+
+class Component:
+    """reference validatorapi.NewComponent (validatorapi.go:49)."""
+
+    def __init__(self, beacon: BeaconNode, dutydb: DutyDB, aggsigdb: AggSigDB,
+                 keys: KeyShares, chain: ChainSpec,
+                 index_resolver: Callable[[int], Awaitable[PubKey | None]] | None = None,
+                 clock: Callable[[], float] = time.time):
+        self._beacon = beacon
+        self._dutydb = dutydb
+        self._aggsigdb = aggsigdb
+        self._keys = keys
+        self._chain = chain
+        self._index_resolver = index_resolver
+        self._clock = clock
+        self._index_cache: dict[int, PubKey] = {}
+        self._subs = []
+
+    def subscribe(self, fn) -> None:
+        self._subs.append(fn)
+
+    # -- duties (proxied to the BN with share→root pubkey mapping) ----------
+
+    async def attester_duties(self, epoch: int,
+                              share_pubkeys: list[bytes]) -> list[spec.AttesterDuty]:
+        """Serve duties keyed by the VC's share pubkeys: map share → root,
+        query the BN for the root validators, substitute share pubkeys back
+        (reference validatorapi.go getDutiesFunc mapping)."""
+        roots = [self._keys.root_by_share_pubkey(pk) for pk in share_pubkeys]
+        vals = await self._beacon.validators_by_pubkey(
+            [pubkey_to_bytes(r) for r in roots])
+        idx_to_share: dict[int, bytes] = {}
+        for share_pk, root in zip(share_pubkeys, roots):
+            v = vals.get(bytes(pubkey_to_bytes(root)))
+            if v is not None:
+                idx_to_share[v.index] = bytes(share_pk)
+        duties = await self._beacon.attester_duties(epoch, sorted(idx_to_share))
+        return [dataclasses.replace(d, pubkey=idx_to_share[d.validator_index])
+                for d in duties if d.validator_index in idx_to_share]
+
+    async def proposer_duties(self, epoch: int,
+                              share_pubkeys: list[bytes]) -> list[spec.ProposerDuty]:
+        roots = [self._keys.root_by_share_pubkey(pk) for pk in share_pubkeys]
+        vals = await self._beacon.validators_by_pubkey(
+            [pubkey_to_bytes(r) for r in roots])
+        idx_to_share: dict[int, bytes] = {}
+        for share_pk, root in zip(share_pubkeys, roots):
+            v = vals.get(bytes(pubkey_to_bytes(root)))
+            if v is not None:
+                idx_to_share[v.index] = bytes(share_pk)
+        duties = await self._beacon.proposer_duties(epoch, sorted(idx_to_share))
+        return [dataclasses.replace(d, pubkey=idx_to_share[d.validator_index])
+                for d in duties if d.validator_index in idx_to_share]
+
+    # -- attestations --------------------------------------------------------
+
+    async def attestation_data(self, slot: int,
+                               committee_index: int) -> spec.AttestationData:
+        """Blocking: serves the consensus-agreed attestation data
+        (reference validatorapi.go:229 AttestationData → DutyDB await)."""
+        return await self._dutydb.await_attestation(slot, committee_index)
+
+    async def submit_attestations(self, atts: list[spec.Attestation]) -> None:
+        """Partial attestations from the VC (validatorapi.go:237
+        SubmitAttestations): identify the validator from the aggregation-bits
+        index, verify the partial sig vs the share pubkey, emit ParSignedData."""
+        by_duty: dict[Duty, ParSignedDataSet] = {}
+        for att in atts:
+            slot = att.data.slot
+            set_bits = [i for i, b in enumerate(att.aggregation_bits) if b]
+            if len(set_bits) != 1:
+                raise errors.new("unaggregated attestation must have one bit set",
+                                 bits=len(set_bits))
+            pubkey = self._dutydb.pubkey_by_attestation(
+                slot, att.data.index, set_bits[0])
+            data = SignedAttestation(att)
+            self._verify_partial(pubkey, data)
+            duty = Duty(slot, DutyType.ATTESTER)
+            by_duty.setdefault(duty, {})[pubkey] = ParSignedData(
+                data, self._keys.my_share_idx)
+        _submit_counter.inc("attestation", amount=len(atts))
+        for duty, parsigs in by_duty.items():
+            await self._emit(duty, parsigs)
+
+    # -- block proposals -----------------------------------------------------
+
+    async def block_proposal(self, slot: int, randao_reveal: bytes,
+                             graffiti: bytes = b"") -> spec.BeaconBlock:
+        """GET /eth/v2/validator/blocks/{slot} (reference
+        validatorapi.go:299 BeaconBlockProposal): the randao_reveal is the
+        VC's *partial* randao signature — verify it, route it through the
+        partial-sig pipeline (duty RANDAO), then serve the consensus-agreed
+        block from DutyDB (which the Fetcher builds once the cluster's
+        aggregated randao lands in AggSigDB)."""
+        epoch = self._chain.epoch_of(slot)
+        pubkey = await self._proposer_pubkey(slot)
+        randao = SignedRandao(epoch, bytes(randao_reveal))
+        self._verify_partial(pubkey, randao)
+        duty = Duty(slot, DutyType.RANDAO)
+        await self._emit(duty, {pubkey: ParSignedData(randao, self._keys.my_share_idx)})
+        _submit_counter.inc("randao")
+        return await self._dutydb.await_beacon_block(slot)
+
+    async def submit_block(self, block: spec.SignedBeaconBlock) -> None:
+        """Partial signed block from the VC (validatorapi.go:357
+        SubmitBeaconBlock)."""
+        slot = block.message.slot
+        pubkey = self._dutydb.proposer_pubkey(slot)
+        if pubkey is None:
+            pubkey = await self._proposer_pubkey(slot)
+        data = SignedProposal(block.message, bytes(block.signature))
+        self._verify_partial(pubkey, data)
+        _submit_counter.inc("block")
+        await self._emit(Duty(slot, DutyType.PROPOSER),
+                         {pubkey: ParSignedData(data, self._keys.my_share_idx)})
+
+    async def _proposer_pubkey(self, slot: int) -> PubKey:
+        pubkey = self._dutydb.proposer_pubkey(slot)
+        if pubkey is not None:
+            return pubkey
+        # Resolve via BN proposer duties for the slot's epoch.
+        epoch = self._chain.epoch_of(slot)
+        vals = await self._beacon.validators_by_pubkey(
+            [pubkey_to_bytes(r) for r in self._keys.root_pubkeys])
+        duties = await self._beacon.proposer_duties(
+            epoch, sorted(v.index for v in vals.values()))
+        for d in duties:
+            if d.slot == slot:
+                return pubkey_from_bytes(d.pubkey)
+        raise errors.new("no cluster proposer for slot", slot=slot)
+
+    # -- aggregation duties --------------------------------------------------
+
+    async def aggregate_beacon_committee_selections(
+            self, selections: list[BeaconCommitteeSelection],
+    ) -> list[BeaconCommitteeSelection]:
+        """POST /eth/v1/validator/beacon_committee_selections — the
+        DVT-specific endpoint combining partial selection proofs cluster-wide
+        (reference validatorapi.go:628 AggregateBeaconCommitteeSelections)."""
+        out = []
+        for sel in selections:
+            pubkey = await self._pubkey_by_index(sel.validator_index)
+            self._verify_partial(pubkey, sel)
+            duty = Duty(sel.slot, DutyType.PREPARE_AGGREGATOR)
+            await self._emit(duty, {pubkey: ParSignedData(sel, self._keys.my_share_idx)})
+            combined = await self._aggsigdb.await_(duty, pubkey)
+            if not isinstance(combined, BeaconCommitteeSelection):
+                raise errors.new("unexpected combined selection type")
+            out.append(combined)
+        _submit_counter.inc("beacon_committee_selection", amount=len(selections))
+        return out
+
+    async def aggregate_attestation(self, slot: int,
+                                    att_data_root: bytes) -> spec.Attestation:
+        """Serve the agreed aggregate attestation from DutyDB
+        (reference validatorapi.go AggregateAttestation)."""
+        return await self._dutydb.await_agg_attestation(slot, att_data_root)
+
+    async def submit_aggregate_attestations(
+            self, aggs: list[spec.SignedAggregateAndProof]) -> None:
+        """reference validatorapi.go:684 SubmitAggregateAttestations."""
+        for agg in aggs:
+            pubkey = await self._pubkey_by_index(agg.message.aggregator_index)
+            data = SignedAggregateAndProof(agg.message, bytes(agg.signature))
+            self._verify_partial(pubkey, data)
+            duty = Duty(agg.message.aggregate.data.slot, DutyType.AGGREGATOR)
+            await self._emit(duty, {pubkey: ParSignedData(data, self._keys.my_share_idx)})
+        _submit_counter.inc("aggregate_and_proof", amount=len(aggs))
+
+    # -- sync committee ------------------------------------------------------
+
+    async def submit_sync_committee_messages(
+            self, msgs: list[spec.SyncCommitteeMessage]) -> None:
+        """reference validatorapi.go:746 SubmitSyncCommitteeMessages."""
+        for msg in msgs:
+            pubkey = await self._pubkey_by_index(msg.validator_index)
+            data = SignedSyncMessage(msg)
+            self._verify_partial(pubkey, data)
+            duty = Duty(msg.slot, DutyType.SYNC_MESSAGE)
+            await self._emit(duty, {pubkey: ParSignedData(data, self._keys.my_share_idx)})
+        _submit_counter.inc("sync_message", amount=len(msgs))
+
+    async def aggregate_sync_committee_selections(
+            self, selections: list[SyncCommitteeSelection],
+    ) -> list[SyncCommitteeSelection]:
+        out = []
+        for sel in selections:
+            pubkey = await self._pubkey_by_index(sel.validator_index)
+            self._verify_partial(pubkey, sel)
+            duty = Duty(sel.slot, DutyType.PREPARE_SYNC_CONTRIBUTION)
+            await self._emit(duty, {pubkey: ParSignedData(sel, self._keys.my_share_idx)})
+            combined = await self._aggsigdb.await_(duty, pubkey)
+            if not isinstance(combined, SyncCommitteeSelection):
+                raise errors.new("unexpected combined sync selection type")
+            out.append(combined)
+        _submit_counter.inc("sync_committee_selection", amount=len(selections))
+        return out
+
+    async def sync_committee_contribution(
+            self, slot: int, subcommittee_index: int,
+            beacon_block_root: bytes) -> spec.SyncCommitteeContribution:
+        return await self._dutydb.await_sync_contribution(
+            slot, subcommittee_index, beacon_block_root)
+
+    async def submit_contribution_and_proofs(
+            self, contribs: list[spec.SignedContributionAndProof]) -> None:
+        for c in contribs:
+            pubkey = await self._pubkey_by_index(c.message.aggregator_index)
+            data = SignedSyncContributionAndProof(c.message, bytes(c.signature))
+            self._verify_partial(pubkey, data)
+            duty = Duty(c.message.contribution.slot, DutyType.SYNC_CONTRIBUTION)
+            await self._emit(duty, {pubkey: ParSignedData(data, self._keys.my_share_idx)})
+        _submit_counter.inc("contribution_and_proof", amount=len(contribs))
+
+    # -- exits & registrations ----------------------------------------------
+
+    async def submit_voluntary_exit(self, exit_: spec.SignedVoluntaryExit) -> None:
+        """reference validatorapi.go:581 SubmitVoluntaryExit."""
+        pubkey = await self._pubkey_by_index(exit_.message.validator_index)
+        data = SignedExit(exit_.message, bytes(exit_.signature))
+        self._verify_partial(pubkey, data)
+        # Exits have no deadline; duty slot anchors at the current slot.
+        slot = max(self._chain.slot_at(self._clock()), 0)
+        _submit_counter.inc("voluntary_exit")
+        await self._emit(Duty(slot, DutyType.EXIT),
+                         {pubkey: ParSignedData(data, self._keys.my_share_idx)})
+
+    async def submit_validator_registrations(
+            self, regs: list[spec.SignedValidatorRegistration]) -> None:
+        """reference validatorapi.go:555 SubmitValidatorRegistrations."""
+        slot = max(self._chain.slot_at(self._clock()), 0)
+        by_duty: ParSignedDataSet = {}
+        for reg in regs:
+            pubkey = self._keys.root_by_share_pubkey(reg.message.pubkey)
+            # The VC registers its share pubkey; the cluster registers the DV
+            # root — rewrite before verification (the VC signed over the root
+            # registration served by the keymanager flow).
+            root_reg = dataclasses.replace(reg.message,
+                                           pubkey=pubkey_to_bytes(pubkey))
+            data = SignedRegistration(root_reg, bytes(reg.signature))
+            self._verify_partial(pubkey, data)
+            by_duty[pubkey] = ParSignedData(data, self._keys.my_share_idx)
+        if by_duty:
+            _submit_counter.inc("validator_registration", amount=len(regs))
+            await self._emit(Duty(slot, DutyType.BUILDER_REGISTRATION), by_duty)
+
+    # -- helpers -------------------------------------------------------------
+
+    def _verify_partial(self, pubkey: PubKey, data: _Eth2Signed) -> None:
+        """Verify a partial signature against this node's share public key
+        (reference verifyPartialSig validatorapi.go:1063)."""
+        share_pk = self._keys.my_share_pubkey(pubkey)
+        if not data.verify(self._chain, share_pk):
+            raise errors.new("invalid partial signature from VC",
+                             pubkey=pubkey[:10], kind=type(data).__name__)
+
+    async def _pubkey_by_index(self, validator_index: int) -> PubKey:
+        if self._index_resolver is not None:
+            pk = await self._index_resolver(validator_index)
+            if pk is not None:
+                return pk
+        # Cache the index→pubkey map: the cluster's validator set is static
+        # for a run, and per-submission BN round-trips would be O(n) per slot.
+        if validator_index not in self._index_cache:
+            vals = await self._beacon.validators_by_pubkey(
+                [pubkey_to_bytes(r) for r in self._keys.root_pubkeys])
+            self._index_cache = {
+                v.index: pubkey_from_bytes(pk_bytes)
+                for pk_bytes, v in vals.items()}
+        pk = self._index_cache.get(validator_index)
+        if pk is None:
+            raise errors.new("unknown validator index", index=validator_index)
+        return pk
+
+    async def _emit(self, duty: Duty, parsigs: ParSignedDataSet) -> None:
+        for fn in self._subs:
+            await fn(duty, {k: v.clone() for k, v in parsigs.items()})

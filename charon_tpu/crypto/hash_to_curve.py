@@ -206,8 +206,17 @@ def iso_map_g2(pt_affine):
 DST_ETH = b"BLS_SIG_BLS12381G2_XMD:SHA-256_SSWU_RO_POP_"
 
 
+import functools
+
+
+@functools.lru_cache(maxsize=4096)
 def hash_to_g2(msg: bytes, dst: bytes = DST_ETH):
-    """Full hash_to_curve: returns a Jacobian point in the G2 subgroup."""
+    """Full hash_to_curve: returns a Jacobian point in the G2 subgroup.
+
+    Cached: the duty pipeline hashes the same signing root several times per
+    duty (VC partial verify, peer bulk verify, aggregate verify); hashing is
+    pure so an LRU cache is sound and cuts a large share of CPU cost.
+    """
     u0, u1 = hash_to_field_fq2(msg, dst, 2)
     q0 = iso_map_g2(map_to_curve_sswu(u0))
     q1 = iso_map_g2(map_to_curve_sswu(u1))

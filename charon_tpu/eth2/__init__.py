@@ -1,0 +1,7 @@
+"""Ethereum consensus-layer utilities (reference layer L2, eth2util/).
+
+  ssz.py      — SSZ serialization + hash_tree_root merkleization
+  spec.py     — minimal consensus-spec datatypes used by the duty pipeline
+  signing.py  — signing domains + signing roots (eth2util/signing/signing.go)
+  keystore.py — EIP-2335 keystores for share keys (eth2util/keystore)
+"""

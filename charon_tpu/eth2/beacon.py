@@ -1,0 +1,132 @@
+"""Beacon-node client seam (reference layer L3, app/eth2wrap).
+
+`BeaconNode` is the async interface the duty pipeline consumes (the reference
+generates a superset wrapper of go-eth2-client, eth2wrap_gen.go; here the
+surface is exactly what the pipeline needs). `MultiBeaconNode` adds the
+reference's multi-endpoint failover: fan out to all nodes, first success wins,
+with per-endpoint error/latency metrics (eth2wrap.go:72,100,246-316).
+`ValidatorCache` caches the validator set per epoch (eth2wrap/valcache.go).
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Protocol, runtime_checkable
+
+from ..utils import errors, log, metrics
+from .spec import (
+    Attestation,
+    AttestationData,
+    AttesterDuty,
+    BeaconBlock,
+    ChainSpec,
+    ProposerDuty,
+    SignedAggregateAndProof,
+    SignedBeaconBlock,
+    SignedContributionAndProof,
+    SignedValidatorRegistration,
+    SignedVoluntaryExit,
+    SyncCommitteeContribution,
+    SyncCommitteeDuty,
+    SyncCommitteeMessage,
+    Validator,
+)
+
+_log = log.with_topic("eth2wrap")
+
+_errors_total = metrics.counter(
+    "app_eth2_errors_total", "Beacon-node request errors", ("endpoint",))
+_latency_hist = metrics.histogram(
+    "app_eth2_latency_seconds", "Beacon-node request latency", ("endpoint",))
+
+
+@runtime_checkable
+class BeaconNode(Protocol):
+    """The beacon-API surface the pipeline consumes."""
+
+    name: str
+
+    async def spec(self) -> ChainSpec: ...
+    async def node_syncing(self) -> bool: ...  # True while syncing
+    async def validators_by_pubkey(self, pubkeys: list[bytes]) -> dict[bytes, Validator]: ...
+    async def attester_duties(self, epoch: int, indices: list[int]) -> list[AttesterDuty]: ...
+    async def proposer_duties(self, epoch: int, indices: list[int]) -> list[ProposerDuty]: ...
+    async def sync_committee_duties(self, epoch: int, indices: list[int]) -> list[SyncCommitteeDuty]: ...
+    async def attestation_data(self, slot: int, committee_index: int) -> AttestationData: ...
+    async def aggregate_attestation(self, slot: int, att_data_root: bytes) -> Attestation: ...
+    async def block_proposal(self, slot: int, randao_reveal: bytes,
+                             graffiti: bytes = b"", blinded: bool = False) -> BeaconBlock: ...
+    async def sync_committee_contribution(self, slot: int, subcommittee_index: int,
+                                          beacon_block_root: bytes) -> SyncCommitteeContribution: ...
+    async def submit_attestations(self, atts: list[Attestation]) -> None: ...
+    async def submit_block(self, block: SignedBeaconBlock) -> None: ...
+    async def submit_aggregate_and_proofs(self, aggs: list[SignedAggregateAndProof]) -> None: ...
+    async def submit_sync_messages(self, msgs: list[SyncCommitteeMessage]) -> None: ...
+    async def submit_contribution_and_proofs(self, contribs: list[SignedContributionAndProof]) -> None: ...
+    async def submit_validator_registrations(self, regs: list[SignedValidatorRegistration]) -> None: ...
+    async def submit_voluntary_exit(self, exit_: SignedVoluntaryExit) -> None: ...
+
+
+class MultiBeaconNode:
+    """Multi-BN failover: try the current best node first, fall back to the
+    rest in parallel; first success wins (reference eth2wrap.go:100 best-node
+    selector + 246-316 submit/request fan-out)."""
+
+    def __init__(self, nodes: list[BeaconNode]):
+        if not nodes:
+            raise errors.new("at least one beacon node required")
+        self.nodes = list(nodes)
+        self.name = "multi:" + ",".join(n.name for n in nodes)
+        self._best = 0
+
+    def __getattr__(self, attr: str):
+        async def call(*args, **kwargs):
+            return await self._fanout(attr, *args, **kwargs)
+        return call
+
+    async def _fanout(self, attr: str, *args, **kwargs):
+        order = [self._best] + [i for i in range(len(self.nodes)) if i != self._best]
+        last_err: BaseException | None = None
+        for i in order:
+            node = self.nodes[i]
+            try:
+                with _latency_hist.time(node.name):
+                    result = await getattr(node, attr)(*args, **kwargs)
+                self._best = i
+                return result
+            except Exception as exc:  # noqa: BLE001 — failover path
+                _errors_total.inc(node.name)
+                _log.warn("beacon node request failed; trying next",
+                          err=exc, endpoint=node.name, method=attr)
+                last_err = exc
+        raise errors.wrap(last_err, "all beacon nodes failed", method=attr)
+
+
+class ValidatorCache:
+    """Per-epoch cache of the cluster's validators by pubkey
+    (reference app/eth2wrap/valcache.go, refreshed each epoch per
+    app/app.go:411-422)."""
+
+    _KEEP_EPOCHS = 2  # scheduler queries current + next epoch each tick
+
+    def __init__(self, node: BeaconNode, pubkeys: list[bytes]):
+        self._node = node
+        self._pubkeys = list(pubkeys)
+        self._cache: dict[int, dict[bytes, Validator]] = {}
+        self._lock = asyncio.Lock()
+
+    async def get(self, epoch: int) -> dict[bytes, Validator]:
+        async with self._lock:
+            if epoch not in self._cache:
+                self._cache[epoch] = await self._node.validators_by_pubkey(self._pubkeys)
+                while len(self._cache) > self._KEEP_EPOCHS:
+                    self._cache.pop(min(self._cache))
+            return dict(self._cache[epoch])
+
+    def trim(self) -> None:
+        self._cache.clear()
+
+    async def active_indices(self, epoch: int) -> dict[int, bytes]:
+        """validator index -> pubkey for active validators."""
+        vals = await self.get(epoch)
+        return {v.index: pk for pk, v in vals.items() if v.is_active()}

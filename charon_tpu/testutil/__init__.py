@@ -1,0 +1,2 @@
+"""Test infrastructure (reference layer LT, testutil/): beaconmock,
+validatormock, and in-process simnet cluster assembly."""

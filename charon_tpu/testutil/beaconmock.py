@@ -1,0 +1,209 @@
+"""BeaconMock — an in-process beacon node (reference testutil/beaconmock).
+
+Serves deterministic duties/attestation-data and records submissions, with
+per-function stub overrides exactly like the reference's beaconmock option
+functions (beaconmock.go:104-130). Supports fuzzing hooks for cluster-level
+fault injection (beaconmock_fuzz.go analogue).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import hashlib
+import time
+from typing import Callable
+
+from ..eth2 import spec
+from ..utils import errors
+
+def _root(*parts) -> bytes:
+    h = hashlib.sha256()
+    for p in parts:
+        h.update(str(p).encode())
+    return h.digest()
+
+
+class BeaconMock:
+    """In-process BeaconNode (reference beaconmock.New, beaconmock.go:51)."""
+
+    def __init__(self, pubkeys: list[bytes], genesis_time: float | None = None,
+                 seconds_per_slot: float = 12.0, slots_per_epoch: int = 32,
+                 attest_all_every_slot: bool = True):
+        self.name = "beaconmock"
+        self._spec = spec.ChainSpec(
+            genesis_time=time.time() if genesis_time is None else genesis_time,
+            genesis_validators_root=_root("genesis"),
+            seconds_per_slot=seconds_per_slot,
+            slots_per_epoch=slots_per_epoch)
+        self.validators: dict[bytes, spec.Validator] = {
+            bytes(pk): spec.Validator(index=i, pubkey=bytes(pk))
+            for i, pk in enumerate(pubkeys)}
+        self._attest_all = attest_all_every_slot
+        self.syncing = False
+
+        # Recorded submissions + wakeup for awaiting tests.
+        self.attestations: list[spec.Attestation] = []
+        self.blocks: list[spec.SignedBeaconBlock] = []
+        self.aggregates: list[spec.SignedAggregateAndProof] = []
+        self.sync_messages: list[spec.SyncCommitteeMessage] = []
+        self.contributions: list[spec.SignedContributionAndProof] = []
+        self.registrations: list[spec.SignedValidatorRegistration] = []
+        self.exits: list[spec.SignedVoluntaryExit] = []
+        self._submitted = asyncio.Event()
+
+        # Per-function stub overrides (reference beaconmock option funcs).
+        self.overrides: dict[str, Callable] = {}
+
+    # -- BeaconNode interface ------------------------------------------------
+
+    async def spec(self) -> spec.ChainSpec:
+        return self._spec
+
+    async def node_syncing(self) -> bool:
+        return self.syncing
+
+    async def validators_by_pubkey(self, pubkeys: list[bytes]) -> dict[bytes, spec.Validator]:
+        return {bytes(pk): self.validators[bytes(pk)]
+                for pk in pubkeys if bytes(pk) in self.validators}
+
+    async def attester_duties(self, epoch: int,
+                              indices: list[int]) -> list[spec.AttesterDuty]:
+        if "attester_duties" in self.overrides:
+            return await self.overrides["attester_duties"](epoch, indices)
+        by_index = {v.index: v for v in self.validators.values()}
+        duties = []
+        wanted = [i for i in indices if i in by_index]
+        for slot in range(epoch * self._spec.slots_per_epoch,
+                          (epoch + 1) * self._spec.slots_per_epoch):
+            if self._attest_all:
+                # Everyone attests every slot in committee 0 — maximal duty
+                # density for exercising the pipeline.
+                for pos, idx in enumerate(sorted(wanted)):
+                    v = by_index[idx]
+                    duties.append(spec.AttesterDuty(
+                        pubkey=v.pubkey, slot=slot, validator_index=idx,
+                        committee_index=0, committee_length=len(wanted),
+                        committees_at_slot=1, validator_committee_index=pos))
+            else:
+                # One deterministic slot per validator per epoch.
+                for pos, idx in enumerate(sorted(wanted)):
+                    if slot % self._spec.slots_per_epoch == idx % self._spec.slots_per_epoch:
+                        v = by_index[idx]
+                        duties.append(spec.AttesterDuty(
+                            pubkey=v.pubkey, slot=slot, validator_index=idx,
+                            committee_index=0, committee_length=len(wanted),
+                            committees_at_slot=1, validator_committee_index=pos))
+        return duties
+
+    async def proposer_duties(self, epoch: int,
+                              indices: list[int]) -> list[spec.ProposerDuty]:
+        if "proposer_duties" in self.overrides:
+            return await self.overrides["proposer_duties"](epoch, indices)
+        by_index = {v.index: v for v in self.validators.values()}
+        wanted = sorted(i for i in indices if i in by_index)
+        if not wanted:
+            return []
+        duties = []
+        for slot in range(epoch * self._spec.slots_per_epoch,
+                          (epoch + 1) * self._spec.slots_per_epoch):
+            idx = wanted[slot % len(wanted)]
+            duties.append(spec.ProposerDuty(
+                pubkey=by_index[idx].pubkey, slot=slot, validator_index=idx))
+        return duties
+
+    async def sync_committee_duties(self, epoch: int,
+                                    indices: list[int]) -> list[spec.SyncCommitteeDuty]:
+        if "sync_committee_duties" in self.overrides:
+            return await self.overrides["sync_committee_duties"](epoch, indices)
+        return []
+
+    async def attestation_data(self, slot: int,
+                               committee_index: int) -> spec.AttestationData:
+        if "attestation_data" in self.overrides:
+            return await self.overrides["attestation_data"](slot, committee_index)
+        epoch = self._spec.epoch_of(slot)
+        return spec.AttestationData(
+            slot=slot, index=committee_index,
+            beacon_block_root=_root("block", slot),
+            source=spec.Checkpoint(max(epoch - 1, 0), _root("cp", epoch - 1)),
+            target=spec.Checkpoint(epoch, _root("cp", epoch)))
+
+    async def aggregate_attestation(self, slot: int,
+                                    att_data_root: bytes) -> spec.Attestation:
+        data = await self.attestation_data(slot, 0)
+        if data.hash_tree_root() != bytes(att_data_root):
+            raise errors.new("unknown attestation data root", slot=slot)
+        return spec.Attestation(
+            aggregation_bits=[True] * len(self.validators),
+            data=data, signature=b"\x00" * 96)
+
+    async def block_proposal(self, slot: int, randao_reveal: bytes,
+                             graffiti: bytes = b"", blinded: bool = False) -> spec.BeaconBlock:
+        if "block_proposal" in self.overrides:
+            return await self.overrides["block_proposal"](slot, randao_reveal,
+                                                          graffiti, blinded)
+        duties = await self.proposer_duties(
+            self._spec.epoch_of(slot), [v.index for v in self.validators.values()])
+        proposer = next((d.validator_index for d in duties if d.slot == slot), 0)
+        return spec.BeaconBlock(
+            slot=slot, proposer_index=proposer,
+            parent_root=_root("block", slot - 1),
+            state_root=_root("state", slot),
+            body_root=_root("body", slot, bytes(randao_reveal).hex()),
+            blinded=blinded)
+
+    async def sync_committee_contribution(self, slot: int, subcommittee_index: int,
+                                          beacon_block_root: bytes) -> spec.SyncCommitteeContribution:
+        return spec.SyncCommitteeContribution(
+            slot=slot, beacon_block_root=bytes(beacon_block_root),
+            subcommittee_index=subcommittee_index,
+            aggregation_bits=[True] * (spec.SYNC_COMMITTEE_SIZE
+                                       // spec.SYNC_COMMITTEE_SUBNET_COUNT),
+            signature=b"\x00" * 96)
+
+    # -- submissions ---------------------------------------------------------
+
+    async def submit_attestations(self, atts: list[spec.Attestation]) -> None:
+        self.attestations.extend(atts)
+        self._wake()
+
+    async def submit_block(self, block: spec.SignedBeaconBlock) -> None:
+        self.blocks.append(block)
+        self._wake()
+
+    async def submit_aggregate_and_proofs(self, aggs) -> None:
+        self.aggregates.extend(aggs)
+        self._wake()
+
+    async def submit_sync_messages(self, msgs) -> None:
+        self.sync_messages.extend(msgs)
+        self._wake()
+
+    async def submit_contribution_and_proofs(self, contribs) -> None:
+        self.contributions.extend(contribs)
+        self._wake()
+
+    async def submit_validator_registrations(self, regs) -> None:
+        self.registrations.extend(regs)
+        self._wake()
+
+    async def submit_voluntary_exit(self, exit_) -> None:
+        self.exits.append(exit_)
+        self._wake()
+
+    def _wake(self) -> None:
+        self._submitted.set()
+
+    async def await_submissions(self, pred: Callable[["BeaconMock"], bool],
+                                timeout: float = 30.0) -> None:
+        """Block until pred(self) — e.g. enough attestations arrived."""
+        deadline = time.monotonic() + timeout
+        while not pred(self):
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                raise asyncio.TimeoutError("await_submissions timed out")
+            self._submitted.clear()
+            try:
+                await asyncio.wait_for(self._submitted.wait(), timeout=remaining)
+            except asyncio.TimeoutError:
+                pass

@@ -1,0 +1,140 @@
+"""Simnet — n full in-process nodes without real networking
+(reference testutil/integration/simnet_test.go:48: spins n app.Run instances
+in one process with cluster.NewForT, beaconmock, validatormock, and in-memory
+transports).
+
+Each node gets the full core wiring (the reference's wireCoreWorkflow,
+app/app.go:333-527): scheduler → fetcher → consensus (leadercast or QBFT) →
+dutydb → validatorapi → parsigdb → parsigex → sigagg → aggsigdb → bcast.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from dataclasses import dataclass, field
+
+from .. import tbls
+from ..core import aggsigdb, bcast, dutydb, fetcher, interfaces, leadercast
+from ..core import parsigdb, parsigex, scheduler, sigagg, validatorapi
+from ..core.deadline import Deadliner, new_duty_deadline_func
+from ..core.gater import new_duty_gater
+from ..core.keyshares import KeyShares, new_cluster_for_t
+from ..eth2.beacon import ValidatorCache
+from ..utils import expbackoff, retry as retry_util
+from .beaconmock import BeaconMock
+from .validatormock import ValidatorMock
+
+
+@dataclass
+class SimNode:
+    """One node's components + background tasks."""
+
+    idx: int
+    keys: KeyShares
+    sched: scheduler.Scheduler
+    vapi: validatorapi.Component
+    vmock: ValidatorMock
+    duty_db: dutydb.MemDB
+    parsig_db: parsigdb.MemDB
+    aggsig_db: aggsigdb.MemDB
+    retryer: retry_util.Retryer
+    tasks: list[asyncio.Task] = field(default_factory=list)
+
+    async def start(self) -> None:
+        self.tasks = [
+            asyncio.create_task(self.sched.run(), name=f"sched-{self.idx}"),
+            asyncio.create_task(self.duty_db.run_gc(), name=f"dutydb-gc-{self.idx}"),
+            asyncio.create_task(self.parsig_db.run_trim(), name=f"parsigdb-{self.idx}"),
+            asyncio.create_task(self.aggsig_db.run_gc(), name=f"aggsigdb-{self.idx}"),
+        ]
+
+    async def stop(self) -> None:
+        self.sched.stop()
+        for t in self.tasks:
+            t.cancel()
+        await asyncio.gather(*self.tasks, return_exceptions=True)
+
+
+@dataclass
+class SimCluster:
+    beacon: BeaconMock
+    nodes: list[SimNode]
+    root_secrets: list[tbls.PrivateKey]
+
+    async def start(self) -> None:
+        for n in self.nodes:
+            await n.start()
+
+    async def stop(self) -> None:
+        for n in self.nodes:
+            await n.stop()
+
+
+def new_simnet(num_validators: int = 2, threshold: int = 3, num_nodes: int = 4,
+               seconds_per_slot: float = 0.2, slots_per_epoch: int = 8,
+               genesis_delay: float = 0.3, use_vmock: bool = True,
+               verify_peer_partials: bool = True) -> SimCluster:
+    """Assemble an n-node in-process cluster sharing one beaconmock."""
+    root_secrets, node_keys = new_cluster_for_t(num_validators, threshold, num_nodes)
+    root_pubkey_bytes = [
+        bytes(tbls.secret_to_public_key(s)) for s in root_secrets]
+
+    beacon = BeaconMock(root_pubkey_bytes,
+                        genesis_time=time.time() + genesis_delay,
+                        seconds_per_slot=seconds_per_slot,
+                        slots_per_epoch=slots_per_epoch)
+    chain = beacon._spec
+
+    lcast_transport = leadercast.MemTransport()
+    parsig_transport = parsigex.MemTransport()
+
+    nodes = []
+    for i, keys in enumerate(node_keys):
+        node = _build_node(i, keys, beacon, chain, lcast_transport,
+                           parsig_transport, num_nodes, use_vmock,
+                           verify_peer_partials)
+        nodes.append(node)
+    return SimCluster(beacon, nodes, root_secrets)
+
+
+def _build_node(idx: int, keys: KeyShares, beacon: BeaconMock, chain,
+                lcast_transport, parsig_transport, num_nodes: int,
+                use_vmock: bool, verify_peer_partials: bool) -> SimNode:
+    """The reference's wireCoreWorkflow (app/app.go:333-527) in miniature."""
+    deadline_fn = new_duty_deadline_func(chain)
+    valcache = ValidatorCache(beacon, list(beacon.validators))
+
+    sched = scheduler.Scheduler(beacon, valcache)
+    fetch = fetcher.Fetcher(beacon)
+    duty_db = dutydb.MemDB(Deadliner(deadline_fn))
+    aggsig_db = aggsigdb.MemDB(Deadliner(deadline_fn))
+    parsig_db = parsigdb.MemDB(keys.threshold, Deadliner(deadline_fn))
+    consensus = leadercast.LeaderCast(lcast_transport, idx, num_nodes)
+    vapi = validatorapi.Component(beacon, duty_db, aggsig_db, keys, chain)
+    verify_set = (parsigex.new_batch_eth2_verifier(chain, keys)
+                  if verify_peer_partials else None)
+    psigex = parsigex.ParSigEx(parsig_transport, idx,
+                               new_duty_gater(chain), verify_set)
+    agg = sigagg.SigAgg(keys, chain)
+    caster = bcast.Broadcaster(beacon, chain)
+
+    fetch.register_agg_sig_db(aggsig_db.await_)
+    fetch.register_await_attestation_data(duty_db.await_attestation)
+
+    retryer = retry_util.Retryer(
+        lambda duty: deadline_fn(duty) if duty is not None else None,
+        expbackoff.Config(base=0.05, jitter=0.1, max_delay=0.5))
+
+    interfaces.wire(
+        sched, fetch, consensus, duty_db, vapi, parsig_db, psigex, agg,
+        aggsig_db, caster,
+        options=[interfaces.WithAsyncRetry(retryer),
+                 interfaces.WithTracing()])
+
+    vmock = ValidatorMock(vapi, keys, chain)
+    if use_vmock:
+        sched.subscribe_slots(vmock.on_slot)
+
+    return SimNode(idx, keys, sched, vapi, vmock, duty_db, parsig_db,
+                   aggsig_db, retryer)

@@ -1,0 +1,107 @@
+"""ValidatorMock — a mini validator client signing with real share keys
+(reference testutil/validatormock): attestations (incl. aggregation selection
+proofs), block proposals, sync committee messages, driven per-slot by the
+scheduler's slot subscription (wired in-process per reference app/vmock.go:23).
+"""
+
+from __future__ import annotations
+
+from .. import tbls
+from ..core.keyshares import KeyShares
+from ..core.signeddata import BeaconCommitteeSelection, SignedAttestation, SignedProposal, SignedRandao
+from ..core.types import PubKey, pubkey_from_bytes
+from ..core.validatorapi import Component as VAPI
+from ..eth2 import signing, spec
+from ..utils import errors, log
+
+_log = log.with_topic("vmock")
+
+
+class ValidatorMock:
+    """Signs duties with this node's share secrets via the in-process
+    ValidatorAPI (reference validatormock/component.go:35)."""
+
+    def __init__(self, vapi: VAPI, keys: KeyShares, chain: spec.ChainSpec):
+        self._vapi = vapi
+        self._keys = keys
+        self._chain = chain
+        # share pubkey bytes -> root PubKey
+        self._share_pks: dict[bytes, PubKey] = {
+            bytes(tbls.secret_to_public_key(sk)): root
+            for root, sk in keys.my_share_secrets.items()}
+
+    def _secret_for_share_pk(self, share_pk: bytes) -> tbls.PrivateKey:
+        root = self._share_pks.get(bytes(share_pk))
+        if root is None:
+            raise errors.new("vmock: unknown share pubkey")
+        return self._keys.my_share_secrets[root]
+
+    async def on_slot(self, slot_obj) -> None:
+        """Slot tick handler: run this slot's duties
+        (reference validatormock/component.go:123-231 scheduling)."""
+        try:
+            await self.attest(slot_obj.slot)
+        except Exception as exc:  # noqa: BLE001 — vmock mirrors a lenient VC
+            _log.warn("vmock attest failed", err=exc, slot=slot_obj.slot)
+        try:
+            await self.propose(slot_obj.slot)
+        except Exception as exc:  # noqa: BLE001
+            _log.warn("vmock propose failed", err=exc, slot=slot_obj.slot)
+
+    async def attest(self, slot: int) -> None:
+        """Fetch duties, sign attestations with share keys, submit
+        (reference validatormock/attest.go:30)."""
+        epoch = self._chain.epoch_of(slot)
+        share_pks = list(self._share_pks)
+        duties = await self._vapi.attester_duties(epoch, share_pks)
+        atts = []
+        for duty in duties:
+            if duty.slot != slot:
+                continue
+            data = await self._vapi.attestation_data(slot, duty.committee_index)
+            bits = [False] * duty.committee_length
+            bits[duty.validator_committee_index] = True
+            unsigned = spec.Attestation(bits, data, b"\x00" * 96)
+            root = SignedAttestation(unsigned).signing_root(self._chain)
+            sig = tbls.sign(self._secret_for_share_pk(duty.pubkey), root)
+            atts.append(spec.Attestation(bits, data, bytes(sig)))
+        if atts:
+            await self._vapi.submit_attestations(atts)
+            _log.debug("vmock submitted attestations", slot=slot, count=len(atts))
+
+    async def propose(self, slot: int) -> None:
+        """Propose if one of our validators leads the slot
+        (reference validatormock/propose.go)."""
+        epoch = self._chain.epoch_of(slot)
+        share_pks = list(self._share_pks)
+        duties = await self._vapi.proposer_duties(epoch, share_pks)
+        for duty in duties:
+            if duty.slot != slot:
+                continue
+            secret = self._secret_for_share_pk(duty.pubkey)
+            randao_root = SignedRandao(epoch).signing_root(self._chain)
+            randao_sig = tbls.sign(secret, randao_root)
+            block = await self._vapi.block_proposal(slot, bytes(randao_sig))
+            block_root = SignedProposal(block).signing_root(self._chain)
+            block_sig = tbls.sign(secret, block_root)
+            await self._vapi.submit_block(spec.SignedBeaconBlock(block, bytes(block_sig)))
+            _log.debug("vmock submitted block", slot=slot)
+
+    async def prepare_aggregation(self, slot: int) -> list[BeaconCommitteeSelection]:
+        """Submit partial beacon-committee selection proofs, get the
+        cluster-combined ones back (reference validatormock/attest.go
+        aggregation selection flow)."""
+        epoch = self._chain.epoch_of(slot)
+        duties = await self._vapi.attester_duties(epoch, list(self._share_pks))
+        selections = []
+        for duty in duties:
+            if duty.slot != slot:
+                continue
+            secret = self._secret_for_share_pk(duty.pubkey)
+            root = signing.slot_selection_root(self._chain, slot)
+            sig = tbls.sign(secret, root)
+            selections.append(BeaconCommitteeSelection(
+                duty.validator_index, slot, bytes(sig)))
+        if not selections:
+            return []
+        return await self._vapi.aggregate_beacon_committee_selections(selections)

@@ -1,0 +1,176 @@
+"""Tests for the eth2 utility layer (SSZ, spec types, signing domains) and the
+core value types (reference eth2util/ and core/types.go test shapes)."""
+
+import asyncio
+import hashlib
+
+from charon_tpu import tbls
+from charon_tpu.core import signeddata, types, unsigneddata
+from charon_tpu.core.deadline import Deadliner, duty_deadline, new_duty_deadline_func
+from charon_tpu.core.gater import new_duty_gater
+from charon_tpu.eth2 import signing, spec, ssz
+
+
+def test_ssz_uint_and_bytes():
+    assert ssz.uint64.serialize(5) == (5).to_bytes(8, "little")
+    assert ssz.uint64.hash_tree_root(5) == (5).to_bytes(8, "little") + b"\x00" * 24
+    assert ssz.Bytes32.hash_tree_root(b"\x01" * 32) == b"\x01" * 32
+    # 48-byte vector spans two chunks -> one hash.
+    pk = bytes(range(48))
+    expect = hashlib.sha256(pk[:32] + pk[32:].ljust(32, b"\x00")).digest()
+    assert ssz.Bytes48.hash_tree_root(pk) == expect
+
+
+def test_ssz_bitlist_sentinel_roundtrip():
+    bl = ssz.Bitlist(2048)
+    bits = [True, False, True]
+    ser = bl.serialize(bits)
+    # 0b1101 = bits 101 + sentinel at index 3.
+    assert ser == bytes([0b1101])
+    assert ssz.Bitlist.deserialize(ser) == bits
+    assert ssz.Bitlist.deserialize(bl.serialize([])) == []
+    # Empty bitlist root: mix_in_length(zero-tree, 0).
+    assert bl.hash_tree_root([]) != bl.hash_tree_root([False])
+
+
+def test_fork_data_root_known_vector():
+    # fork_data_root(0x00000000, zero_root) merkleizes two zero chunks:
+    # sha256(0x00*64) = f5a5fd42... (the canonical depth-1 zero hash).
+    root = signing.compute_fork_data_root(b"\x00" * 4, b"\x00" * 32)
+    assert root.hex().startswith("f5a5fd42d16a20302798ef6ed309979b")
+    domain = signing.compute_domain(signing.DOMAIN_DEPOSIT, b"\x00" * 4, b"\x00" * 32)
+    assert domain.hex() == "03000000f5a5fd42d16a20302798ef6ed309979b43003d2320d9f0e8ea9831a9"
+
+
+def test_ssz_container_offsets_variable_fields():
+    att = spec.Attestation(
+        aggregation_bits=[True] * 5,
+        data=spec.AttestationData(1, 2, b"\xaa" * 32,
+                                  spec.Checkpoint(0, b"\xbb" * 32),
+                                  spec.Checkpoint(1, b"\xcc" * 32)),
+        signature=b"\xdd" * 96)
+    ser = att.ssz_serialize()
+    # offset(4) + fixed data(128) + sig(96) then the bitlist.
+    assert ser[:4] == (228).to_bytes(4, "little")
+    assert ser[-1] == 0b111111  # 5 set bits + sentinel
+    root = att.hash_tree_root()
+    assert len(root) == 32
+    # Root changes with any field.
+    att2 = spec.Attestation([True] * 5, att.data, b"\xde" * 96)
+    assert att2.hash_tree_root() != root
+
+
+def test_signing_roots_differ_by_domain_and_epoch():
+    chain = spec.ChainSpec(genesis_time=0)
+    obj = b"\x11" * 32
+    r1 = signing.signing_root_for(chain, signing.DOMAIN_BEACON_ATTESTER, 0, obj)
+    r2 = signing.signing_root_for(chain, signing.DOMAIN_RANDAO, 0, obj)
+    assert r1 != r2
+    assert signing.randao_signing_root(chain, 3) != signing.randao_signing_root(chain, 4)
+
+
+def test_sign_verify_eth2_signeddata():
+    chain = spec.ChainSpec(genesis_time=0)
+    sk = tbls.generate_secret_key()
+    pk = tbls.secret_to_public_key(sk)
+    data = spec.AttestationData(5, 0, b"\x01" * 32,
+                                spec.Checkpoint(0, b"\x02" * 32),
+                                spec.Checkpoint(1, b"\x03" * 32))
+    unsigned = spec.Attestation([False] * 4, data, b"\x00" * 96)
+    att = signeddata.SignedAttestation(unsigned)
+    sig = tbls.sign(sk, att.signing_root(chain))
+    signed = att.set_signature(sig)
+    assert signed.verify(chain, pk)
+    # Wrong epoch/domain -> fails.
+    bad = tbls.sign(sk, att.message_root())
+    assert not att.set_signature(bad).verify(chain, pk)
+
+
+def test_signeddata_json_roundtrip_registry():
+    data = spec.AttestationData(5, 0, b"\x01" * 32,
+                                spec.Checkpoint(0, b"\x02" * 32),
+                                spec.Checkpoint(1, b"\x03" * 32))
+    att = signeddata.SignedAttestation(spec.Attestation([True, False], data, b"\x04" * 96))
+    for value in [
+        att,
+        signeddata.SignedRandao(7, b"\x05" * 96),
+        signeddata.SignedProposal(spec.BeaconBlock(9, 1, b"\x06" * 32, b"\x07" * 32, b"\x08" * 32), b"\x09" * 96),
+        signeddata.SignedExit(spec.VoluntaryExit(2, 11), b"\x0a" * 96),
+        signeddata.BeaconCommitteeSelection(3, 21, b"\x0b" * 96),
+        signeddata.SignedRegistration(spec.ValidatorRegistration(b"\x0c" * 20, 30_000_000, 1700000000, b"\x0d" * 48), b"\x0e" * 96),
+    ]:
+        enc = types.encode_signed(value)
+        dec = types.decode_signed(enc)
+        assert dec == value
+        assert dec.message_root() == value.message_root()
+    psd = types.ParSignedData(att, share_idx=3)
+    assert types.ParSignedData.from_json(psd.to_json()) == psd
+
+
+def test_parsigned_clone_and_set_discipline():
+    data = spec.AttestationData(5, 0, b"\x01" * 32,
+                                spec.Checkpoint(0, b"\x02" * 32),
+                                spec.Checkpoint(1, b"\x03" * 32))
+    att = signeddata.SignedAttestation(spec.Attestation([True], data, b"\x04" * 96))
+    psd = types.ParSignedData(att, 1)
+    cl = psd.clone()
+    assert cl == psd and cl is not psd
+    # Mutating the clone's bits must not affect the original.
+    cl.data.att.aggregation_bits.append(True)
+    assert psd.data.att.aggregation_bits == [True]
+
+
+def test_unsigned_data_hash_roots_and_json():
+    duty = spec.AttesterDuty(b"\x0f" * 48, 5, 1, 2, 64, 4, 7)
+    data = spec.AttestationData(5, 2, b"\x01" * 32,
+                                spec.Checkpoint(0, b"\x02" * 32),
+                                spec.Checkpoint(1, b"\x03" * 32))
+    u = unsigneddata.AttestationDataUnsigned(data, duty)
+    assert u.hash_root() == data.hash_tree_root()
+    rt = types.decode_unsigned(types.encode_unsigned(u))
+    assert rt == u
+    cl = u.clone()
+    assert cl == u and cl.data is not u.data
+
+
+def test_duty_ordering_and_strings():
+    d1 = types.Duty(5, types.DutyType.ATTESTER)
+    d2 = types.Duty(5, types.DutyType.PROPOSER)
+    assert d2 < d1  # proposer enum value < attester
+    assert str(d1) == "5/attester"
+    assert types.DutyType.ATTESTER.valid and not types.DutyType.UNKNOWN.valid
+
+
+def test_duty_deadline_and_gater():
+    chain = spec.ChainSpec(genesis_time=1000, seconds_per_slot=12)
+    duty = types.Duty(10, types.DutyType.ATTESTER)
+    assert duty_deadline(chain, duty) == 1000 + (10 + 5) * 12
+    assert duty_deadline(chain, types.Duty(10, types.DutyType.EXIT)) is None
+
+    now = [1000 + 10 * 12]
+    gate = new_duty_gater(chain, clock=lambda: now[0])
+    assert gate(duty)
+    assert gate(types.Duty(10 + 64, types.DutyType.ATTESTER))
+    assert not gate(types.Duty(10 + 65, types.DutyType.ATTESTER))
+    assert not gate(types.Duty(5, types.DutyType.UNKNOWN))
+
+
+def test_deadliner_expires_in_order():
+    async def run():
+        chain = spec.ChainSpec(genesis_time=0, seconds_per_slot=0.01)
+        import time
+        dl = Deadliner(new_duty_deadline_func(chain), clock=time.time)
+        now_slot = chain.slot_at(time.time())
+        d1 = types.Duty(now_slot + 1, types.DutyType.ATTESTER)
+        d2 = types.Duty(now_slot + 2, types.DutyType.PROPOSER)
+        assert dl.add(d2)
+        assert dl.add(d1)
+        assert not dl.add(types.Duty(now_slot - 10, types.DutyType.ATTESTER))
+        got = []
+        async for duty in dl.expired():
+            got.append(duty)
+            if len(got) == 2:
+                break
+        assert got == [d1, d2]
+
+    asyncio.run(asyncio.wait_for(run(), timeout=10))
