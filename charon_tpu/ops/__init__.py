@@ -1,0 +1,24 @@
+"""JAX/TPU batched BLS12-381 kernels (the crypto compute plane).
+
+The reference's only native component is the herumi C++ BLS library consumed
+via cgo (reference tbls/herumi.go:12); this package is its TPU-native
+replacement: batched field/curve/pairing arithmetic as jittable JAX programs.
+
+Design (TPU-first, not a port):
+  * Fq elements are vectors of 32 × 12-bit limbs in int32 lanes — products fit
+    in 24 bits, Montgomery-CIOS accumulators stay < 2^31, so every op is exact
+    int32 VPU arithmetic with static shapes.
+  * All values live in Montgomery form on device; host converts at the edges.
+  * Points are Jacobian over Fq2 with branchless (select-based) add/double so
+    scalar multiplication is a fixed-length `lax.scan` — XLA-friendly, no
+    data-dependent control flow.
+  * The batch axis is validators × shares — the duty pipeline's `…Set`
+    batching (reference docs/architecture.md:126-128) maps directly onto one
+    device dispatch.
+
+Modules:
+  field.py    — Fq/Fq2 Montgomery limb arithmetic
+  curve.py    — G1/G2 Jacobian ops + batched scalar multiplication
+  aggregate.py— batched Lagrange threshold-aggregation kernel
+  pairing.py  — Fq6/Fq12 towers, Miller loop, final exponentiation, verify
+"""

@@ -1,0 +1,250 @@
+"""Fq / Fq2 Montgomery limb arithmetic for BLS12-381 on TPU.
+
+Representation: an Fq element is (..., L=32) int32 limbs of 12 bits each
+(little-endian), canonical in [0, p), in Montgomery form (x·R mod p with
+R = 2^384). Why 12-bit limbs: int32 products of 12-bit values are ≤ 2^24, so
+a CIOS Montgomery accumulator that lazily sums 2 products/limb/iteration over
+32 iterations stays ≤ 33·2^25 < 2^31 — exact int32 arithmetic with no carries
+inside the hot loop, exactly one carry-normalization scan at the end.
+
+Fq2 = Fq[u]/(u²+1) is (..., 2, L) with Karatsuba 3-mult multiplication.
+
+reference: this plane replaces herumi's C++ Fp/Fp2 (tbls/herumi.go via cgo).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+# BLS12-381 base field prime.
+P_INT = 0x1A0111EA397FE69A4B1BA7B6434BACD764774B84F38512BF6730D2A0F6B0F6241EABFFFEB153FFFFB9FEFFFFFFFFAAAB
+# Subgroup order (scalar field Fr).
+R_INT = 0x73EDA753299D7D483339D80809A1D80553BDA402FFFE5BFEFFFFFFFF00000001
+
+LIMB_BITS = 12
+LIMBS = 32                      # 32 × 12 = 384 bits ≥ 381
+MASK = (1 << LIMB_BITS) - 1
+R_MONT = 1 << (LIMB_BITS * LIMBS)          # Montgomery R = 2^384
+R_MONT_INV = pow(R_MONT, -1, P_INT)
+R2_INT = (R_MONT * R_MONT) % P_INT
+# -p^{-1} mod 2^12 (the Montgomery n' constant).
+N0_INV = (-pow(P_INT, -1, 1 << LIMB_BITS)) % (1 << LIMB_BITS)
+
+SCALAR_BITS = 256               # scalars are < r < 2^255
+
+
+def limbs_from_int(x: int) -> np.ndarray:
+    """Host: int -> little-endian 12-bit limb vector."""
+    out = np.zeros(LIMBS, dtype=np.int32)
+    for i in range(LIMBS):
+        out[i] = x & MASK
+        x >>= LIMB_BITS
+    if x:
+        raise ValueError("value exceeds 384 bits")
+    return out
+
+
+def int_from_limbs(limbs) -> int:
+    """Host: limb vector -> int."""
+    arr = np.asarray(limbs, dtype=np.int64)
+    return sum(int(v) << (LIMB_BITS * i) for i, v in enumerate(arr))
+
+
+P_LIMBS = limbs_from_int(P_INT)
+
+
+def to_mont_int(x: int) -> int:
+    return (x * R_MONT) % P_INT
+
+
+def from_mont_int(x: int) -> int:
+    return (x * R_MONT_INV) % P_INT
+
+
+def fq_from_int(x: int) -> np.ndarray:
+    """Host: canonical int -> Montgomery limb vector."""
+    return limbs_from_int(to_mont_int(x % P_INT))
+
+
+def fq_to_int(limbs) -> int:
+    """Host: Montgomery limb vector -> canonical int."""
+    return from_mont_int(int_from_limbs(limbs))
+
+
+def fq2_from_ints(c0: int, c1: int) -> np.ndarray:
+    return np.stack([fq_from_int(c0), fq_from_int(c1)])
+
+
+def fq2_to_ints(limbs) -> tuple[int, int]:
+    return fq_to_int(limbs[..., 0, :]), fq_to_int(limbs[..., 1, :])
+
+
+# ---------------------------------------------------------------------------
+# Device arithmetic. All functions take/return int32 arrays with limb axis
+# last and broadcast over leading batch axes.
+# ---------------------------------------------------------------------------
+
+_P = jnp.asarray(P_LIMBS, dtype=jnp.int32)
+
+
+def carry_norm(x: jnp.ndarray, out_limbs: int = LIMBS) -> jnp.ndarray:
+    """Exact carry propagation via scan over the limb axis: limbs may hold any
+    int32 (including negative); result limbs are canonical 12-bit."""
+    nin = x.shape[-1]
+    xt = jnp.moveaxis(x, -1, 0)  # (limbs, ...)
+
+    def step(carry, limb):
+        v = limb + carry
+        return v >> LIMB_BITS, v & MASK
+
+    # Derive the carry init from the input (x*0) so its type keeps the same
+    # varying manual axes under shard_map (plain zeros would not).
+    carry0 = x[..., 0] * 0
+    final_carry, out = jax.lax.scan(step, carry0, xt)
+    out = jnp.moveaxis(out, 0, -1)
+    if out_limbs > nin:
+        pad = [(0, 0)] * (out.ndim - 1) + [(0, out_limbs - nin)]
+        out = jnp.pad(out, pad)
+        out = out.at[..., nin].add(final_carry)
+    return out[..., :out_limbs]
+
+
+def _sub_with_borrow(x: jnp.ndarray, y: jnp.ndarray):
+    """(x - y) limbwise with borrow scan; returns (diff, underflow_mask)."""
+    d = x - y
+    dt = jnp.moveaxis(d, -1, 0)
+
+    def step(carry, limb):
+        v = limb + carry
+        return v >> LIMB_BITS, v & MASK
+
+    carry0 = d[..., 0] * 0
+    final_carry, out = jax.lax.scan(step, carry0, dt)
+    return jnp.moveaxis(out, 0, -1), final_carry < 0
+
+
+def cond_sub_p(x: jnp.ndarray) -> jnp.ndarray:
+    """x in [0, 2p) with canonical limbs -> x mod p."""
+    d, under = _sub_with_borrow(x, _P)
+    return jnp.where(under[..., None], x, d)
+
+
+def fq_add(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    return cond_sub_p(carry_norm(a + b))
+
+
+def fq_sub(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    return cond_sub_p(carry_norm(a - b + _P))
+
+
+def fq_neg(a: jnp.ndarray) -> jnp.ndarray:
+    # p - a, with 0 -> 0.
+    is_zero = jnp.all(a == 0, axis=-1, keepdims=True)
+    d, _ = _sub_with_borrow(jnp.broadcast_to(_P, a.shape), a)
+    return jnp.where(is_zero, a, d)
+
+
+# CIOS unroll factor: the 32-iteration loop runs as a lax.scan over
+# LIMBS/UNROLL steps with UNROLL iterations inlined per step. Pure compile-
+# time/runtime trade-off: larger UNROLL = bigger graphs (the pairing kernel
+# contains ~15k multiplies), smaller = more loop overhead.
+CIOS_UNROLL = 4
+
+
+def fq_mont_mul(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """Montgomery product a·b·R⁻¹ mod p (CIOS with lazy accumulation).
+
+    12-bit limbs keep every product ≤ 2^24 and the lazily-accumulated columns
+    ≤ 33·2^25 < 2^31, so the whole inner loop is exact int32 arithmetic with a
+    single carry-normalization at the end.
+    """
+    a, b = jnp.broadcast_arrays(a, b)
+    t0 = a * 0          # shaped+typed like a limb vector, shard_map-varying
+    zero1 = a[..., :1] * 0
+    # a's limbs as scan inputs, grouped by the unroll factor.
+    a_steps = jnp.moveaxis(a, -1, 0).reshape(
+        (LIMBS // CIOS_UNROLL, CIOS_UNROLL) + a.shape[:-1])
+
+    def step(t, a_group):
+        for u in range(CIOS_UNROLL):
+            ai = a_group[u][..., None]
+            t = t + ai * b
+            m = ((t[..., 0:1] & MASK) * N0_INV) & MASK
+            t = t + m * _P
+            # t[0] ≡ 0 mod 2^12: shift one limb down, pushing the carry up.
+            carry0 = t[..., 0:1] >> LIMB_BITS
+            t = jnp.concatenate([t[..., 1:2] + carry0, t[..., 2:], zero1],
+                                axis=-1)
+        return t, None
+
+    t, _ = jax.lax.scan(step, t0, a_steps)
+    # CIOS with R = 2^384 > 4p bounds the result below 2p < 2^384, so the
+    # 33rd accumulator limb normalizes to zero and one cond-sub suffices.
+    return cond_sub_p(carry_norm(t, out_limbs=LIMBS))
+
+
+def fq_sqr(a: jnp.ndarray) -> jnp.ndarray:
+    return fq_mont_mul(a, a)
+
+
+def fq_is_zero(a: jnp.ndarray) -> jnp.ndarray:
+    return jnp.all(a == 0, axis=-1)
+
+
+# -- Fq2 --------------------------------------------------------------------
+
+
+def fq2_add(a, b):
+    return fq_add(a, b)
+
+
+def fq2_sub(a, b):
+    return fq_sub(a, b)
+
+
+def fq2_neg(a):
+    return fq_neg(a)
+
+
+def fq2_mul(a, b):
+    """Karatsuba over Fq[u]/(u²+1): 3 Fq multiplications."""
+    a0, a1 = a[..., 0, :], a[..., 1, :]
+    b0, b1 = b[..., 0, :], b[..., 1, :]
+    v0 = fq_mont_mul(a0, b0)
+    v1 = fq_mont_mul(a1, b1)
+    s = fq_mont_mul(fq_add(a0, a1), fq_add(b0, b1))
+    c0 = fq_sub(v0, v1)
+    c1 = fq_sub(fq_sub(s, v0), v1)
+    return jnp.stack([c0, c1], axis=-2)
+
+
+def fq2_sqr(a):
+    """(a0+a1u)² = (a0+a1)(a0−a1) + 2a0a1·u : 2 Fq multiplications."""
+    a0, a1 = a[..., 0, :], a[..., 1, :]
+    c0 = fq_mont_mul(fq_add(a0, a1), fq_sub(a0, a1))
+    t = fq_mont_mul(a0, a1)
+    c1 = fq_add(t, t)
+    return jnp.stack([c0, c1], axis=-2)
+
+
+def fq2_scalar_small(a, k: int):
+    """Multiply by a small integer constant via repeated addition."""
+    acc = a
+    for _ in range(k - 1):
+        acc = fq_add(acc, a)
+    return acc
+
+
+def fq2_is_zero(a):
+    return jnp.all(a == 0, axis=(-1, -2))
+
+
+def fq2_select(mask, a, b):
+    """mask: (...) bool -> a where mask else b (broadcast over (2, L))."""
+    return jnp.where(mask[..., None, None], a, b)
+
+
+def fq_select(mask, a, b):
+    return jnp.where(mask[..., None], a, b)

@@ -1,0 +1,263 @@
+"""Batched optimal-ate pairing on TPU: Miller loop + final exponentiation.
+
+TPU-first design (vs the CPU oracle's textbook affine Fq12 loop,
+crypto/pairing.py): the Miller loop runs on the M-twist with homogeneous
+projective T — no inversions anywhere — and line values that are sparse Fq12
+elements (coefficients in slots 1, v·w, v²·w). Lines are scaled by ξ·2y'·Z³
+(resp. ξ·λ·Z³), all in the Fq2/Fq6 subfields, which the final exponentiation
+kills. Derivation of the line shape:
+
+  untwist ψ(x',y') = (x'/w², y'/w³);  w⁻¹ = v²w/ξ, w⁻³ = vw/ξ
+  tangent at T, evaluated at P=(xp,yp) ∈ G1, scaled by ξ·2y'·Z³:
+    l = 2YZ²·ξ·yp · 1 + (3X³ − 2Y²Z) · vw − 3X²Z·xp · v²w
+  chord through T and affine Q=(xq,yq), scaled by ξ·λ·Z (θ = Y−yq·Z,
+  λ = X−xq·Z):
+    l = λ·ξ·yp · 1 + (θ·xq − λ·yq) · vw − θ·xp · v²w
+
+The final exponentiation's hard part uses the Ghammam–Fouotsa addition chain
+computing m^(3·(p⁴−p²+1)/r) — a fixed multiple coprime to r, so the
+verification check `final_exp(f) == 1` is exact (validated against the CPU
+oracle's naive exponentiation in tests).
+
+The batch axis spans verification items (the reference's hot loop: per-partial
+tbls.Verify in parsigex/validatorapi, reference core/parsigex/parsigex.go:61).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..crypto import curve as PC
+from ..crypto import fields as PF
+from . import field as F
+from . import tower as T
+
+X_ABS = 0xD201000000010000
+_X_BITS = bin(X_ABS)[3:]  # MSB implied; 63 steps, 5 additions
+
+
+def _fq2_scale_fq(a, s):
+    """Fq2 element × Fq scalar (both in Montgomery form)."""
+    return jnp.stack([F.fq_mont_mul(a[..., 0, :], s),
+                      F.fq_mont_mul(a[..., 1, :], s)], axis=-2)
+
+
+def _dbl_step(f, Tp, xp, yp):
+    """Doubling step: line through T,T evaluated at P; T <- 2T.
+    Independent Fq2 products are staged into shared scans (see
+    curve._fq2_mul_many)."""
+    from .curve import _fq2_mul_many
+
+    X, Y, Z = Tp
+    XX, S, XY = _fq2_mul_many([(X, X), (Y, Z), (X, Y)])
+    W = F.fq2_add(F.fq2_add(XX, XX), XX)         # 3X²
+    SZ, XW, WZ, B_, WW, SS, YS = _fq2_mul_many(
+        [(S, Z), (X, W), (W, Z), (XY, S), (W, W), (S, S), (Y, S)])
+    # line: l = 2YZ²·ξ·yp + (3X³ − 2Y²Z)·vw − 3X²Z·xp·v²w
+    a0 = T.fq2_mul_xi(_fq2_scale_fq(F.fq2_add(SZ, SZ), yp))
+    avw = F.fq2_sub(XW, F.fq2_add(YS, YS))
+    av2w = F.fq2_neg(_fq2_scale_fq(WZ, xp))
+    # point update (homogeneous doubling, a=0)
+    B4 = F.fq2_add(F.fq2_add(B_, B_), F.fq2_add(B_, B_))
+    H = F.fq2_sub(WW, F.fq2_add(B4, B4))         # W² − 8B
+    HS, WBH, Y2S2, S3 = _fq2_mul_many(
+        [(H, S), (W, F.fq2_sub(B4, H)), (YS, YS), (SS, S)])
+    X3 = F.fq2_add(HS, HS)
+    Y2S2_8 = F.fq2_add(F.fq2_add(Y2S2, Y2S2), F.fq2_add(Y2S2, Y2S2))
+    Y3 = F.fq2_sub(WBH, F.fq2_add(Y2S2_8, Y2S2_8))
+    S3_4 = F.fq2_add(F.fq2_add(S3, S3), F.fq2_add(S3, S3))
+    Z3 = F.fq2_add(S3_4, S3_4)                   # 8S³
+    f = T.fq12_mul_sparse(f, a0, avw, av2w)
+    return f, (X3, Y3, Z3)
+
+
+def _add_step(f, Tp, xq, yq, xp, yp):
+    """Mixed addition step: line through T and Q at P; T <- T + Q."""
+    from .curve import _fq2_mul_many
+
+    X, Y, Z = Tp
+    yqZ, xqZ = _fq2_mul_many([(yq, Z), (xq, Z)])
+    theta = F.fq2_sub(Y, yqZ)
+    lam = F.fq2_sub(X, xqZ)
+    ll, thth, th_xq, lam_yq = _fq2_mul_many(
+        [(lam, lam), (theta, theta), (theta, xq), (lam, yq)])
+    # line: l = λ·ξ·yp + (θ·xq − λ·yq)·vw − θ·xp·v²w
+    a0 = T.fq2_mul_xi(_fq2_scale_fq(lam, yp))
+    avw = F.fq2_sub(th_xq, lam_yq)
+    av2w = F.fq2_neg(_fq2_scale_fq(theta, xp))
+    lll, thZ, llA, llX = _fq2_mul_many(
+        [(ll, lam), (thth, Z), (ll, F.fq2_add(X, xqZ)), (ll, X)])
+    D = F.fq2_sub(thZ, llA)
+    X3, thT, Ylll, Z3 = _fq2_mul_many(
+        [(lam, D), (theta, F.fq2_sub(llX, D)), (Y, lll), (lll, Z)])
+    Y3 = F.fq2_sub(thT, Ylll)
+    f = T.fq12_mul_sparse(f, a0, avw, av2w)
+    return f, (X3, Y3, Z3)
+
+
+def _select_fq12(mask, a, b):
+    def sel(x, y):
+        return jnp.where(mask[..., None, None], x, y)
+    return (tuple(sel(x, y) for x, y in zip(a[0], b[0])),
+            tuple(sel(x, y) for x, y in zip(a[1], b[1])))
+
+
+def _select_point(mask, p, q):
+    return tuple(jnp.where(mask[..., None, None], x, y) for x, y in zip(p, q))
+
+
+_X_BITS_ARR = jnp.asarray([int(b) for b in _X_BITS], dtype=jnp.int32)
+
+
+def miller_loop_pairs(g1_points, g2_points):
+    """Product of Miller loops over pair groups sharing one accumulator:
+    f = Π_j f_{|x|,Q_j}(P_j), conjugated at the end (x < 0).
+
+    Runs as a 63-step lax.scan; addition steps are computed every iteration
+    and selected by the (static) bit pattern — uniform scan bodies beat a
+    fully unrolled graph for XLA, at ~1.6× redundant point work.
+
+    g1_points: list of (xp, yp) Fq arrays (batch, L).
+    g2_points: list of (xq, yq) Fq2 arrays (batch, 2, L) on the twist.
+    """
+    f0 = T.fq12_one_like(g2_points[0][0])
+    Ts0 = tuple((xq, yq, _one2_like(xq)) for (xq, yq) in g2_points)
+
+    def step(state, bit):
+        f, Ts = state
+        f = T.fq12_sqr(f)
+        Ts = list(Ts)
+        for j, (xp, yp) in enumerate(g1_points):
+            f, Ts[j] = _dbl_step(f, Ts[j], xp, yp)
+        f_add = f
+        Ts_add = list(Ts)
+        for j, ((xp, yp), (xq, yq)) in enumerate(zip(g1_points, g2_points)):
+            f_add, Ts_add[j] = _add_step(f_add, Ts_add[j], xq, yq, xp, yp)
+        mask = jnp.broadcast_to(bit.astype(bool), f[0][0].shape[:-2])
+        f = _select_fq12(mask, f_add, f)
+        Ts = tuple(_select_point(mask, ta, t) for ta, t in zip(Ts_add, Ts))
+        return (f, Ts), None
+
+    (f, _), _ = jax.lax.scan(step, (f0, Ts0), _X_BITS_ARR)
+    return T.fq12_conj(f)
+
+
+def _one2_like(x):
+    one = jnp.asarray(F.fq_from_int(1), dtype=jnp.int32)
+    one = jnp.broadcast_to(one, x[..., 0, :].shape) + x[..., 0, :] * 0
+    return jnp.stack([one, one * 0], axis=-2)
+
+
+_X_ABS_BITS_FULL = jnp.asarray([int(b) for b in bin(X_ABS)[2:]],
+                               dtype=jnp.int32)
+
+
+def _expt_conj(m):
+    """m^u for the (negative) BLS parameter u: conj(m^|u|) — valid in the
+    cyclotomic subgroup (post easy part). Scanned square-and-multiply."""
+    one = T.fq12_one_like(m[0][0])
+
+    def step(acc, bit):
+        acc = T.fq12_sqr(acc)
+        mul = T.fq12_mul(acc, m)
+        mask = jnp.broadcast_to(bit.astype(bool), m[0][0].shape[:-2])
+        return _select_fq12(mask, mul, acc), None
+
+    acc, _ = jax.lax.scan(step, one, _X_ABS_BITS_FULL)
+    return T.fq12_conj(acc)
+
+
+def final_exp_is_one(f):
+    """final_exponentiation(f) == 1, computed as f^(3·(p¹²−1)/r) == 1.
+    Since gcd(3, r) = 1 this is equivalent to the standard check."""
+    # easy part: f^(p⁶−1)(p²+1)
+    f1 = T.fq12_mul(T.fq12_conj(f), T.fq12_inv(f))
+    m = T.fq12_mul(T.fq12_frobenius(f1, 2), f1)
+    # hard part ×3 (Ghammam–Fouotsa chain, validated vs the CPU oracle)
+    t0 = T.fq12_sqr(m)
+    t1 = _expt_conj(m)
+    t2 = T.fq12_conj(m)
+    t1 = T.fq12_mul(t1, t2)
+    t2 = _expt_conj(t1)
+    t1 = T.fq12_conj(t1)
+    t1 = T.fq12_mul(t1, t2)
+    t2 = _expt_conj(t1)
+    t1 = T.fq12_frobenius(t1, 1)
+    t1 = T.fq12_mul(t1, t2)
+    res = T.fq12_mul(m, t0)
+    t0 = _expt_conj(t1)
+    t2 = _expt_conj(t0)
+    t0 = T.fq12_frobenius(t1, 2)
+    t1 = T.fq12_conj(t1)
+    t1 = T.fq12_mul(t1, t2)
+    t1 = T.fq12_mul(t1, t0)
+    res = T.fq12_mul(res, t1)
+    return T.fq12_is_one(res)
+
+
+# ---------------------------------------------------------------------------
+# Batched BLS verification kernel
+# ---------------------------------------------------------------------------
+
+# −G1 generator (host constant).
+_G1_NEG = (PC.g1_generator()[0], PF.fq_neg(PC.g1_generator()[1]))
+
+
+@functools.lru_cache(maxsize=8)
+def _compiled_verify(batch: int):
+    neg_g1_x = jnp.asarray(F.fq_from_int(_G1_NEG[0]))
+    neg_g1_y = jnp.asarray(F.fq_from_int(_G1_NEG[1]))
+
+    @jax.jit
+    def kernel(pk_x, pk_y, h_x, h_y, sig_x, sig_y):
+        # e(pk, H(m))·e(−G1, sig) == 1  ⟺  e(pk, H(m)) == e(G1, sig)
+        gx = jnp.broadcast_to(neg_g1_x, pk_x.shape)
+        gy = jnp.broadcast_to(neg_g1_y, pk_y.shape)
+        f = miller_loop_pairs([(pk_x, pk_y), (gx, gy)],
+                              [(h_x, h_y), (sig_x, sig_y)])
+        return final_exp_is_one(f)
+
+    return kernel
+
+
+def _bucket(n: int) -> int:
+    b = 8
+    while b < n:
+        b *= 2
+    return b
+
+
+def verify_batch_device(pubkeys_affine, h2c_affine, sigs_affine) -> np.ndarray:
+    """Batched verification of k independent (pk, H(m), sig) triples.
+
+    Inputs are host-side affine int coordinates:
+      pubkeys_affine: list of (x, y) G1 ints
+      h2c_affine:     list of ((x0,x1), (y0,y1)) G2 twist ints — hash points
+      sigs_affine:    list of ((x0,x1), (y0,y1)) G2 twist ints
+    Returns a bool array: per-item signature validity.
+    """
+    B = len(pubkeys_affine)
+    if B == 0:
+        return np.zeros(0, dtype=bool)
+    Bp = _bucket(B)
+
+    def pad(items, make):
+        out = [make(v) for v in items]
+        out += [out[0]] * (Bp - B)
+        return np.stack(out)
+
+    pk_x = pad(pubkeys_affine, lambda v: F.fq_from_int(v[0]))
+    pk_y = pad(pubkeys_affine, lambda v: F.fq_from_int(v[1]))
+    h_x = pad(h2c_affine, lambda v: F.fq2_from_ints(*v[0]))
+    h_y = pad(h2c_affine, lambda v: F.fq2_from_ints(*v[1]))
+    s_x = pad(sigs_affine, lambda v: F.fq2_from_ints(*v[0]))
+    s_y = pad(sigs_affine, lambda v: F.fq2_from_ints(*v[1]))
+
+    kernel = _compiled_verify(Bp)
+    ok = kernel(jnp.asarray(pk_x), jnp.asarray(pk_y), jnp.asarray(h_x),
+                jnp.asarray(h_y), jnp.asarray(s_x), jnp.asarray(s_y))
+    return np.asarray(ok)[:B]

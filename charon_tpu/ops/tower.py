@@ -1,0 +1,267 @@
+"""Device Fq6/Fq12 extension towers for the TPU pairing.
+
+Same tower as the CPU oracle (crypto/fields.py): Fq2 = Fq[u]/(u²+1),
+Fq6 = Fq2[v]/(v³−ξ) with ξ = 1+u, Fq12 = Fq6[w]/(w²−v). Elements are nested
+tuples of Fq2 limb arrays — jax pytrees, so they flow through jit/scan.
+
+Includes the sparse multiplication by Miller-loop line values (nonzero
+coefficients 1, v·w, v²·w only) and Frobenius maps with host-precomputed γ
+constants.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..crypto import fields as PF
+from . import field as F
+
+# Fq6 = (c0, c1, c2) of Fq2; Fq12 = (g, h) of Fq6.
+
+
+def fq2_mul_xi(a):
+    """(a0 + a1·u)(1 + u) = (a0 − a1) + (a0 + a1)u."""
+    a0, a1 = a[..., 0, :], a[..., 1, :]
+    return jnp.stack([F.fq_sub(a0, a1), F.fq_add(a0, a1)], axis=-2)
+
+
+def fq2_conj(a):
+    a0, a1 = a[..., 0, :], a[..., 1, :]
+    return jnp.stack([a0, F.fq_neg(a1)], axis=-2)
+
+
+# -- Fq6 --------------------------------------------------------------------
+
+
+def fq6_add(a, b):
+    return tuple(F.fq2_add(x, y) for x, y in zip(a, b))
+
+
+def fq6_sub(a, b):
+    return tuple(F.fq2_sub(x, y) for x, y in zip(a, b))
+
+
+def fq6_neg(a):
+    return tuple(F.fq2_neg(x) for x in a)
+
+
+def fq6_mul_many(pairs):
+    """k independent Fq6 Karatsuba products, all 6k Fq2 products stacked into
+    one scan (mirrors crypto/fields.py fq6_mul formulas)."""
+    from .curve import _fq2_mul_many
+
+    ops = []
+    for a, b in pairs:
+        a0, a1, a2 = a
+        b0, b1, b2 = b
+        ops += [
+            (a0, b0), (a1, b1), (a2, b2),
+            (F.fq2_add(a1, a2), F.fq2_add(b1, b2)),
+            (F.fq2_add(a0, a1), F.fq2_add(b0, b1)),
+            (F.fq2_add(a0, a2), F.fq2_add(b0, b2)),
+        ]
+    rs = _fq2_mul_many(ops)
+    outs = []
+    for i in range(len(pairs)):
+        t0, t1, t2, s12, s01, s02 = rs[6 * i: 6 * i + 6]
+        c0 = F.fq2_add(t0, fq2_mul_xi(F.fq2_sub(F.fq2_sub(s12, t1), t2)))
+        c1 = F.fq2_add(F.fq2_sub(F.fq2_sub(s01, t0), t1), fq2_mul_xi(t2))
+        c2 = F.fq2_add(F.fq2_sub(F.fq2_sub(s02, t0), t2), t1)
+        outs.append((c0, c1, c2))
+    return outs
+
+
+def fq6_mul(a, b):
+    return fq6_mul_many([(a, b)])[0]
+
+
+def fq6_sqr(a):
+    return fq6_mul(a, a)
+
+
+def fq6_mul_v(a):
+    return (fq2_mul_xi(a[2]), a[0], a[1])
+
+
+def fq6_inv(a):
+    a0, a1, a2 = a
+    c0 = F.fq2_sub(F.fq2_sqr(a0), fq2_mul_xi(F.fq2_mul(a1, a2)))
+    c1 = F.fq2_sub(fq2_mul_xi(F.fq2_sqr(a2)), F.fq2_mul(a0, a1))
+    c2 = F.fq2_sub(F.fq2_sqr(a1), F.fq2_mul(a0, a2))
+    t = F.fq2_add(F.fq2_mul(a0, c0),
+                  fq2_mul_xi(F.fq2_add(F.fq2_mul(a2, c1), F.fq2_mul(a1, c2))))
+    ti = fq2_inv(t)
+    return (F.fq2_mul(c0, ti), F.fq2_mul(c1, ti), F.fq2_mul(c2, ti))
+
+
+# -- Fq inversion via fixed-exponent power (p−2), scanned --------------------
+
+_P_MINUS_2_BITS = jnp.asarray(
+    [int(b) for b in bin(F.P_INT - 2)[2:]], dtype=jnp.int32)
+
+
+def fq_inv(a):
+    """a^(p−2) by square-and-multiply over the 381 static exponent bits,
+    as a lax.scan (the unrolled graph would dominate the pairing kernel)."""
+    one = jnp.broadcast_to(jnp.asarray(F.fq_from_int(1), dtype=jnp.int32),
+                           a.shape) + a * 0  # + a*0: shard_map varying type
+
+    def step(acc, bit):
+        acc = F.fq_sqr(acc)
+        mul = F.fq_mont_mul(acc, a)
+        return jnp.where(bit.astype(bool), mul, acc), None
+
+    acc, _ = jax.lax.scan(step, one, _P_MINUS_2_BITS)
+    return acc
+
+
+def fq2_inv(a):
+    a0, a1 = a[..., 0, :], a[..., 1, :]
+    norm = F.fq_add(F.fq_sqr(a0), F.fq_sqr(a1))
+    d = fq_inv(norm)
+    return jnp.stack([F.fq_mont_mul(a0, d),
+                      F.fq_neg(F.fq_mont_mul(a1, d))], axis=-2)
+
+
+# -- Fq12 -------------------------------------------------------------------
+
+
+def fq12_one_like(x):
+    """Fq12 one, broadcast to x's batch shape; x is an Fq2 array (..., 2, L).
+    Derived with +x*0 so it can seed lax.scan carries under shard_map."""
+    one = jnp.asarray(F.fq_from_int(1), dtype=jnp.int32)
+    one = jnp.broadcast_to(one, x[..., 0, :].shape) + x[..., 0, :] * 0
+    zero = one * 0
+    f2_one = jnp.stack([one, zero], axis=-2)
+    f2_zero = jnp.zeros_like(f2_one)
+    g = (f2_one, f2_zero, f2_zero)
+    h = (f2_zero, f2_zero, f2_zero)
+    return (g, h)
+
+
+def fq12_add(a, b):
+    return (fq6_add(a[0], b[0]), fq6_add(a[1], b[1]))
+
+
+def fq12_mul(a, b):
+    a0, a1 = a
+    b0, b1 = b
+    # The 3 Karatsuba Fq6 products are independent: one 18-wide Fq2 stack.
+    t0, t1, s = fq6_mul_many(
+        [(a0, b0), (a1, b1), (fq6_add(a0, a1), fq6_add(b0, b1))])
+    c0 = fq6_add(t0, fq6_mul_v(t1))
+    c1 = fq6_sub(fq6_sub(s, t0), t1)
+    return (c0, c1)
+
+
+def fq12_sqr(a):
+    return fq12_mul(a, a)
+
+
+def fq12_conj(a):
+    return (a[0], fq6_neg(a[1]))
+
+
+def fq12_inv(a):
+    a0, a1 = a
+    t = fq6_sub(fq6_sqr(a0), fq6_mul_v(fq6_sqr(a1)))
+    ti = fq6_inv(t)
+    return (fq6_mul(a0, ti), fq6_neg(fq6_mul(a1, ti)))
+
+
+def fq12_mul_sparse(f, a, b, c):
+    """f · (a + b·vw + c·v²w) where a, b, c are Fq2 — the Miller line shape.
+
+    Derivation (basis 1, v, v², w, vw, v²w with w²=v, v³=ξ):
+      c0' = a f0 + ξ(b f4 + c f3)      c3' = a f3 + ξ(b f2 + c f1)
+      c1' = a f1 + ξ(b f5 + c f4)      c4' = a f4 + b f0 + ξ c f2
+      c2' = a f2 + b f3 + ξ c f5       c5' = a f5 + b f1 + c f0
+    All 18 Fq2 products are independent: one stacked scan.
+    """
+    from .curve import _fq2_mul_many
+
+    (f0, f1, f2), (f3, f4, f5) = f
+    coeffs = (f0, f1, f2, f3, f4, f5)
+    rs = _fq2_mul_many([(a, x) for x in coeffs]
+                       + [(b, x) for x in coeffs]
+                       + [(c, x) for x in coeffs])
+    af, bf, cf = rs[0:6], rs[6:12], rs[12:18]
+    c0 = F.fq2_add(af[0], fq2_mul_xi(F.fq2_add(bf[4], cf[3])))
+    c1 = F.fq2_add(af[1], fq2_mul_xi(F.fq2_add(bf[5], cf[4])))
+    c2 = F.fq2_add(af[2], F.fq2_add(bf[3], fq2_mul_xi(cf[5])))
+    c3 = F.fq2_add(af[3], fq2_mul_xi(F.fq2_add(bf[2], cf[1])))
+    c4 = F.fq2_add(af[4], F.fq2_add(bf[0], fq2_mul_xi(cf[2])))
+    c5 = F.fq2_add(af[5], F.fq2_add(bf[1], cf[0]))
+    return ((c0, c1, c2), (c3, c4, c5))
+
+
+# -- Frobenius with host-precomputed γ constants -----------------------------
+
+def _host_frob_constants():
+    """γ_{n,k} for frobenius^n on basis (1, v, v², w, vw, v²w):
+    frobⁿ(Σ c_k e_k) = Σ conjⁿ(c_k)·γ_{n,k}·e_k, computed with the CPU oracle's
+    exact Fq2 arithmetic."""
+    xi = (1, 1)
+    e = (PF.P - 1) // 6
+    gamma1 = [PF.fq2_pow(xi, e * k) for k in [0, 2, 4, 1, 3, 5]]
+    tables = []
+    cur = gamma1
+    prev = gamma1
+    tables.append(gamma1)
+    for _ in range(2):  # frob^2, frob^3
+        nxt = [PF.fq2_mul(PF.fq2_conj(pk), g1k) for pk, g1k in zip(prev, gamma1)]
+        tables.append(nxt)
+        prev = nxt
+    return tables
+
+
+_FROB_TABLES = _host_frob_constants()
+
+
+def _frob_consts_device(n: int):
+    tbl = _FROB_TABLES[n - 1]
+    return [jnp.asarray(F.fq2_from_ints(*g), dtype=jnp.int32) for g in tbl]
+
+
+def fq12_frobenius(f, n: int = 1):
+    """frobⁿ for n in {1, 2, 3}."""
+    if n not in (1, 2, 3):
+        raise ValueError("frobenius power must be 1..3")
+    consts = _frob_consts_device(n)
+    (f0, f1, f2), (f3, f4, f5) = f
+    coeffs = [f0, f1, f2, f3, f4, f5]
+    if n % 2 == 1:
+        coeffs = [fq2_conj(x) for x in coeffs]
+    out = [F.fq2_mul(x, g) for x, g in zip(coeffs, consts)]
+    return ((out[0], out[1], out[2]), (out[3], out[4], out[5]))
+
+
+def fq12_is_one(f):
+    """Canonical-form equality with 1 (Montgomery one in slot 0)."""
+    one = fq12_one_like(f[0][0])
+    ok = jnp.ones(f[0][0].shape[:-2], dtype=bool)
+    for fa, fb in zip(f, one):
+        for ca, cb in zip(fa, fb):
+            ok = jnp.logical_and(ok, jnp.all(ca == cb, axis=(-1, -2)))
+    return ok
+
+
+# -- host <-> device conversion ---------------------------------------------
+
+
+def fq12_to_device(x) -> tuple:
+    """Host: python fq12 nested-int tuples -> device limb arrays."""
+    (g, h) = x
+    return (tuple(jnp.asarray(F.fq2_from_ints(*c)) for c in g),
+            tuple(jnp.asarray(F.fq2_from_ints(*c)) for c in h))
+
+
+def fq12_from_device(f, idx=()) -> tuple:
+    """Host: device fq12 (optionally indexed into the batch) -> python ints."""
+    def conv(c):
+        arr = np.asarray(c)[idx] if idx != () else np.asarray(c)
+        return F.fq2_to_ints(arr)
+    (g, h) = f
+    return (tuple(conv(c) for c in g), tuple(conv(c) for c in h))

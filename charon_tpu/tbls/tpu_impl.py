@@ -1,0 +1,39 @@
+"""TPU backend for the tbls facade — the north-star offload.
+
+Routes the duty pipeline's hot calls (threshold aggregation now; batched
+pairing verification as ops/pairing.py lands) onto batched JAX kernels, while
+delegating the remaining operations to the CPU oracle. Feature-gated via
+charon_tpu.utils.featureset.TPU_BLS in app wiring, mirroring how the reference
+gates backends behind tbls.SetImplementation + app/featureset
+(reference tbls/tbls.go:72, featureset.go:10-75).
+
+Outputs are bit-identical to PythonImpl: both compute Σ λᵢ·sigᵢ exactly and
+use the same ETH serialization; the cross-implementation randomized test suite
+(reference tbls/tbls_test.go:210-240) holds across the pair.
+"""
+
+from __future__ import annotations
+
+from ..ops.aggregate import threshold_aggregate_batch as _device_aggregate
+from .python_impl import PythonImpl
+from .types import PrivateKey, PublicKey, Signature
+
+
+class TPUImpl(PythonImpl):
+    """tbls Implementation running batched ops on the JAX device."""
+
+    name = "jax-tpu"
+
+    def threshold_aggregate(self, partial_sigs: dict[int, Signature]) -> Signature:
+        return self.threshold_aggregate_batch([partial_sigs])[0]
+
+    def threshold_aggregate_batch(self, batches: list[dict[int, Signature]]
+                                  ) -> list[Signature]:
+        if not batches:
+            return []
+        for b in batches:
+            if not b:
+                raise ValueError("no partial signatures to aggregate")
+        raw = _device_aggregate([{i: bytes(s) for i, s in b.items()}
+                                 for b in batches])
+        return [Signature(r) for r in raw]

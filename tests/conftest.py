@@ -1,13 +1,20 @@
 """Test configuration: force JAX onto a virtual 8-device CPU mesh so
 multi-chip sharding paths are exercised without TPU hardware (the driver
 separately dry-runs the multichip path; real-TPU benchmarking happens in
-bench.py)."""
+bench.py).
+
+Note: the axon TPU plugin in this image overrides the JAX_PLATFORMS env var,
+so the platform must be forced via jax.config before any backend init.
+"""
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 xla_flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in xla_flags:
     os.environ["XLA_FLAGS"] = (
         xla_flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

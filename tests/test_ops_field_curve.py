@@ -1,0 +1,137 @@
+"""Device (JAX) field/curve kernel tests against the pure-Python oracle
+(reference pattern: tbls cross-implementation tests, tbls/tbls_test.go:210).
+
+Runs on the CPU backend (conftest forces JAX_PLATFORMS=cpu with 8 virtual
+devices); bench.py exercises the same kernels on the real TPU chip.
+"""
+
+import random
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from charon_tpu.crypto import curve as PC
+from charon_tpu.crypto import fields as PF
+from charon_tpu.ops import curve as DC
+from charon_tpu.ops import field as DF
+
+pytestmark = pytest.mark.ops
+
+random.seed(42)
+
+
+def _rand_fq(n):
+    return [random.randrange(DF.P_INT) for _ in range(n)]
+
+
+def _to_dev(vals):
+    return jnp.asarray(np.stack([DF.fq_from_int(v) for v in vals]))
+
+
+class TestFieldOps:
+    def test_mont_mul_random_and_edges(self):
+        xs = _rand_fq(6) + [0, 1, DF.P_INT - 1]
+        ys = _rand_fq(6) + [DF.P_INT - 1, DF.P_INT - 1, DF.P_INT - 1]
+        r = jax.jit(DF.fq_mont_mul)(_to_dev(xs), _to_dev(ys))
+        for i, (x, y) in enumerate(zip(xs, ys)):
+            assert DF.fq_to_int(np.asarray(r[i])) == (x * y) % DF.P_INT
+
+    def test_add_sub_neg(self):
+        xs, ys = _rand_fq(8), _rand_fq(8)
+        ax, by = _to_dev(xs), _to_dev(ys)
+        r = jax.jit(DF.fq_add)(ax, by)
+        s = jax.jit(DF.fq_sub)(ax, by)
+        n = jax.jit(DF.fq_neg)(ax)
+        for i in range(8):
+            assert DF.fq_to_int(np.asarray(r[i])) == (xs[i] + ys[i]) % DF.P_INT
+            assert DF.fq_to_int(np.asarray(s[i])) == (xs[i] - ys[i]) % DF.P_INT
+            assert DF.fq_to_int(np.asarray(n[i])) == (-xs[i]) % DF.P_INT
+
+    def test_fq2_mul_sqr(self):
+        a = [(random.randrange(DF.P_INT), random.randrange(DF.P_INT)) for _ in range(6)]
+        b = [(random.randrange(DF.P_INT), random.randrange(DF.P_INT)) for _ in range(6)]
+        a2 = jnp.asarray(np.stack([DF.fq2_from_ints(*v) for v in a]))
+        b2 = jnp.asarray(np.stack([DF.fq2_from_ints(*v) for v in b]))
+        r = jax.jit(DF.fq2_mul)(a2, b2)
+        s = jax.jit(DF.fq2_sqr)(a2)
+        for i in range(6):
+            assert DF.fq2_to_ints(np.asarray(r[i])) == PF.fq2_mul(a[i], b[i])
+            assert DF.fq2_to_ints(np.asarray(s[i])) == PF.fq2_sqr(a[i])
+
+
+def _affine(pt):
+    return PC.to_affine(PC.Fq2Ops, pt)
+
+
+class TestCurveOps:
+    @classmethod
+    def setup_class(cls):
+        g2 = PC.g2_generator()
+        cls.pts = [PC.jac_mul(PC.Fq2Ops, g2, random.randrange(DF.R_INT))
+                   for _ in range(4)]
+        cls.P = tuple(
+            jnp.asarray(np.stack([DC.g2_point_to_device(p)[k] for p in cls.pts]))
+            for k in range(3))
+
+    def _dev_affine(self, R, i):
+        return _affine(DC.g2_point_from_device(R[0][i], R[1][i], R[2][i]))
+
+    def test_double_add_match_oracle(self):
+        D = jax.jit(lambda p: DC.double(DC.FQ2_OPS, p))(self.P)
+        A = jax.jit(lambda p, q: DC.add_unified(DC.FQ2_OPS, p, q))(
+            self.P, tuple(jnp.roll(c, 1, axis=0) for c in self.P))
+        for i in range(4):
+            assert self._dev_affine(D, i) == _affine(
+                PC.jac_add(PC.Fq2Ops, self.pts[i], self.pts[i]))
+            assert self._dev_affine(A, i) == _affine(
+                PC.jac_add(PC.Fq2Ops, self.pts[i], self.pts[(i - 1) % 4]))
+
+    def test_add_exceptional_cases(self):
+        jadd = jax.jit(lambda p, q: DC.add_unified(DC.FQ2_OPS, p, q))
+        # P + P -> double; P + (-P) -> infinity; inf + P -> P.
+        A = jadd(self.P, self.P)
+        for i in range(4):
+            assert self._dev_affine(A, i) == _affine(
+                PC.jac_add(PC.Fq2Ops, self.pts[i], self.pts[i]))
+        negP = (self.P[0], jax.jit(DF.fq2_neg)(self.P[1]), self.P[2])
+        A = jadd(self.P, negP)
+        assert bool(jnp.all(DC.is_infinity(DC.FQ2_OPS, A)))
+        inf = DC.infinity_like(DC.FQ2_OPS, self.P[0])
+        A = jadd(inf, self.P)
+        for i in range(4):
+            assert self._dev_affine(A, i) == _affine(self.pts[i])
+
+    def test_scalar_mul_matches_oracle(self):
+        scalars = [random.randrange(DF.R_INT) for _ in range(4)]
+        bits = jnp.asarray(np.stack([DC.scalar_to_bits(s) for s in scalars]))
+        R = jax.jit(lambda p, b: DC.scalar_mul(DC.FQ2_OPS, p, b))(self.P, bits)
+        for i in range(4):
+            assert self._dev_affine(R, i) == _affine(
+                PC.jac_mul(PC.Fq2Ops, self.pts[i], scalars[i]))
+
+
+class TestAggregateKernel:
+    def test_threshold_aggregate_batch_bit_identical(self):
+        """Device aggregation == CPU oracle, byte-for-byte (the north-star
+        bit-identity requirement)."""
+        from charon_tpu import tbls
+        from charon_tpu.tbls.python_impl import PythonImpl
+        from charon_tpu.tbls.tpu_impl import TPUImpl
+
+        cpu, tpu = PythonImpl(), TPUImpl()
+        msg = b"\x17" * 32
+        batches = []
+        for _ in range(3):
+            sk = cpu.generate_secret_key()
+            shares = cpu.threshold_split(sk, 5, 3)
+            ids = sorted(random.sample(sorted(shares), 3))
+            batches.append({i: cpu.sign(shares[i], msg) for i in ids})
+        want = cpu.threshold_aggregate_batch(batches)
+        got = tpu.threshold_aggregate_batch(batches)
+        assert [bytes(g) for g in got] == [bytes(w) for w in want]
+
+        # Single aggregate too, and it verifies against the root pubkey.
+        single = tpu.threshold_aggregate(batches[0])
+        assert bytes(single) == bytes(want[0])
