@@ -23,8 +23,13 @@ class MemDB:
     """reference aggsigdb.NewMemDB; Store memory.go:44, Await memory.go:86."""
 
     def __init__(self, deadliner: Deadliner | None = None):
-        self._data: dict[tuple[Duty, PubKey], SignedData] = {}
-        self._waiters: dict[tuple[Duty, PubKey], list[asyncio.Future]] = {}
+        # (duty, pubkey) -> message_root -> SignedData. Most duties have one
+        # aggregate per validator; selection duties can have several (one per
+        # subcommittee), each keyed by its payload root.
+        self._data: dict[tuple[Duty, PubKey], dict[bytes, SignedData]] = {}
+        # Waiter key includes the awaited root, or None for "any/first".
+        self._waiters: dict[tuple[Duty, PubKey, bytes | None],
+                            list[asyncio.Future]] = {}
         self._deadliner = deadliner
 
     async def run_gc(self) -> None:
@@ -32,7 +37,14 @@ class MemDB:
             return
         async for duty in self._deadliner.expired():
             self._data = {k: v for k, v in self._data.items() if k[0] != duty}
-            self._waiters = {k: v for k, v in self._waiters.items() if k[0] != duty}
+            for key in [k for k in self._waiters if k[0] == duty]:
+                # Fail (don't abandon) awaits whose aggregate never arrived —
+                # a hanging future would wedge its caller forever.
+                for fut in self._waiters.pop(key):
+                    if not fut.done():
+                        fut.set_exception(errors.new(
+                            "duty expired awaiting aggregate signature",
+                            duty=str(duty)))
 
     async def store(self, duty: Duty, signed: SignedDataSet) -> None:
         """Store aggregates, resolving blocked awaits (memory.go:44)."""
@@ -41,22 +53,34 @@ class MemDB:
             return
         for pubkey, data in signed.items():
             key = (duty, pubkey)
-            existing = self._data.get(key)
+            root = data.message_root()
+            by_root = self._data.setdefault(key, {})
+            existing = by_root.get(root)
             if existing is not None:
                 if bytes(existing.signature()) != bytes(data.signature()):
                     raise errors.new("conflicting aggregate signature",
                                      duty=str(duty), pubkey=pubkey[:10])
                 continue
-            self._data[key] = data.clone()
-            for fut in self._waiters.pop(key, []):
-                if not fut.done():
-                    fut.set_result(data.clone())
+            by_root[root] = data.clone()
+            for waiter_root in (root, None):
+                for fut in self._waiters.pop((duty, pubkey, waiter_root), []):
+                    if not fut.done():
+                        fut.set_result(data.clone())
 
-    async def await_(self, duty: Duty, pubkey: PubKey) -> SignedData:
-        """Block until the aggregate for (duty, pubkey) exists (memory.go:86)."""
-        key = (duty, pubkey)
-        if key in self._data:
-            return self._data[key].clone()
+    async def await_(self, duty: Duty, pubkey: PubKey,
+                     root: bytes | None = None) -> SignedData:
+        """Block until an aggregate for (duty, pubkey) exists (memory.go:86).
+
+        With `root`, waits for the aggregate over that specific payload —
+        required for selection duties where one validator aggregates several
+        payloads (e.g. per sync subcommittee); without it, the first/only
+        aggregate resolves the await."""
+        by_root = self._data.get((duty, pubkey))
+        if by_root:
+            if root is None:
+                return next(iter(by_root.values())).clone()
+            if root in by_root:
+                return by_root[root].clone()
         fut = asyncio.get_running_loop().create_future()
-        self._waiters.setdefault(key, []).append(fut)
+        self._waiters.setdefault((duty, pubkey, root), []).append(fut)
         return await fut

@@ -1,0 +1,595 @@
+"""Consensus component — ties the generic QBFT algorithm to duties
+(reference core/consensus/component.go).
+
+One QBFT instance per Duty. The consensus value is the 32-byte hash of the
+canonical encoding of the proposed UnsignedDataSet; actual payloads travel
+alongside messages in a hash-keyed values map (reference component.go:311-318,
+values carried as protobuf Anys). Every wire message is signed with the
+node's secp256k1 identity key and verified against the sending peer's pubkey
+(reference verifyMsg component.go:600). Round timers are pluggable:
+increasing (750ms + 250ms/round) or eager-double-linear, A/B-testable
+(reference roundtimer.go:17-43). A sniffer records full instances for
+debugging (/debug/qbft, reference component.go:449-455).
+
+Propose() vs Participate(): proposing supplies this node's value and runs the
+instance; participating eagerly starts the instance (for eager timers) so
+late proposals still join a synchronized round schedule.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import dataclasses
+import hashlib
+import json
+import time as time_mod
+from dataclasses import dataclass, field
+from typing import Awaitable, Callable
+
+from ..utils import aio, errors, k1util, log, metrics
+from . import qbft
+from .deadline import Deadliner
+from .gater import DutyGaterFunc
+from .types import (
+    Duty,
+    DutyType,
+    UnsignedDataSet,
+    decode_unsigned,
+    encode_unsigned,
+)
+
+_log = log.with_topic("consensus")
+
+PROTOCOL_ID = "/charon/consensus/qbft/2.0.0"
+
+_decided_rounds = metrics.gauge(
+    "core_consensus_decided_rounds", "Round consensus decided at",
+    ("duty", "timer"))
+_consensus_duration = metrics.histogram(
+    "core_consensus_duration_seconds", "Duration of consensus instances",
+    ("duty", "timer"))
+_consensus_timeout = metrics.counter(
+    "core_consensus_timeout_total", "Consensus timeouts", ("duty", "timer"))
+_consensus_error = metrics.counter(
+    "core_consensus_error_total", "Consensus errors", ())
+
+RECV_BUFFER = 100  # buffered inbound messages per instance (component.go:29)
+
+
+def leader(duty: Duty, round_: int, nodes: int) -> int:
+    """Deterministic leader election (reference component.go:745)."""
+    return (duty.slot + int(duty.type) + round_) % nodes
+
+
+# ---------------------------------------------------------------------------
+# Round timers (reference core/consensus/roundtimer.go)
+# ---------------------------------------------------------------------------
+
+INC_ROUND_START = 0.75
+INC_ROUND_INCREASE = 0.25
+LINEAR_ROUND_INC = 1.0
+
+
+class IncreasingRoundTimer:
+    """Round r times out after 750ms + r*250ms (reference roundtimer.go:60)."""
+
+    type = "inc"
+    eager = False
+
+    def new_timer(self, round_: int):
+        duration = INC_ROUND_START + round_ * INC_ROUND_INCREASE
+
+        async def wait():
+            await asyncio.sleep(duration)
+
+        return wait, lambda: None
+
+
+class DoubleEagerLinearRoundTimer:
+    """Linear r*1s rounds anchored at absolute first-seen deadlines; a round
+    restarted (justified pre-prepare) doubles instead of resetting, keeping
+    all peers' round end-times aligned (reference roundtimer.go:99-149)."""
+
+    type = "eager_dlinear"
+    eager = True
+
+    def __init__(self, clock: Callable[[], float] = time_mod.monotonic):
+        self._clock = clock
+        self._first_deadlines: dict[int, float] = {}
+
+    def new_timer(self, round_: int):
+        linear = round_ * LINEAR_ROUND_INC
+        first = self._first_deadlines.get(round_)
+        if first is not None:
+            deadline = first + linear
+        else:
+            deadline = self._clock() + linear
+            self._first_deadlines[round_] = deadline
+        duration = max(deadline - self._clock(), 0.0)
+
+        async def wait():
+            await asyncio.sleep(duration)
+
+        return wait, lambda: None
+
+
+def default_timer_func(duty: Duty):
+    return IncreasingRoundTimer()
+
+
+def ab_timer_func(duty: Duty):
+    """A/B test timers deterministically by duty (reference
+    roundtimer.go:27-38 getTimerFunc under QBFTTimersABTest)."""
+    pick = (duty.slot + int(duty.type)) % 2
+    return [IncreasingRoundTimer, DoubleEagerLinearRoundTimer][pick]()
+
+
+# ---------------------------------------------------------------------------
+# Wire codec + signatures (reference core/consensus/msg.go, transport.go)
+# ---------------------------------------------------------------------------
+
+
+def _hx(b: bytes | None) -> str:
+    return b.hex() if b else ""
+
+
+def _unhx(s: str) -> bytes | None:
+    return bytes.fromhex(s) if s else None
+
+
+def hash_value(value_json: dict) -> bytes:
+    """Canonical hash of an encoded value (the reference hashes the proto;
+    here: sha256 over sorted-key compact JSON)."""
+    blob = json.dumps(value_json, sort_keys=True, separators=(",", ":"))
+    return hashlib.sha256(blob.encode()).digest()
+
+
+def _msg_digest(m: qbft.Msg) -> bytes:
+    """Digest signed by the sender (covers all message fields)."""
+    blob = json.dumps([
+        "charon_tpu/consensus/1", int(m.type), m.instance.slot,
+        int(m.instance.type), m.source, m.round, _hx(m.value),
+        m.prepared_round, _hx(m.prepared_value),
+    ], separators=(",", ":"))
+    return hashlib.sha256(blob.encode()).digest()
+
+
+def _encode_qbft_msg(m: qbft.Msg, sig: bytes) -> dict:
+    return {
+        "type": int(m.type), "slot": m.instance.slot,
+        "duty_type": int(m.instance.type), "source": m.source,
+        "round": m.round, "value": _hx(m.value),
+        "pr": m.prepared_round, "pv": _hx(m.prepared_value),
+        "sig": sig.hex(),
+    }
+
+
+def _decode_qbft_msg(obj: dict, justification=()) -> tuple[qbft.Msg, bytes]:
+    duty = Duty(int(obj["slot"]), DutyType(int(obj["duty_type"])))
+    m = qbft.Msg(
+        type=qbft.MsgType(int(obj["type"])), instance=duty,
+        source=int(obj["source"]), round=int(obj["round"]),
+        value=_unhx(obj["value"]), prepared_round=int(obj["pr"]),
+        prepared_value=_unhx(obj["pv"]), justification=tuple(justification))
+    return m, bytes.fromhex(obj["sig"])
+
+
+def encode_wire(m: qbft.Msg, privkey: bytes, own_idx: int,
+                values: dict[bytes, dict],
+                sig_cache: dict[qbft.Msg, bytes]) -> dict:
+    """Sign and encode a consensus message + justification + value payloads
+    (reference transport.go:168-205; nested justifications are dropped).
+
+    Relayed justification messages (e.g. peers' PREPAREs inside our
+    ROUND-CHANGE) must carry their *original* signatures — we cannot sign
+    for other sources — so receivers' verified signatures are cached per
+    instance and looked up here."""
+    just = []
+    for j in m.justification:
+        sig = sig_cache.get(j)
+        if sig is None:
+            if j.source != own_idx:
+                raise errors.new("missing signature for relayed justification",
+                                 source=j.source)
+            sig = k1util.sign(privkey, _msg_digest(j))
+            sig_cache[j] = sig
+        just.append(_encode_qbft_msg(j, sig))
+    wire_values = {}
+    for h in (m.value, m.prepared_value, *(j.value for j in m.justification),
+              *(j.prepared_value for j in m.justification)):
+        if h is not None and h in values:
+            wire_values[h.hex()] = values[h]
+    return {
+        "msg": _encode_qbft_msg(m, k1util.sign(privkey, _msg_digest(m))),
+        "justification": just,
+        "values": wire_values,
+    }
+
+
+def decode_and_verify_wire(obj: dict, pubkeys: dict[int, bytes],
+                           gater: DutyGaterFunc | None = None,
+                           sig_cache: dict[qbft.Msg, bytes] | None = None,
+                           ) -> tuple[qbft.Msg, dict[bytes, dict]]:
+    """Decode an inbound wire message, verifying the outer and every
+    justification signature against the claimed source's identity key
+    (reference verifyMsg component.go:600, newMsg msg.go:19-62). Verified
+    signatures land in sig_cache so they can be relayed onward."""
+    raw = obj.get("msg") or {}
+    if not qbft.MsgType(int(raw.get("type", 0))).valid:
+        raise errors.new("invalid consensus message type")
+    if not DutyType(int(raw.get("duty_type", 0))).valid:
+        raise errors.new("invalid consensus message duty type")
+    just_msgs = []
+    for jobj in obj.get("justification", ()):
+        jm, jsig = _decode_qbft_msg(jobj)
+        _check_sig(jm, jsig, pubkeys)
+        if sig_cache is not None:
+            sig_cache[jm] = jsig
+        just_msgs.append(jm)
+    m, sig = _decode_qbft_msg(raw, tuple(just_msgs))
+    _check_sig(m, sig, pubkeys)
+    if sig_cache is not None:
+        # Cache the bare (justification-free) form: that is the shape in
+        # which this message would be relayed as evidence later.
+        sig_cache[dataclasses.replace(m, justification=())] = sig
+    if gater is not None and not gater(m.instance):
+        raise errors.new("gated consensus duty", duty=str(m.instance))
+    values = {bytes.fromhex(h): v for h, v in (obj.get("values") or {}).items()}
+    for h, v in values.items():
+        if hash_value(v) != h:
+            raise errors.new("value hash mismatch")
+    return m, values
+
+
+def _check_sig(m: qbft.Msg, sig: bytes, pubkeys: dict[int, bytes]) -> None:
+    pk = pubkeys.get(m.source)
+    if pk is None:
+        raise errors.new("unknown consensus message source", source=m.source)
+    if not k1util.verify(pk, _msg_digest(m), sig):
+        raise errors.new("invalid consensus message signature",
+                         source=m.source)
+
+
+# ---------------------------------------------------------------------------
+# Sniffer (reference component.go:449-455, app/qbftdebug.go)
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class SniffedInstance:
+    duty: Duty
+    nodes: int
+    peer_idx: int
+    started_at: float
+    msgs: list[dict] = field(default_factory=list)
+
+
+class Sniffer:
+    """Records full consensus instances for debugging; served gzipped at
+    /debug/qbft by the monitoring API."""
+
+    def __init__(self, keep: int = 32):
+        self._keep = keep
+        self.instances: list[SniffedInstance] = []
+
+    def new_instance(self, duty: Duty, nodes: int, peer_idx: int) -> SniffedInstance:
+        inst = SniffedInstance(duty, nodes, peer_idx, time_mod.time())
+        self.instances.append(inst)
+        del self.instances[: -self._keep]
+        return inst
+
+    def to_json(self) -> list[dict]:
+        return [{
+            "duty": str(i.duty), "nodes": i.nodes, "peer_idx": i.peer_idx,
+            "started_at": i.started_at, "msgs": i.msgs,
+        } for i in self.instances]
+
+
+# ---------------------------------------------------------------------------
+# The component
+# ---------------------------------------------------------------------------
+
+
+class _InstanceIO:
+    """Async inputs/outputs of one consensus instance (reference
+    component.go:129-193 instanceIO: once-semantics on participate/propose/
+    run, buffered receive, value/hash futures)."""
+
+    def __init__(self) -> None:
+        loop = asyncio.get_running_loop()
+        self.participated = False
+        self.proposed = False
+        self.running = False
+        # Unbounded: the qbft loop is both a producer (self-delivery) and the
+        # sole consumer — a bounded queue would deadlock broadcast when full.
+        # Peer-message flooding is capped explicitly in Component._handle.
+        self.recv: asyncio.Queue = asyncio.Queue()
+        self.hash_fut: asyncio.Future = loop.create_future()
+        self.values: dict[bytes, dict] = {}  # hash -> encoded value payload
+        self.done_fut: asyncio.Future = loop.create_future()
+        self.decided_at: float | None = None
+        self.qbft_task: asyncio.Task | None = None
+        self.sig_cache: dict[qbft.Msg, bytes] = {}
+
+    def mark_participated(self) -> None:
+        if self.participated:
+            raise errors.new("already participated")
+        self.participated = True
+
+    def mark_proposed(self) -> None:
+        if self.proposed:
+            raise errors.new("already proposed")
+        self.proposed = True
+
+    def maybe_start(self) -> bool:
+        if self.running:
+            return False
+        self.running = True
+        return True
+
+
+class Component:
+    """QBFT consensus component (reference consensus.New component.go:195).
+
+    transport: object with `register(handler)` + `async broadcast(wire_dict)`
+    delivering to all *other* peers (self-delivery is done internally).
+    """
+
+    def __init__(self, transport, peer_idx: int, nodes: int,
+                 privkey: bytes, peer_pubkeys: dict[int, bytes],
+                 deadliner: Deadliner | None, gater: DutyGaterFunc,
+                 timer_func=default_timer_func, sniffer: Sniffer | None = None):
+        self._transport = transport
+        self._peer_idx = peer_idx
+        self._nodes = nodes
+        self._privkey = privkey
+        self._pubkeys = peer_pubkeys
+        self._deadliner = deadliner
+        self._gater = gater
+        self._timer_func = timer_func
+        self._sniffer = sniffer or Sniffer()
+        self._subs: list[Callable[[Duty, UnsignedDataSet], Awaitable[None]]] = []
+        self._instances: dict[Duty, _InstanceIO] = {}
+        self._raw_subs: list[Callable[[Duty, dict], Awaitable[None]]] = []
+        transport.register(self._handle)
+
+    @property
+    def sniffer(self) -> Sniffer:
+        return self._sniffer
+
+    def subscribe(self, fn) -> None:
+        """Subscribe to decided UnsignedDataSets (→ DutyDB.store)."""
+        self._subs.append(fn)
+
+    def subscribe_priority(self, fn) -> None:
+        """Subscribe to decided priority-protocol payloads (reference
+        component.go:278 SubscribePriority); fn(duty, raw_value_dict)."""
+        self._raw_subs.append(fn)
+
+    async def run_trim(self) -> None:
+        """GC instance state as duties expire, cancelling still-running qbft
+        event loops (reference Start component.go:295-304; instances live
+        until their duty deadline so late peers get DECIDED replies)."""
+        if self._deadliner is None:
+            return
+        async for duty in self._deadliner.expired():
+            inst = self._instances.pop(duty, None)
+            if inst is None:
+                continue
+            if inst.qbft_task is not None and not inst.qbft_task.done():
+                inst.qbft_task.cancel()
+            if not inst.done_fut.done():
+                # Release anyone still awaiting this instance.
+                inst.done_fut.set_result("failed")
+            if inst.running and inst.decided_at is None:
+                _consensus_timeout.inc(str(duty.type),
+                                       self._timer_func(duty).type)
+
+    # -- inputs ---------------------------------------------------------------
+
+    async def propose(self, duty: Duty, data: UnsignedDataSet) -> None:
+        """Propose our value; runs the instance if not already running and
+        waits for completion (reference Propose component.go:311)."""
+        value_json = {pk: encode_unsigned(v) for pk, v in data.items()}
+        await self._propose_raw(duty, value_json)
+
+    async def propose_priority(self, duty: Duty, value_json: dict) -> None:
+        """Propose a raw (non-UnsignedDataSet) payload, e.g. the priority
+        protocol's result (reference ProposePriority component.go:325)."""
+        await self._propose_raw(duty, {"__priority__": value_json})
+
+    async def _propose_raw(self, duty: Duty, value_json: dict) -> None:
+        h = hash_value(value_json)
+        inst = self._instance(duty)
+        inst.mark_proposed()
+        inst.values[h] = value_json
+        if not inst.hash_fut.done():
+            inst.hash_fut.set_result(h)
+        proposed_at = time_mod.monotonic()
+        if inst.maybe_start():
+            await self._run_instance(duty, inst)
+        elif await inst.done_fut != "decided":
+            raise errors.new("consensus failed", duty=str(duty))
+        if inst.decided_at is not None:
+            timer = self._timer_func(duty)
+            _consensus_duration.observe(
+                time_mod.monotonic() - proposed_at,
+                str(duty.type), timer.type)
+
+    async def participate(self, duty: Duty) -> None:
+        """Eagerly start the instance before our value is known
+        (reference Participate component.go:380)."""
+        if duty.type in (DutyType.AGGREGATOR, DutyType.SYNC_CONTRIBUTION):
+            return  # no eager consensus for potential no-op duties
+        timer = self._timer_func(duty)
+        if not timer.eager:
+            return
+        inst = self._instance(duty)
+        inst.mark_participated()
+        if inst.maybe_start():
+            await self._run_instance(duty, inst)
+
+    # -- the instance ---------------------------------------------------------
+
+    def _instance(self, duty: Duty) -> _InstanceIO:
+        inst = self._instances.get(duty)
+        if inst is None:
+            inst = self._instances[duty] = _InstanceIO()
+        return inst
+
+    async def _run_instance(self, duty: Duty, inst: _InstanceIO) -> None:
+        """Run one qbft instance to completion (reference runInstance
+        component.go:405)."""
+        if self._deadliner is not None and not self._deadliner.add(duty):
+            _log.warn("skipping consensus for expired duty", duty=str(duty))
+            if not inst.done_fut.done():
+                inst.done_fut.set_result("failed")
+            return
+        timer = self._timer_func(duty)
+        sniffed = self._sniffer.new_instance(duty, self._nodes, self._peer_idx)
+
+        def decide(instance, value_hash, qcommit) -> None:
+            inst.decided_at = time_mod.monotonic()
+            _decided_rounds.set(qcommit[0].round, str(duty.type), timer.type)
+            value_json = inst.values.get(value_hash)
+            if value_json is None:
+                _log.error("decided value not in instance values",
+                           duty=str(duty))
+                if not inst.done_fut.done():
+                    inst.done_fut.set_result("failed")
+                return
+            if not inst.done_fut.done():
+                inst.done_fut.set_result("decided")
+            aio.spawn(self._notify(duty, value_json),
+                      name=f"consensus-decide-{duty}")
+
+        definition = qbft.Definition(
+            is_leader=lambda inst_, r, p: leader(inst_, r, self._nodes) == p,
+            new_timer=timer.new_timer,
+            decide=decide,
+            nodes=self._nodes,
+            log_upon_rule=lambda *a: sniffed.msgs.append(
+                {"event": "rule", "rule": str(a[-1]), "t": time_mod.time()}),
+        )
+
+        async def broadcast(m: qbft.Msg) -> None:
+            wire = encode_wire(m, self._privkey, self._peer_idx, inst.values,
+                               inst.sig_cache)
+            sniffed.msgs.append({"event": "send", "type": int(m.type),
+                                 "round": m.round, "t": time_mod.time()})
+            # Deliver to self directly (the algorithm expects its own
+            # messages back) and to all peers via the transport.
+            inst.recv.put_nowait(m)
+            await self._transport.broadcast(wire)
+
+        transport = qbft.Transport(broadcast, inst.recv)
+        # The qbft event loop never returns on its own: after deciding it
+        # keeps answering late peers' ROUND-CHANGEs with DECIDED until the
+        # duty deadline cancels it (reference: runInstance blocks until the
+        # duty context closes). Run it as a task; the caller is released as
+        # soon as the instance decides.
+        inst.qbft_task = aio.spawn(
+            qbft.run(definition, transport, duty, self._peer_idx,
+                     inst.hash_fut),
+            name=f"qbft-{duty}")
+        done, _ = await asyncio.wait({inst.qbft_task, inst.done_fut},
+                                     return_when=asyncio.FIRST_COMPLETED)
+        if inst.done_fut in done:
+            if inst.done_fut.result() == "decided":
+                return
+            raise errors.new("consensus failed", duty=str(duty))
+        if not inst.done_fut.done():
+            inst.done_fut.set_result("failed")
+        if inst.qbft_task.cancelled():
+            raise errors.new("consensus timeout", duty=str(duty))
+        exc = inst.qbft_task.exception()
+        _consensus_error.inc()
+        raise errors.wrap(exc or errors.new("qbft loop exited"),
+                          "consensus instance failed", duty=str(duty))
+
+    async def _notify(self, duty: Duty, value_json: dict) -> None:
+        if "__priority__" in value_json:
+            for fn in self._raw_subs:
+                await fn(duty, value_json["__priority__"])
+            return
+        unsigned: UnsignedDataSet = {
+            pk: decode_unsigned(v) for pk, v in value_json.items()}
+        for fn in self._subs:
+            try:
+                await fn(duty, {k: v.clone() for k, v in unsigned.items()})
+            except Exception as exc:  # noqa: BLE001 — subscriber isolation
+                _log.error("consensus subscriber failed", err=exc,
+                           duty=str(duty))
+
+    # -- inbound --------------------------------------------------------------
+
+    async def _handle(self, wire: dict) -> None:
+        """Inbound wire message: verify signatures, gate, route to (or
+        buffer-start) the duty's instance (reference handle
+        component.go:483-548)."""
+        try:
+            sig_cache: dict[qbft.Msg, bytes] = {}
+            m, values = decode_and_verify_wire(wire, self._pubkeys,
+                                               self._gater, sig_cache)
+        except Exception as exc:  # noqa: BLE001 — invalid peer msg dropped
+            _log.warn("dropping invalid consensus message", err=exc)
+            return
+        if self._deadliner is not None and not self._deadliner.add(m.instance):
+            return
+        inst = self._instance(m.instance)
+        inst.sig_cache.update(sig_cache)
+        inst.values.update(values)
+        # DoS cap on peer traffic (reference recvBuffer component.go:29);
+        # self-delivered messages bypass this inside the instance.
+        if inst.recv.qsize() >= RECV_BUFFER:
+            _log.warn("consensus receive buffer full; dropping",
+                      duty=str(m.instance), source=m.source)
+            return
+        inst.recv.put_nowait(m)
+        # A peer started consensus before us: start our instance eagerly so
+        # we participate even before our Propose (reference handle starts
+        # instances on first message receipt via Participate/Propose racing).
+        if inst.maybe_start():
+            aio.spawn(self._run_instance_logged(m.instance, inst),
+                      name=f"consensus-{m.instance}")
+
+    async def _run_instance_logged(self, duty: Duty, inst: _InstanceIO) -> None:
+        try:
+            await self._run_instance(duty, inst)
+        except Exception as exc:  # noqa: BLE001 — background instance
+            _log.warn("consensus instance ended with error", err=exc,
+                      duty=str(duty))
+
+
+class MemTransport:
+    """In-memory consensus fabric for tests: broadcast delivers the wire dict
+    to every *other* registered node (self-delivery happens inside the
+    component)."""
+
+    def __init__(self):
+        self._handlers: list = []
+
+    def endpoint(self):
+        t = _MemEndpoint(self)
+        return t
+
+    def _broadcast(self, from_ep, wire: dict) -> None:
+        for ep in self._handlers:
+            if ep is from_ep:
+                continue
+            if ep.handler is not None:
+                aio.spawn(ep.handler(json.loads(json.dumps(wire))),
+                          name="consensus-mem-deliver")
+
+
+class _MemEndpoint:
+    def __init__(self, fabric: MemTransport):
+        self._fabric = fabric
+        self.handler = None
+        fabric._handlers.append(self)
+
+    def register(self, handler) -> None:
+        self.handler = handler
+
+    async def broadcast(self, wire: dict) -> None:
+        self._fabric._broadcast(self, wire)

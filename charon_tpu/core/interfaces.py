@@ -17,7 +17,7 @@ from __future__ import annotations
 
 from typing import Any, Awaitable, Callable, Protocol, runtime_checkable
 
-from ..utils import log, retry, tracer
+from ..utils import aio, log, retry, tracer
 from .types import (
     Duty,
     DutyDefinitionSet,
@@ -180,7 +180,36 @@ def wire(
             fn = opt.wrap(component, fn)
         return fn
 
-    scheduler.subscribe_duties(wrapped("fetcher", fetcher.fetch))
+    # The scheduler→fetcher boundary MUST be asynchronous: fetching a
+    # PROPOSER duty blocks awaiting the aggregated randao, which only arrives
+    # via pipeline steps driven by *later* scheduler ticks — awaiting the
+    # fetch inside the tick loop deadlocks. WithAsyncRetry provides the
+    # decoupling (with retries); without it, spawn the fetch as a background
+    # task so a live pipeline can never wedge the ticker.
+    fetch = wrapped("fetcher", fetcher.fetch)
+    if not any(isinstance(opt, WithAsyncRetry) for opt in options):
+        inner_fetch = fetch
+
+        async def fetch(duty: Duty, defset):  # noqa: F811 — async boundary
+            aio.spawn(inner_fetch(duty, defset), name=f"fetch-{duty}")
+
+    scheduler.subscribe_duties(fetch)
+
+    # Eager consensus participation: start instances at duty time so all
+    # peers' round schedules align even before values are fetched
+    # (reference interfaces.go wiring of consensus.Participate). Like the
+    # fetch boundary above, participate blocks until the instance completes,
+    # so it must never run inline in the scheduler's tick loop.
+    participate = wrapped("consensus_participate",
+                          lambda duty, _defset: consensus.participate(duty))
+    if not any(isinstance(opt, WithAsyncRetry) for opt in options):
+        inner_participate = participate
+
+        async def participate(duty: Duty, defset):  # noqa: F811
+            aio.spawn(inner_participate(duty, defset),
+                      name=f"participate-{duty}")
+
+    scheduler.subscribe_duties(participate)
     fetcher.subscribe(wrapped("consensus", consensus.propose))
     consensus.subscribe(wrapped("dutydb", dutydb.store))
     validatorapi.subscribe(wrapped("parsigdb_internal", parsigdb.store_internal))

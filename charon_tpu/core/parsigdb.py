@@ -14,12 +14,22 @@ from collections import defaultdict
 
 from ..utils import errors, log, metrics
 from .deadline import Deadliner
-from .types import Duty, ParSignedData, ParSignedDataSet, PubKey
+from .types import Duty, DutyType, ParSignedData, ParSignedDataSet, PubKey
 
 _log = log.with_topic("parsigdb")
 
 _store_counter = metrics.counter(
     "core_parsigdb_store_total", "Partial signatures stored", ("source",))
+
+# Duty types where one validator legitimately signs several distinct payloads
+# per duty — e.g. one SyncCommitteeSelection per subcommittee for the same
+# (slot, PREPARE_SYNC_CONTRIBUTION) duty. For these a second payload from the
+# same share is NOT equivocation; each message root aggregates independently
+# (the reference keys selections per subcommittee).
+MULTI_ROOT_DUTIES = frozenset({
+    DutyType.PREPARE_AGGREGATOR,
+    DutyType.PREPARE_SYNC_CONTRIBUTION,
+})
 
 
 class MemDB:
@@ -28,9 +38,11 @@ class MemDB:
     def __init__(self, threshold: int, deadliner: Deadliner | None = None):
         self._threshold = threshold
         self._deadliner = deadliner
-        # (duty, pubkey) -> share_idx -> ParSignedData
-        self._sigs: dict[tuple[Duty, PubKey], dict[int, ParSignedData]] = defaultdict(dict)
-        self._fired: set[tuple[Duty, PubKey]] = set()
+        # (duty, pubkey) -> (share_idx, message_root) -> ParSignedData
+        self._sigs: dict[tuple[Duty, PubKey],
+                         dict[tuple[int, bytes], ParSignedData]] = defaultdict(dict)
+        # Threshold fires once per (duty, pubkey, message_root).
+        self._fired: set[tuple[Duty, PubKey, bytes]] = set()
         self._internal_subs = []
         self._threshold_subs = []
 
@@ -47,7 +59,7 @@ class MemDB:
         async for duty in self._deadliner.expired():
             for key in [k for k in self._sigs if k[0] == duty]:
                 del self._sigs[key]
-            self._fired = {k for k in self._fired if k[0] != duty}
+            self._fired = {f for f in self._fired if f[0] != duty}
 
     async def store_internal(self, duty: Duty, parsigs: ParSignedDataSet) -> None:
         """Store our own VC's partials and fan out to internal subscribers
@@ -66,55 +78,69 @@ class MemDB:
         await self._fire_threshold(duty, threshold_hits)
 
     async def _store(self, duty: Duty,
-                     parsigs: ParSignedDataSet) -> dict[PubKey, list[ParSignedData]]:
+                     parsigs: ParSignedDataSet) -> dict[PubKey, list[list[ParSignedData]]]:
         if self._deadliner is not None and not self._deadliner.add(duty):
             _log.debug("dropping expired duty partials", duty=str(duty))
             return {}
-        hits: dict[PubKey, list[ParSignedData]] = {}
+        hits: dict[PubKey, list[list[ParSignedData]]] = defaultdict(list)
         equivocation: BaseException | None = None
+        multi_root = duty.type in MULTI_ROOT_DUTIES
         for pubkey, psd in parsigs.items():
             key = (duty, pubkey)
-            existing = self._sigs[key].get(psd.share_idx)
+            root = psd.message_root()
+            existing = self._sigs[key].get((psd.share_idx, root))
             if existing is not None:
                 if bytes(existing.signature()) != bytes(psd.signature()):
-                    # Equivocation: same share signed two different things
-                    # (reference memory.go:145-177). Record it but keep
-                    # processing the rest of the batch — one faulty peer must
-                    # not suppress other validators' threshold hits.
+                    # Same share, same payload, different signature.
                     equivocation = errors.new("equivocating partial signature",
                                               duty=str(duty),
                                               share_idx=psd.share_idx)
                 continue  # duplicate, drop
-            self._sigs[key][psd.share_idx] = psd.clone()
-            if key in self._fired:
+            if not multi_root and any(si == psd.share_idx
+                                      for si, _ in self._sigs[key]):
+                # Equivocation: for single-payload duties one share signing
+                # two different things is byzantine (reference
+                # memory.go:145-177). Record it but keep processing the rest
+                # of the batch — one faulty peer must not suppress other
+                # validators' threshold hits.
+                equivocation = errors.new("equivocating partial signature",
+                                          duty=str(duty),
+                                          share_idx=psd.share_idx)
                 continue
-            matching = self._threshold_matching(key)
-            # Fire exactly once per duty+validator, when the matching-root
-            # group reaches threshold (reference memory.go:100-122).
+            self._sigs[key][(psd.share_idx, root)] = psd.clone()
+            if (duty, pubkey, root) in self._fired:
+                continue
+            matching = self._root_group(key, root)
+            # Fire exactly once per duty+validator+root, when the matching-
+            # root group reaches threshold (reference memory.go:100-122,
+            # getThresholdMatching:198).
             if len(matching) >= self._threshold:
-                self._fired.add(key)
-                hits[pubkey] = matching[: self._threshold]
+                self._fired.add((duty, pubkey, root))
+                hits[pubkey].append(matching[: self._threshold])
         if equivocation is not None:
             _log.warn("equivocating partial in batch", err=equivocation,
                       duty=str(duty))
-        return hits
+        return dict(hits)
 
-    def _threshold_matching(self, key) -> list[ParSignedData]:
-        """Largest group of partials with identical message roots
-        (reference getThresholdMatching memory.go:198)."""
-        groups: dict[bytes, list[ParSignedData]] = defaultdict(list)
-        for psd in self._sigs[key].values():
-            groups[psd.message_root()].append(psd)
-        if not groups:
-            return []
-        best = max(groups.values(), key=len)
-        return best
+    def _root_group(self, key, root: bytes) -> list[ParSignedData]:
+        """All partials for key with the given message root."""
+        return [psd for (_, r), psd in self._sigs[key].items() if r == root]
 
-    async def _fire_threshold(self, duty: Duty,
-                              hits: dict[PubKey, list[ParSignedData]]) -> None:
+    async def _fire_threshold(
+            self, duty: Duty,
+            hits: dict[PubKey, list[list[ParSignedData]]]) -> None:
         if not hits:
             return
         _log.debug("threshold reached", duty=str(duty), pubkeys=len(hits))
-        payload = {pk: [p.clone() for p in sigs] for pk, sigs in hits.items()}
-        for fn in self._threshold_subs:
-            await fn(duty, payload)
+        # Each root group aggregates independently; SigAgg takes one group per
+        # pubkey per call, so emit in waves (a pubkey with k root groups —
+        # e.g. k sync subcommittees — appears in k successive payloads).
+        wave = 0
+        while True:
+            payload = {pk: [p.clone() for p in groups[wave]]
+                       for pk, groups in hits.items() if wave < len(groups)}
+            if not payload:
+                return
+            for fn in self._threshold_subs:
+                await fn(duty, payload)
+            wave += 1

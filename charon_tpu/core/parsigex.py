@@ -8,7 +8,7 @@ acceptance (parsigex.go:61-102) — the bulk-verification hot path the TPU
 backend batches (north-star parsigex config: 500 DVs mixed duties).
 
 MemTransport here is the in-memory test fabric (reference
-parsigex/memory.go); the TCP fabric lives in charon_tpu.p2p.
+parsigex/memory.go).
 """
 
 from __future__ import annotations
@@ -17,7 +17,7 @@ from typing import Awaitable, Callable
 
 from .. import tbls
 from ..eth2.spec import ChainSpec
-from ..utils import errors, log, metrics
+from ..utils import aio, errors, log, metrics
 from .gater import DutyGaterFunc
 from .keyshares import KeyShares
 from .signeddata import _Eth2Signed
@@ -72,6 +72,11 @@ def new_batch_eth2_verifier(chain: ChainSpec, keys: KeyShares):
             if not tbls.verify(pk, root, sig):
                 raise errors.new("invalid partial signature", duty=str(duty),
                                  pubkey=pubkey[:10], share_idx=psd.share_idx)
+        # Batch verify failed but every signature passed individually: the
+        # batch and individual verifiers disagree. Surface it loudly instead
+        # of silently accepting a set no effective check validated.
+        raise errors.new("batch/individual signature verifier disagreement",
+                         duty=str(duty), count=len(sigs))
 
     return verify_set
 
@@ -129,10 +134,8 @@ class MemTransport:
                         parsigs: ParSignedDataSet) -> None:
         # Fire-and-forget like the reference's SendAsync (p2p/sender.go:107):
         # the sender never blocks on peers' verification work.
-        import asyncio
-
         for idx, handler in list(self._handlers.items()):
             if idx == from_idx:
                 continue
-            asyncio.create_task(
-                handler(duty, {k: v.clone() for k, v in parsigs.items()}))
+            aio.spawn(handler(duty, {k: v.clone() for k, v in parsigs.items()}),
+                      name=f"parsigex-deliver-{idx}")

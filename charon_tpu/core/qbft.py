@@ -1,0 +1,663 @@
+"""Generic QBFT (IBFT 2.0 family) consensus algorithm.
+
+Transport-agnostic implementation of the protocol in arXiv:2002.03613 (the
+QBFT formal spec), mirroring the reference's generic algorithm
+(reference core/qbft/qbft.go:166 Run, quorum rules qbft.go:55-63,
+justification rules qbft.go:501-709, round-change logic qbft.go:476).
+
+Design notes (asyncio-native rather than a goroutine/channel translation):
+  - `run()` is a single async event loop over three sources — the input
+    value, inbound messages, and the round timer — using tasks and
+    asyncio.wait instead of a select statement.
+  - Values V are arbitrary hashable/comparable objects; `None` is the null
+    value (the duty-tied component uses 32-byte payload hashes).
+  - Messages are immutable dataclasses; justifications are tuples and are
+    never nested more than one level.
+
+The same safety rules hold: quorum = ceil(2n/3), at most floor((n-1)/3)
+byzantine nodes, PRE-PREPARE justified by quorum ROUND-CHANGE + PREPARE
+evidence for rounds > 1, DECIDED justified by quorum COMMITs.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import dataclasses
+import enum
+from dataclasses import dataclass, field
+from typing import Any, Awaitable, Callable, Hashable
+
+from ..utils import log
+
+_log = log.with_topic("qbft")
+
+
+class MsgType(enum.IntEnum):
+    """Wire message types; ordering is wire-compatible with the reference
+    (core/qbft/qbft.go:71-79) and must not change."""
+
+    UNKNOWN = 0
+    PRE_PREPARE = 1
+    PREPARE = 2
+    COMMIT = 3
+    ROUND_CHANGE = 4
+    DECIDED = 5
+
+    @property
+    def valid(self) -> bool:
+        return self is not MsgType.UNKNOWN
+
+    def __str__(self) -> str:
+        return self.name.lower()
+
+
+# The proposed value; None is the null/zero value.
+Value = Hashable
+
+
+@dataclass(frozen=True)
+class Msg:
+    """An inter-process consensus message (reference qbft.go:98-116)."""
+
+    type: MsgType
+    instance: Any
+    source: int
+    round: int
+    value: Value = None
+    prepared_round: int = 0
+    prepared_value: Value = None
+    justification: tuple["Msg", ...] = ()
+
+
+class UponRule(enum.IntEnum):
+    """Event rules triggered on message receipt (reference qbft.go:125-135)."""
+
+    NOTHING = 0
+    JUSTIFIED_PRE_PREPARE = 1
+    QUORUM_PREPARES = 2
+    QUORUM_COMMITS = 3
+    UNJUST_QUORUM_ROUND_CHANGES = 4
+    F_PLUS_1_ROUND_CHANGES = 5
+    QUORUM_ROUND_CHANGES = 6
+    JUSTIFIED_DECIDED = 7
+    ROUND_TIMEOUT = 8
+
+    def __str__(self) -> str:
+        return self.name.lower()
+
+
+# new_timer(round) -> (timeout_event_task_factory, stop). We model a round
+# timer as a coroutine factory: awaiting it completes when the round times
+# out; stop() cancels it.
+TimerFactory = Callable[[int], tuple[Callable[[], Awaitable[None]], Callable[[], None]]]
+
+
+def increasing_round_timer(base: float = 0.75,
+                           inc: float = 0.25) -> TimerFactory:
+    """Round timeouts growing linearly with the round number. Algorithm-level
+    default used by tests; the production timers (with the reference's
+    constants and the eager-double-linear variant) live in
+    consensus.IncreasingRoundTimer / DoubleEagerLinearRoundTimer. Stopping is
+    handled by run()'s task cancellation, so stop is a no-op here."""
+
+    def new_timer(round_: int):
+        duration = base + inc * round_
+
+        async def wait():
+            await asyncio.sleep(duration)
+
+        return wait, lambda: None
+
+    return new_timer
+
+
+@dataclass
+class Definition:
+    """Consensus system parameters external to the algorithm; constant
+    across instances (reference qbft.go:32-51)."""
+
+    is_leader: Callable[[Any, int, int], bool]
+    new_timer: TimerFactory
+    decide: Callable[[Any, Value, list[Msg]], None]
+    nodes: int
+    fifo_limit: int = 1000
+    # Optional debug hooks (reference LogUponRule/LogRoundChange/LogUnjust).
+    log_upon_rule: Callable[..., None] | None = None
+    log_round_change: Callable[..., None] | None = None
+    log_unjust: Callable[..., None] | None = None
+
+    @property
+    def quorum(self) -> int:
+        """ceil(2n/3) (IBFT 2.0, reference qbft.go:55-57)."""
+        return -(-self.nodes * 2 // 3)
+
+    @property
+    def faulty(self) -> int:
+        """floor((n-1)/3) (reference qbft.go:61-63)."""
+        return (self.nodes - 1) // 3
+
+
+class Transport:
+    """Transport seam between processes (reference qbft.go:18-28): broadcast
+    must deliver to all processes *including the sender*; receive is the
+    inbound queue."""
+
+    def __init__(self, broadcast, receive: asyncio.Queue):
+        self.broadcast = broadcast
+        self.receive = receive
+
+
+class SanityError(Exception):
+    """Internal invariant violation (the reference uses panics tagged "bug")."""
+
+
+async def run(d: Definition, t: Transport, instance: Any, process: int,
+              input_value: "asyncio.Future[Value] | Value | None" = None) -> None:
+    """Execute one consensus instance until decided or cancelled
+    (reference qbft.go:166 Run).
+
+    `input_value` may be the value itself or a future resolving to it (the
+    leader can start without its own value: pre-prepare justification is
+    cached until the value arrives, reference broadcastOwnPrePrepare
+    qbft.go:211-225).
+    """
+    round_ = 1
+    value: Value = None
+    ppj_cache: list[Msg] | None = None  # cached own-pre-prepare justification
+    prepared_round = 0
+    prepared_value: Value = None
+    prepared_justification: tuple[Msg, ...] = ()
+    q_commit: list[Msg] = []
+    buffer: dict[int, list[Msg]] = {}
+    dedup_rules: set[tuple[UponRule, int]] = set()
+
+    if input_value is not None and not isinstance(input_value, asyncio.Future):
+        fut: asyncio.Future = asyncio.get_running_loop().create_future()
+        fut.set_result(input_value)
+        input_value = fut
+
+    # -- helpers (closures over the instance state) --------------------------
+
+    async def broadcast_msg(typ: MsgType, val: Value,
+                            justification: tuple[Msg, ...] = ()) -> None:
+        await t.broadcast(Msg(typ, instance, process, round_, val,
+                              0, None, _strip_nested(justification)))
+
+    async def broadcast_round_change() -> None:
+        await t.broadcast(Msg(MsgType.ROUND_CHANGE, instance, process, round_,
+                              None, prepared_round, prepared_value,
+                              _strip_nested(prepared_justification)))
+
+    async def broadcast_own_pre_prepare(justification: tuple[Msg, ...]) -> None:
+        nonlocal ppj_cache
+        if ppj_cache is not None:
+            raise SanityError("justification cache must be empty")
+        if value is None:
+            # No input value yet: cache the justification, send on arrival.
+            ppj_cache = list(justification)
+            return
+        await broadcast_msg(MsgType.PRE_PREPARE, value, justification)
+
+    def buffer_msg(msg: Msg) -> None:
+        fifo = buffer.setdefault(msg.source, [])
+        fifo.append(msg)
+        if len(fifo) > d.fifo_limit:
+            del fifo[: len(fifo) - d.fifo_limit]
+
+    def is_duplicated_rule(rule: UponRule, msg_round: int) -> bool:
+        key = (rule, msg_round)
+        if key in dedup_rules:
+            return True
+        dedup_rules.add(key)
+        return False
+
+    def change_round(new_round: int, rule: UponRule) -> None:
+        nonlocal round_, dedup_rules, ppj_cache
+        if round_ == new_round:
+            return
+        if d.log_round_change is not None:
+            d.log_round_change(instance, process, round_, new_round, rule,
+                               _extract_round_msgs(buffer, round_))
+        round_ = new_round
+        dedup_rules = set()
+        ppj_cache = None
+
+    # -- timer/event plumbing ------------------------------------------------
+
+    loop = asyncio.get_running_loop()
+    timer_task: asyncio.Task | None = None
+    timer_stop: Callable[[], None] | None = None
+
+    def start_timer() -> None:
+        nonlocal timer_task, timer_stop
+        wait, stop = d.new_timer(round_)
+        timer_task = loop.create_task(wait())
+        timer_stop = stop
+
+    def stop_timer() -> None:
+        nonlocal timer_task, timer_stop
+        if timer_stop is not None:
+            timer_stop()
+        if timer_task is not None:
+            timer_task.cancel()
+        timer_task = None
+        timer_stop = None
+
+    recv_task: asyncio.Task | None = None
+
+    try:
+        # Algorithm 1:11 — round-1 leader proposes immediately.
+        if d.is_leader(instance, round_, process):
+            if input_value is not None and input_value.done():
+                value = input_value.result()
+                input_value = None
+            await broadcast_own_pre_prepare(())
+        start_timer()
+
+        while True:
+            waiters: list[asyncio.Future] = []
+            if recv_task is None:
+                recv_task = loop.create_task(t.receive.get())
+            waiters.append(recv_task)
+            if timer_task is not None:
+                waiters.append(timer_task)
+            if input_value is not None:
+                waiters.append(input_value)
+            done, _ = await asyncio.wait(waiters,
+                                         return_when=asyncio.FIRST_COMPLETED)
+
+            if input_value is not None and input_value in done:
+                value = input_value.result()
+                input_value = None
+                if value is None:
+                    raise ValueError("null input value not supported")
+                if ppj_cache is not None:
+                    just, ppj_cache = tuple(ppj_cache), None
+                    await broadcast_msg(MsgType.PRE_PREPARE, value, just)
+                continue
+
+            if timer_task is not None and timer_task in done:
+                # Algorithm 3:1 — round timeout.
+                timer_task = None
+                change_round(round_ + 1, UponRule.ROUND_TIMEOUT)
+                stop_timer()
+                start_timer()
+                await broadcast_round_change()
+                continue
+
+            if recv_task not in done:
+                continue
+            msg: Msg = recv_task.result()
+            recv_task = None
+
+            if q_commit:
+                # Already decided: answer ROUND-CHANGEs with DECIDED
+                # (algorithm 3:17).
+                if msg.source != process and msg.type == MsgType.ROUND_CHANGE:
+                    await broadcast_msg(MsgType.DECIDED, q_commit[0].value,
+                                        tuple(q_commit))
+                continue
+
+            if not is_justified(d, instance, msg):
+                if d.log_unjust is not None:
+                    d.log_unjust(instance, process, msg)
+                continue
+
+            buffer_msg(msg)
+            rule, justification = classify(d, instance, round_, process,
+                                           buffer, msg)
+            if rule is UponRule.NOTHING or is_duplicated_rule(rule, msg.round):
+                continue
+            if d.log_upon_rule is not None:
+                d.log_upon_rule(instance, process, round_, msg, rule)
+
+            if rule is UponRule.JUSTIFIED_PRE_PREPARE:  # Algorithm 2:1
+                # Current or future rounds (justified PRE-PREPARE may jump).
+                change_round(msg.round, rule)
+                stop_timer()
+                start_timer()
+                await broadcast_msg(MsgType.PREPARE, msg.value)
+
+            elif rule is UponRule.QUORUM_PREPARES:  # Algorithm 2:4
+                prepared_round = round_
+                prepared_value = msg.value
+                prepared_justification = tuple(justification)
+                await broadcast_msg(MsgType.COMMIT, prepared_value)
+
+            elif rule in (UponRule.QUORUM_COMMITS,
+                          UponRule.JUSTIFIED_DECIDED):  # Algorithm 2:8
+                change_round(msg.round, rule)
+                q_commit = list(justification)
+                stop_timer()
+                d.decide(instance, msg.value, list(justification))
+
+            elif rule is UponRule.F_PLUS_1_ROUND_CHANGES:  # Algorithm 3:5
+                change_round(next_min_round(d, justification, round_), rule)
+                stop_timer()
+                start_timer()
+                await broadcast_round_change()
+
+            elif rule is UponRule.QUORUM_ROUND_CHANGES:  # Algorithm 3:11
+                pr_pv = get_single_justified_pr_pv(d, justification)
+                if pr_pv is not None:
+                    # Propose the prepared value, not our own input.
+                    _, pv = pr_pv
+                    await broadcast_msg(MsgType.PRE_PREPARE, pv,
+                                        tuple(justification))
+                else:
+                    await broadcast_own_pre_prepare(tuple(justification))
+
+            elif rule is UponRule.UNJUST_QUORUM_ROUND_CHANGES:
+                pass  # bug or byzantine; ignore
+
+            else:  # pragma: no cover
+                raise SanityError(f"invalid rule {rule}")
+    finally:
+        stop_timer()
+        if recv_task is not None:
+            recv_task.cancel()
+
+
+# ---------------------------------------------------------------------------
+# Classification and justification rules (pure functions)
+# ---------------------------------------------------------------------------
+
+
+def classify(d: Definition, instance: Any, round_: int, process: int,
+             buffer: dict[int, list[Msg]],
+             msg: Msg) -> tuple[UponRule, list[Msg]]:
+    """Rule triggered by the last received message + its justification
+    (reference classify qbft.go:399-472)."""
+    if msg.type is MsgType.DECIDED:
+        return UponRule.JUSTIFIED_DECIDED, list(msg.justification)
+
+    if msg.type is MsgType.PRE_PREPARE:
+        # Old rounds are ignored; justified PRE-PREPAREs may jump ahead.
+        if msg.round < round_:
+            return UponRule.NOTHING, []
+        return UponRule.JUSTIFIED_PRE_PREPARE, []
+
+    if msg.type is MsgType.PREPARE:
+        if msg.round != round_:  # PREPARE is unjustified: current round only
+            return UponRule.NOTHING, []
+        prepares = _filter_msgs(_flatten(buffer), MsgType.PREPARE, msg.round,
+                                value=msg.value)
+        if len(prepares) >= d.quorum:
+            return UponRule.QUORUM_PREPARES, prepares
+        return UponRule.NOTHING, []
+
+    if msg.type is MsgType.COMMIT:
+        if msg.round != round_:
+            return UponRule.NOTHING, []
+        commits = _filter_msgs(_flatten(buffer), MsgType.COMMIT, msg.round,
+                               value=msg.value)
+        if len(commits) >= d.quorum:
+            return UponRule.QUORUM_COMMITS, commits
+        return UponRule.NOTHING, []
+
+    if msg.type is MsgType.ROUND_CHANGE:
+        if msg.round < round_:
+            return UponRule.NOTHING, []
+        all_ = _flatten(buffer)
+        if msg.round > round_:
+            frc = get_f_plus_1_round_changes(d, all_, round_)
+            if frc is not None:
+                return UponRule.F_PLUS_1_ROUND_CHANGES, frc
+            return UponRule.NOTHING, []
+        # msg.round == round_
+        if len(_filter_round_change(all_, msg.round)) < d.quorum:
+            return UponRule.NOTHING, []
+        qrc = get_justified_qrc(d, all_, msg.round)
+        if qrc is None:
+            return UponRule.UNJUST_QUORUM_ROUND_CHANGES, []
+        if not d.is_leader(instance, msg.round, process):
+            return UponRule.NOTHING, []
+        return UponRule.QUORUM_ROUND_CHANGES, qrc
+
+    raise SanityError(f"invalid message type {msg.type}")
+
+
+def next_min_round(d: Definition, frc: list[Msg], round_: int) -> int:
+    """Smallest round among F+1 future ROUND-CHANGEs (algorithm 3:6,
+    reference nextMinRound qbft.go:476-498)."""
+    if len(frc) < d.faulty + 1:
+        raise SanityError("frc too short")
+    for m in frc:
+        if m.type is not MsgType.ROUND_CHANGE:
+            raise SanityError("frc contains non-round-change")
+        if m.round <= round_:
+            raise SanityError("frc round not in future")
+    return min(m.round for m in frc)
+
+
+def is_justified(d: Definition, instance: Any, msg: Msg) -> bool:
+    """Justification check per message type (reference isJustified
+    qbft.go:501-516)."""
+    if msg.type is MsgType.PRE_PREPARE:
+        return is_justified_pre_prepare(d, instance, msg)
+    if msg.type in (MsgType.PREPARE, MsgType.COMMIT):
+        return True
+    if msg.type is MsgType.ROUND_CHANGE:
+        return is_justified_round_change(d, msg)
+    if msg.type is MsgType.DECIDED:
+        return is_justified_decided(d, msg)
+    raise SanityError(f"invalid message type {msg.type}")
+
+
+def is_justified_round_change(d: Definition, msg: Msg) -> bool:
+    """ROUND-CHANGE justification: quorum PREPAREs proving (pr, pv), or null
+    prepared state (reference isJustifiedRoundChange qbft.go:520-558)."""
+    prepares = msg.justification
+    pr, pv = msg.prepared_round, msg.prepared_value
+    if not prepares:
+        return pr == 0 and pv is None
+    if len(prepares) < d.quorum:
+        return False
+    seen: set[int] = set()
+    for p in prepares:
+        if p.source in seen:
+            return False
+        seen.add(p.source)
+        if p.type is not MsgType.PREPARE or p.round != pr or p.value != pv:
+            return False
+    return True
+
+
+def is_justified_decided(d: Definition, msg: Msg) -> bool:
+    """DECIDED justified by quorum COMMITs of same round+value
+    (reference isJustifiedDecided qbft.go:562-571)."""
+    if msg.value is None:
+        return False
+    commits = _filter_msgs(list(msg.justification), MsgType.COMMIT, msg.round,
+                           value=msg.value)
+    return len(commits) >= d.quorum
+
+
+def is_justified_pre_prepare(d: Definition, instance: Any, msg: Msg) -> bool:
+    """PRE-PREPARE from the round's leader; round 1 needs no evidence, later
+    rounds need a justified quorum of ROUND-CHANGEs (reference
+    isJustifiedPrePrepare qbft.go:574-597)."""
+    if msg.value is None:
+        return False  # a null value must never be proposed (nor decided)
+    if not d.is_leader(instance, msg.round, msg.source):
+        return False
+    if msg.round == 1:
+        return True
+    res = contains_justified_qrc(d, list(msg.justification), msg.round)
+    if res is None:
+        return False
+    pv = res
+    if pv is _NULL:
+        return True  # new value being proposed
+    return msg.value == pv
+
+
+class _Null:
+    """Sentinel distinguishing 'justified with null pv' from 'not justified'."""
+
+
+_NULL = _Null()
+
+
+def contains_justified_qrc(d: Definition, justification: list[Msg],
+                           round_: int):
+    """Algorithm 4:1: check the justification embeds a justified quorum of
+    ROUND-CHANGEs; returns the prepared value, _NULL for null-prepared, or
+    None if unjustified (reference containsJustifiedQrc qbft.go:601-644)."""
+    qrc = _filter_round_change(justification, round_)
+    if len(qrc) < d.quorum:
+        return None
+    # J1: all ROUND-CHANGEs have null prepared state.
+    if all(rc.prepared_round == 0 and rc.prepared_value is None for rc in qrc):
+        return _NULL
+    # J2: quorum PREPAREs for the highest (pr, pv) in Qrc.
+    pr_pv = get_single_justified_pr_pv(d, justification)
+    if pr_pv is None:
+        return None
+    pr, pv = pr_pv
+    found = False
+    for rc in qrc:
+        if rc.prepared_round > pr:
+            return None
+        if rc.prepared_round == pr and rc.prepared_value == pv:
+            found = True
+    if not found:
+        return None
+    return _NULL if pv is None else pv
+
+
+def get_single_justified_pr_pv(d: Definition,
+                               msgs: list[Msg]) -> tuple[int, Value] | None:
+    """The single (pr, pv) proven by quorum PREPAREs in msgs; None if absent
+    or ambiguous (reference getSingleJustifiedPrPv qbft.go:648-672)."""
+    pr, pv, count = 0, None, 0
+    seen: set[int] = set()
+    for m in msgs:
+        if m.type is not MsgType.PREPARE:
+            continue
+        if m.source in seen:
+            return None
+        seen.add(m.source)
+        if count == 0:
+            pr, pv = m.round, m.value
+        elif pr != m.round or pv != m.value:
+            return None
+        count += 1
+    return (pr, pv) if count >= d.quorum else None
+
+
+def get_justified_qrc(d: Definition, all_: list[Msg],
+                      round_: int) -> list[Msg] | None:
+    """A justified quorum of ROUND-CHANGEs for the round (algorithm 4:1,
+    reference getJustifiedQrc qbft.go:675-710)."""
+    null_qrc = _filter_msgs(all_, MsgType.ROUND_CHANGE, round_,
+                            pr=0, pv=None)
+    if len(null_qrc) >= d.quorum:
+        return null_qrc  # J1
+    round_changes = _filter_round_change(all_, round_)
+    for prepares in get_prepare_quorums(d, all_):
+        pr, pv = prepares[0].round, prepares[0].value
+        qrc: list[Msg] = []
+        has_highest = False
+        seen: set[int] = set()
+        for rc in round_changes:
+            if rc.prepared_round > pr or rc.source in seen:
+                continue
+            seen.add(rc.source)
+            if rc.prepared_round == pr and rc.prepared_value == pv:
+                has_highest = True
+            qrc.append(rc)
+        if len(qrc) >= d.quorum and has_highest:
+            return qrc + prepares
+    return None
+
+
+def get_f_plus_1_round_changes(d: Definition, all_: list[Msg],
+                               round_: int) -> list[Msg] | None:
+    """F+1 ROUND-CHANGEs with rounds beyond `round_`, highest per process
+    (reference getFPlus1RoundChanges qbft.go:715-745)."""
+    highest: dict[int, Msg] = {}
+    for m in all_:
+        if m.type is not MsgType.ROUND_CHANGE or m.round <= round_:
+            continue
+        cur = highest.get(m.source)
+        if cur is not None and cur.round > m.round:
+            continue
+        highest[m.source] = m
+        if len(highest) == d.faulty + 1:
+            break
+    if len(highest) < d.faulty + 1:
+        return None
+    return list(highest.values())
+
+
+def get_prepare_quorums(d: Definition, all_: list[Msg]) -> list[list[Msg]]:
+    """All quorum sets of PREPAREs with identical (round, value)
+    (reference getPrepareQuorums qbft.go:755-785)."""
+    sets: dict[tuple[int, Value], dict[int, Msg]] = {}
+    for m in all_:
+        if m.type is not MsgType.PREPARE:
+            continue
+        sets.setdefault((m.round, m.value), {})[m.source] = m
+    return [list(byproc.values()) for byproc in sets.values()
+            if len(byproc) >= d.quorum]
+
+
+# -- low-level filters -------------------------------------------------------
+
+
+def _strip_nested(justification) -> tuple[Msg, ...]:
+    """Justification messages never carry their own justifications on the
+    wire — e.g. a PRE-PREPARE justified by ROUND-CHANGEs drops those
+    ROUND-CHANGEs' PREPARE evidence (the reference strips them during
+    serialization: consensus/transport.go:193 "nested justifications are
+    ignored"). Receivers re-derive any needed PREPARE evidence from their
+    own buffers (quorum-round-change justifications carry the PREPARE
+    quorum at the top level, so nothing essential is lost)."""
+    return tuple(dataclasses.replace(j, justification=())
+                 if j.justification else j for j in justification)
+
+
+def _extract_round_msgs(buffer: dict[int, list[Msg]], round_: int) -> list[Msg]:
+    return [m for fifo in buffer.values() for m in fifo if m.round == round_]
+
+
+def _flatten(buffer: dict[int, list[Msg]]) -> list[Msg]:
+    """All buffered messages plus their (non-nested) justifications
+    (reference flatten qbft.go:858-873)."""
+    out: list[Msg] = []
+    for fifo in buffer.values():
+        for m in fifo:
+            out.append(m)
+            for j in m.justification:
+                if j.justification:
+                    raise SanityError("nested justifications")
+                out.append(j)
+    return out
+
+
+def _filter_msgs(msgs: list[Msg], typ: MsgType, round_: int, *,
+                 value: Value | bool = False, pr: int | None = None,
+                 pv: Value | bool = False) -> list[Msg]:
+    """One message per source matching type/round and optional value/pr/pv
+    (reference filterMsgs qbft.go:811-843). `value`/`pv` use False as the
+    "no filter" sentinel since None is a legitimate null value."""
+    out: list[Msg] = []
+    seen: set[int] = set()
+    for m in msgs:
+        if m.type is not typ or m.round != round_:
+            continue
+        if value is not False and m.value != value:
+            continue
+        if pv is not False and m.prepared_value != pv:
+            continue
+        if pr is not None and m.prepared_round != pr:
+            continue
+        if m.source not in seen:
+            seen.add(m.source)
+            out.append(m)
+    return out
+
+
+def _filter_round_change(msgs: list[Msg], round_: int) -> list[Msg]:
+    return _filter_msgs(msgs, MsgType.ROUND_CHANGE, round_)

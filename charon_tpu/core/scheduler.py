@@ -16,7 +16,7 @@ from dataclasses import dataclass
 from typing import Callable
 
 from ..eth2.beacon import BeaconNode, ValidatorCache
-from ..utils import log, metrics
+from ..utils import aio, log, metrics
 from .types import (
     Duty,
     DutyDefinitionSet,
@@ -123,7 +123,7 @@ class Scheduler:
             # Slot subscribers (vmock, infosync, recaster) may block on
             # pipeline results — run them as tasks, never in the tick loop.
             for fn in self._slot_subs:
-                asyncio.create_task(self._emit_safe(fn, slot))
+                aio.spawn(self._emit_safe(fn, slot), name=f"slot-sub-{slot.slot}")
 
             await self._emit_slot_duties(spec, slot)
             self._trim(slot.epoch)

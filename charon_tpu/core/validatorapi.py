@@ -9,8 +9,8 @@ submissions as ParSignedData and emits them to ParSigDB. Aggregation selection
 proofs are combined cluster-wide via the DVT-specific selections endpoints
 (AggregateBeaconCommitteeSelections:628, eth2util/eth2exp).
 
-This is the in-process component; the HTTP router (reference router.go) wraps
-it for real VCs in charon_tpu.app.vapi_router.
+This is the in-process component; the HTTP router (reference router.go)
+wrapping it for real VCs lives alongside it in vapi_router.py.
 """
 
 from __future__ import annotations
@@ -200,7 +200,8 @@ class Component:
             self._verify_partial(pubkey, sel)
             duty = Duty(sel.slot, DutyType.PREPARE_AGGREGATOR)
             await self._emit(duty, {pubkey: ParSignedData(sel, self._keys.my_share_idx)})
-            combined = await self._aggsigdb.await_(duty, pubkey)
+            combined = await self._aggsigdb.await_(duty, pubkey,
+                                                   root=sel.message_root())
             if not isinstance(combined, BeaconCommitteeSelection):
                 raise errors.new("unexpected combined selection type")
             out.append(combined)
@@ -246,7 +247,8 @@ class Component:
             self._verify_partial(pubkey, sel)
             duty = Duty(sel.slot, DutyType.PREPARE_SYNC_CONTRIBUTION)
             await self._emit(duty, {pubkey: ParSignedData(sel, self._keys.my_share_idx)})
-            combined = await self._aggsigdb.await_(duty, pubkey)
+            combined = await self._aggsigdb.await_(duty, pubkey,
+                                                   root=sel.message_root())
             if not isinstance(combined, SyncCommitteeSelection):
                 raise errors.new("unexpected combined sync selection type")
             out.append(combined)

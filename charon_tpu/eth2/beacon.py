@@ -68,9 +68,10 @@ class BeaconNode(Protocol):
 
 
 class MultiBeaconNode:
-    """Multi-BN failover: try the current best node first, fall back to the
-    rest in parallel; first success wins (reference eth2wrap.go:100 best-node
-    selector + 246-316 submit/request fan-out)."""
+    """Multi-BN failover: fan every request out to all nodes in parallel,
+    first success wins, and the winner becomes the preferred "best" node
+    (reference eth2wrap.go:100 best-node selector + 246-316 submit/request
+    fan-out via forkjoin)."""
 
     def __init__(self, nodes: list[BeaconNode]):
         if not nodes:
@@ -85,21 +86,38 @@ class MultiBeaconNode:
         return call
 
     async def _fanout(self, attr: str, *args, **kwargs):
-        order = [self._best] + [i for i in range(len(self.nodes)) if i != self._best]
+        if len(self.nodes) == 1:
+            return await self._one(0, attr, *args, **kwargs)
+        # Parallel first-success-wins race across all nodes (the reference's
+        # forkjoin fan-out); losers are cancelled once a winner returns.
+        tasks = {
+            asyncio.ensure_future(self._one(i, attr, *args, **kwargs)): i
+            for i in range(len(self.nodes))
+        }
+        pending = set(tasks)
         last_err: BaseException | None = None
-        for i in order:
-            node = self.nodes[i]
-            try:
-                with _latency_hist.time(node.name):
-                    result = await getattr(node, attr)(*args, **kwargs)
-                self._best = i
-                return result
-            except Exception as exc:  # noqa: BLE001 — failover path
-                _errors_total.inc(node.name)
-                _log.warn("beacon node request failed; trying next",
-                          err=exc, endpoint=node.name, method=attr)
-                last_err = exc
+        try:
+            while pending:
+                done, pending = await asyncio.wait(
+                    pending, return_when=asyncio.FIRST_COMPLETED)
+                for task in done:
+                    if task.exception() is None:
+                        self._best = tasks[task]
+                        return task.result()
+                    last_err = task.exception()
+                    node = self.nodes[tasks[task]]
+                    _errors_total.inc(node.name)
+                    _log.warn("beacon node request failed",
+                              err=last_err, endpoint=node.name, method=attr)
+        finally:
+            for task in pending:
+                task.cancel()
         raise errors.wrap(last_err, "all beacon nodes failed", method=attr)
+
+    async def _one(self, i: int, attr: str, *args, **kwargs):
+        node = self.nodes[i]
+        with _latency_hist.time(node.name):
+            return await getattr(node, attr)(*args, **kwargs)
 
 
 class ValidatorCache:

@@ -40,9 +40,14 @@ def compute_domain(domain_type: bytes, fork_version: bytes,
 def get_domain(spec: ChainSpec, domain_type: bytes, epoch: int) -> bytes:
     """Fork-aware domain for an epoch. The deposit and builder domains always
     use the genesis fork with a zero genesis_validators_root (consensus-spec /
-    builder-specs)."""
+    builder-specs). Voluntary exits are pinned to the Capella fork domain
+    regardless of the exit's epoch per EIP-7044 (in force Deneb+), so exit
+    signatures stay valid across future forks."""
     if domain_type in (DOMAIN_DEPOSIT, DOMAIN_APPLICATION_BUILDER):
         return compute_domain(domain_type, spec.genesis_fork_version, b"\x00" * 32)
+    if domain_type == DOMAIN_VOLUNTARY_EXIT and spec.capella_fork_version is not None:
+        return compute_domain(domain_type, spec.capella_fork_version,
+                              spec.genesis_validators_root)
     return compute_domain(domain_type, spec.fork_version_at(epoch),
                           spec.genesis_validators_root)
 

@@ -337,6 +337,9 @@ class ChainSpec:
     # domain for an epoch uses the latest fork at or before it.
     fork_schedule: tuple = ((0, b"\x00\x00\x00\x00"),)
     epochs_per_sync_committee_period: int = 256
+    # EIP-7044: on Deneb+ networks voluntary exits always use the Capella
+    # fork domain; None means pre-Deneb behavior (exit-epoch fork domain).
+    capella_fork_version: bytes | None = None
 
     def fork_version_at(self, epoch: int) -> bytes:
         version = self.fork_schedule[0][1]

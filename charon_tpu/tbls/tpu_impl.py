@@ -1,8 +1,9 @@
 """TPU backend for the tbls facade — the north-star offload.
 
-Routes the duty pipeline's hot calls (threshold aggregation now; batched
-pairing verification as ops/pairing.py lands) onto batched JAX kernels, while
-delegating the remaining operations to the CPU oracle. Feature-gated via
+Routes the duty pipeline's hot calls — threshold aggregation
+(ops/aggregate.py) and batched pairing verification (ops/pairing.py) — onto
+batched JAX kernels, while delegating the remaining operations to the CPU
+oracle. Feature-gated via
 charon_tpu.utils.featureset.TPU_BLS in app wiring, mirroring how the reference
 gates backends behind tbls.SetImplementation + app/featureset
 (reference tbls/tbls.go:72, featureset.go:10-75).
@@ -14,7 +15,13 @@ use the same ETH serialization; the cross-implementation randomized test suite
 
 from __future__ import annotations
 
+import numpy as np
+
+from ..crypto.curve import Fq2Ops, FqOps, jac_is_infinity, to_affine
+from ..crypto.hash_to_curve import DST_ETH, hash_to_g2
+from ..crypto.serialize import DeserializationError, g1_from_bytes, g2_from_bytes
 from ..ops.aggregate import threshold_aggregate_batch as _device_aggregate
+from ..ops.pairing import verify_batch_device as _device_verify
 from .python_impl import PythonImpl
 from .types import PrivateKey, PublicKey, Signature
 
@@ -37,3 +44,45 @@ class TPUImpl(PythonImpl):
         raw = _device_aggregate([{i: bytes(s) for i, s in b.items()}
                                  for b in batches])
         return [Signature(r) for r in raw]
+
+    def verify_batch(self, public_keys: list[PublicKey], datas: list[bytes],
+                     signatures: list[Signature]) -> bool:
+        """Batched verification on device: each (pk, H(m), sig) triple runs
+        its own pairing check with the batch axis spanning the triples — the
+        parsigex/sigagg hot path (reference core/parsigex/parsigex.go:61,
+        core/sigagg/sigagg.go:159). Host does the (cheap) deserialization and
+        hash-to-curve; the Miller loops + final exponentiation run batched on
+        device. Unlike PythonImpl's random-linear-combination batch, per-item
+        results are exact, so a False return already identifies culprits."""
+        ok = self.verify_batch_each(public_keys, datas, signatures)
+        return bool(np.all(ok)) if len(ok) else True
+
+    def verify_batch_each(self, public_keys: list[PublicKey],
+                          datas: list[bytes],
+                          signatures: list[Signature]) -> np.ndarray:
+        """Per-item validity of each (pubkey, data, signature) triple."""
+        if not (len(public_keys) == len(datas) == len(signatures)):
+            raise ValueError("length mismatch")
+        n = len(public_keys)
+        if n == 0:
+            return np.zeros(0, dtype=bool)
+        ok = np.zeros(n, dtype=bool)
+        idx, pk_affs, h_affs, sig_affs = [], [], [], []
+        h_cache: dict[bytes, tuple] = {}
+        for i, (pkb, data, sigb) in enumerate(zip(public_keys, datas, signatures)):
+            try:
+                pk = g1_from_bytes(bytes(pkb))
+                sig = g2_from_bytes(bytes(sigb))
+            except DeserializationError:
+                continue  # stays False
+            if jac_is_infinity(FqOps, pk) or jac_is_infinity(Fq2Ops, sig):
+                continue
+            if data not in h_cache:
+                h_cache[data] = to_affine(Fq2Ops, hash_to_g2(data, DST_ETH))
+            idx.append(i)
+            pk_affs.append(to_affine(FqOps, pk))
+            h_affs.append(h_cache[data])
+            sig_affs.append(to_affine(Fq2Ops, sig))
+        if idx:
+            ok[idx] = _device_verify(pk_affs, h_affs, sig_affs)
+        return ok

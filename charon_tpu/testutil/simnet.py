@@ -15,13 +15,14 @@ import time
 from dataclasses import dataclass, field
 
 from .. import tbls
-from ..core import aggsigdb, bcast, dutydb, fetcher, interfaces, leadercast
+from ..core import aggsigdb, bcast, consensus as consensus_mod, dutydb
+from ..core import fetcher, interfaces, leadercast
 from ..core import parsigdb, parsigex, scheduler, sigagg, validatorapi
 from ..core.deadline import Deadliner, new_duty_deadline_func
 from ..core.gater import new_duty_gater
 from ..core.keyshares import KeyShares, new_cluster_for_t
 from ..eth2.beacon import ValidatorCache
-from ..utils import expbackoff, retry as retry_util
+from ..utils import expbackoff, k1util, retry as retry_util
 from .beaconmock import BeaconMock
 from .validatormock import ValidatorMock
 
@@ -39,6 +40,7 @@ class SimNode:
     parsig_db: parsigdb.MemDB
     aggsig_db: aggsigdb.MemDB
     retryer: retry_util.Retryer
+    consensus: object = None
     tasks: list[asyncio.Task] = field(default_factory=list)
 
     async def start(self) -> None:
@@ -48,6 +50,9 @@ class SimNode:
             asyncio.create_task(self.parsig_db.run_trim(), name=f"parsigdb-{self.idx}"),
             asyncio.create_task(self.aggsig_db.run_gc(), name=f"aggsigdb-{self.idx}"),
         ]
+        if hasattr(self.consensus, "run_trim"):
+            self.tasks.append(asyncio.create_task(
+                self.consensus.run_trim(), name=f"consensus-trim-{self.idx}"))
 
     async def stop(self) -> None:
         self.sched.stop()
@@ -74,8 +79,13 @@ class SimCluster:
 def new_simnet(num_validators: int = 2, threshold: int = 3, num_nodes: int = 4,
                seconds_per_slot: float = 0.2, slots_per_epoch: int = 8,
                genesis_delay: float = 0.3, use_vmock: bool = True,
-               verify_peer_partials: bool = True) -> SimCluster:
-    """Assemble an n-node in-process cluster sharing one beaconmock."""
+               verify_peer_partials: bool = True,
+               consensus_type: str = "qbft") -> SimCluster:
+    """Assemble an n-node in-process cluster sharing one beaconmock.
+
+    consensus_type: "qbft" (the production default, like the reference) or
+    "leadercast" (the reference's legacy/test-only bootstrap path).
+    """
     root_secrets, node_keys = new_cluster_for_t(num_validators, threshold, num_nodes)
     root_pubkey_bytes = [
         bytes(tbls.secret_to_public_key(s)) for s in root_secrets]
@@ -88,19 +98,28 @@ def new_simnet(num_validators: int = 2, threshold: int = 3, num_nodes: int = 4,
 
     lcast_transport = leadercast.MemTransport()
     parsig_transport = parsigex.MemTransport()
+    consensus_fabric = consensus_mod.MemTransport()
+    # Node identity keys (p2p/consensus signing, reference app/k1util).
+    identity_keys = [k1util.generate_private_key() for _ in range(num_nodes)]
+    identity_pubkeys = {i: k1util.public_key(k)
+                        for i, k in enumerate(identity_keys)}
 
     nodes = []
     for i, keys in enumerate(node_keys):
         node = _build_node(i, keys, beacon, chain, lcast_transport,
                            parsig_transport, num_nodes, use_vmock,
-                           verify_peer_partials)
+                           verify_peer_partials, consensus_type,
+                           consensus_fabric, identity_keys[i],
+                           identity_pubkeys)
         nodes.append(node)
     return SimCluster(beacon, nodes, root_secrets)
 
 
 def _build_node(idx: int, keys: KeyShares, beacon: BeaconMock, chain,
                 lcast_transport, parsig_transport, num_nodes: int,
-                use_vmock: bool, verify_peer_partials: bool) -> SimNode:
+                use_vmock: bool, verify_peer_partials: bool,
+                consensus_type: str, consensus_fabric, identity_key: bytes,
+                identity_pubkeys: dict[int, bytes]) -> SimNode:
     """The reference's wireCoreWorkflow (app/app.go:333-527) in miniature."""
     deadline_fn = new_duty_deadline_func(chain)
     valcache = ValidatorCache(beacon, list(beacon.validators))
@@ -110,7 +129,15 @@ def _build_node(idx: int, keys: KeyShares, beacon: BeaconMock, chain,
     duty_db = dutydb.MemDB(Deadliner(deadline_fn))
     aggsig_db = aggsigdb.MemDB(Deadliner(deadline_fn))
     parsig_db = parsigdb.MemDB(keys.threshold, Deadliner(deadline_fn))
-    consensus = leadercast.LeaderCast(lcast_transport, idx, num_nodes)
+    if consensus_type == "qbft":
+        consensus = consensus_mod.Component(
+            consensus_fabric.endpoint(), peer_idx=idx, nodes=num_nodes,
+            privkey=identity_key, peer_pubkeys=identity_pubkeys,
+            deadliner=Deadliner(deadline_fn), gater=new_duty_gater(chain))
+    elif consensus_type == "leadercast":
+        consensus = leadercast.LeaderCast(lcast_transport, idx, num_nodes)
+    else:
+        raise ValueError(f"unknown consensus type {consensus_type!r}")
     vapi = validatorapi.Component(beacon, duty_db, aggsig_db, keys, chain)
     verify_set = (parsigex.new_batch_eth2_verifier(chain, keys)
                   if verify_peer_partials else None)
@@ -137,4 +164,4 @@ def _build_node(idx: int, keys: KeyShares, beacon: BeaconMock, chain,
         sched.subscribe_slots(vmock.on_slot)
 
     return SimNode(idx, keys, sched, vapi, vmock, duty_db, parsig_db,
-                   aggsig_db, retryer)
+                   aggsig_db, retryer, consensus)

@@ -1,0 +1,47 @@
+"""Asyncio helpers shared across the framework.
+
+The event loop holds only weak references to tasks, so a fire-and-forget
+`asyncio.create_task` result that nobody retains can be garbage-collected
+mid-flight, silently dropping the work. `spawn` keeps a strong reference
+until the task completes (the discipline utils/retry.Retryer already uses),
+mirroring how the reference's goroutines are rooted until they return.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Coroutine
+
+from . import log
+
+_log = log.with_topic("aio")
+
+_tasks: set[asyncio.Task] = set()
+
+
+def spawn(coro: Coroutine, name: str | None = None) -> asyncio.Task:
+    """Run `coro` as a background task with a strong reference held until it
+    finishes. Exceptions are logged, never silently dropped."""
+    task = asyncio.get_running_loop().create_task(coro, name=name)
+    _tasks.add(task)
+    task.add_done_callback(_reap)
+    return task
+
+
+def _reap(task: asyncio.Task) -> None:
+    _tasks.discard(task)
+    if task.cancelled():
+        return
+    exc = task.exception()
+    if exc is not None:
+        _log.error("background task failed", task=task.get_name(), err=exc)
+
+
+def pending_count() -> int:
+    return len(_tasks)
+
+
+async def drain() -> None:
+    """Await all currently-pending spawned tasks (test helper)."""
+    while _tasks:
+        await asyncio.gather(*list(_tasks), return_exceptions=True)

@@ -1,0 +1,202 @@
+"""secp256k1 (k1) signing utilities (reference app/k1util/k1util.go).
+
+Node identity keys: every charon node holds a secp256k1 private key used for
+p2p identity (ENR), consensus-message signatures, cluster-definition operator
+signatures (EIP-712) and DKG node signatures. The reference uses the decred
+implementation; this is a from-scratch pure-Python implementation of the
+curve + RFC-6979 deterministic ECDSA with low-S normalization and public-key
+recovery (65-byte [R || S || V] signatures, matching k1util.Sign65).
+
+Pure Python is fast enough here: identity signatures are per-message
+consensus/DKG traffic (a few dozen per slot), not the BLS hot path.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import hmac
+import secrets
+
+# secp256k1 parameters.
+P = 0xFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFEFFFFFC2F
+N = 0xFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFEBAAEDCE6AF48A03BBFD25E8CD0364141
+Gx = 0x79BE667EF9DCBBAC55A06295CE870B07029BFCDB2DCE28D959F2815B16F81798
+Gy = 0x483ADA7726A3C4655DA4FBFC0E1108A8FD17B448A68554199C47D08FFB10D4B8
+A = 0
+B = 7
+
+_INF = None  # point at infinity sentinel
+
+
+def _add(p1, p2):
+    if p1 is _INF:
+        return p2
+    if p2 is _INF:
+        return p1
+    x1, y1 = p1
+    x2, y2 = p2
+    if x1 == x2:
+        if (y1 + y2) % P == 0:
+            return _INF
+        # doubling
+        lam = (3 * x1 * x1) * pow(2 * y1, -1, P) % P
+    else:
+        lam = (y2 - y1) * pow(x2 - x1, -1, P) % P
+    x3 = (lam * lam - x1 - x2) % P
+    y3 = (lam * (x1 - x3) - y1) % P
+    return (x3, y3)
+
+
+def _mul(point, k: int):
+    acc = _INF
+    addend = point
+    while k:
+        if k & 1:
+            acc = _add(acc, addend)
+        addend = _add(addend, addend)
+        k >>= 1
+    return acc
+
+
+def generate_private_key() -> bytes:
+    while True:
+        k = secrets.randbelow(N)
+        if k != 0:
+            return k.to_bytes(32, "big")
+
+
+def public_key(privkey: bytes) -> bytes:
+    """Compressed 33-byte SEC1 public key."""
+    k = _scalar(privkey)
+    x, y = _mul((Gx, Gy), k)
+    return bytes([2 + (y & 1)]) + x.to_bytes(32, "big")
+
+
+def decompress(pubkey: bytes):
+    """Compressed SEC1 -> (x, y); raises on invalid."""
+    if len(pubkey) == 65 and pubkey[0] == 4:
+        x = int.from_bytes(pubkey[1:33], "big")
+        y = int.from_bytes(pubkey[33:65], "big")
+    elif len(pubkey) == 33 and pubkey[0] in (2, 3):
+        x = int.from_bytes(pubkey[1:], "big")
+        if x >= P:
+            raise ValueError("invalid pubkey x")
+        y2 = (pow(x, 3, P) + B) % P
+        y = pow(y2, (P + 1) // 4, P)
+        if y * y % P != y2:
+            raise ValueError("not on curve")
+        if (y & 1) != (pubkey[0] & 1):
+            y = P - y
+    else:
+        raise ValueError("invalid pubkey encoding")
+    if (y * y - (x ** 3 + B)) % P != 0:
+        raise ValueError("not on curve")
+    return (x, y)
+
+
+def uncompressed(pubkey: bytes) -> bytes:
+    """Any SEC1 encoding -> uncompressed 65-byte 0x04||X||Y."""
+    x, y = decompress(pubkey)
+    return b"\x04" + x.to_bytes(32, "big") + y.to_bytes(32, "big")
+
+
+def _scalar(privkey: bytes) -> int:
+    k = int.from_bytes(privkey, "big")
+    if not 1 <= k < N:
+        raise ValueError("invalid private key scalar")
+    return k
+
+
+def _rfc6979_k(x: int, h1: bytes) -> int:
+    """Deterministic nonce per RFC 6979 (SHA-256)."""
+    holen = 32
+    V = b"\x01" * holen
+    K = b"\x00" * holen
+    bx = x.to_bytes(32, "big") + h1
+    K = hmac.new(K, V + b"\x00" + bx, hashlib.sha256).digest()
+    V = hmac.new(K, V, hashlib.sha256).digest()
+    K = hmac.new(K, V + b"\x01" + bx, hashlib.sha256).digest()
+    V = hmac.new(K, V, hashlib.sha256).digest()
+    while True:
+        V = hmac.new(K, V, hashlib.sha256).digest()
+        k = int.from_bytes(V, "big")
+        if 1 <= k < N:
+            return k
+        K = hmac.new(K, V + b"\x00", hashlib.sha256).digest()
+        V = hmac.new(K, V, hashlib.sha256).digest()
+
+
+def sign(privkey: bytes, digest: bytes) -> bytes:
+    """Sign a 32-byte digest; returns 65-byte [R || S || V] with low-S and
+    recovery id V in {0, 1} (reference k1util.Sign)."""
+    if len(digest) != 32:
+        raise ValueError("digest must be 32 bytes")
+    x = _scalar(privkey)
+    z = int.from_bytes(digest, "big") % N
+    while True:
+        k = _rfc6979_k(x, digest)
+        px, py = _mul((Gx, Gy), k)
+        r = px % N
+        if r == 0:
+            digest = hashlib.sha256(digest).digest()
+            continue
+        s = (z + r * x) * pow(k, -1, N) % N
+        if s == 0:
+            digest = hashlib.sha256(digest).digest()
+            continue
+        v = (py & 1) ^ (1 if px >= N else 0)
+        if s > N // 2:
+            s = N - s
+            v ^= 1
+        return r.to_bytes(32, "big") + s.to_bytes(32, "big") + bytes([v])
+
+
+def verify(pubkey: bytes, digest: bytes, sig: bytes) -> bool:
+    """Verify a 64- or 65-byte signature over a 32-byte digest
+    (reference k1util.Verify65 ignores the recovery byte)."""
+    if len(sig) not in (64, 65) or len(digest) != 32:
+        return False
+    try:
+        Q = decompress(pubkey)
+    except ValueError:
+        return False
+    r = int.from_bytes(sig[:32], "big")
+    s = int.from_bytes(sig[32:64], "big")
+    if not (1 <= r < N and 1 <= s < N):
+        return False
+    z = int.from_bytes(digest, "big") % N
+    w = pow(s, -1, N)
+    u1 = z * w % N
+    u2 = r * w % N
+    pt = _add(_mul((Gx, Gy), u1), _mul(Q, u2))
+    if pt is _INF:
+        return False
+    return pt[0] % N == r
+
+
+def recover(digest: bytes, sig: bytes) -> bytes:
+    """Recover the compressed public key from a 65-byte [R||S||V] signature
+    (reference k1util.Recover)."""
+    if len(sig) != 65 or len(digest) != 32:
+        raise ValueError("need 65-byte sig and 32-byte digest")
+    r = int.from_bytes(sig[:32], "big")
+    s = int.from_bytes(sig[32:64], "big")
+    v = sig[64]
+    if v not in (0, 1) or not (1 <= r < N and 1 <= s < N):
+        raise ValueError("invalid signature")
+    x = r + (N if v >= 2 else 0)
+    if x >= P:
+        raise ValueError("invalid r")
+    y2 = (pow(x, 3, P) + B) % P
+    y = pow(y2, (P + 1) // 4, P)
+    if y * y % P != y2:
+        raise ValueError("invalid point")
+    if (y & 1) != (v & 1):
+        y = P - y
+    z = int.from_bytes(digest, "big") % N
+    r_inv = pow(r, -1, N)
+    Q = _mul(_add(_mul((x, y), s), _mul((Gx, Gy), (-z) % N)), r_inv)
+    if Q is _INF:
+        raise ValueError("recovered infinity")
+    qx, qy = Q
+    return bytes([2 + (qy & 1)]) + qx.to_bytes(32, "big")

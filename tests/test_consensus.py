@@ -1,0 +1,140 @@
+"""Consensus component tests (reference core/consensus/component_test.go):
+n nodes over the in-memory fabric reach agreement on UnsignedDataSets with
+signed messages; a dead node doesn't block; forged signatures are dropped;
+the sniffer records instances.
+"""
+
+import asyncio
+import dataclasses
+
+from charon_tpu.core import consensus, qbft
+from charon_tpu.core.consensus import Component, MemTransport
+from charon_tpu.core.types import Duty, DutyType
+from charon_tpu.core.unsigneddata import AttestationDataUnsigned
+from charon_tpu.eth2 import spec
+from charon_tpu.utils import k1util
+
+
+def _run(coro, timeout=30.0):
+    async def wrapped():
+        return await asyncio.wait_for(coro, timeout=timeout)
+
+    return asyncio.run(wrapped())
+
+
+def _att_data(slot=10, index=1, seed=0):
+    return AttestationDataUnsigned(
+        spec.AttestationData(
+            slot=slot, index=index,
+            beacon_block_root=bytes([seed]) * 32,
+            source=spec.Checkpoint(0, b"\x00" * 32),
+            target=spec.Checkpoint(1, bytes([seed]) * 32)),
+        spec.AttesterDuty(pubkey=b"\xab" * 48, slot=slot, validator_index=0,
+                          committee_index=index, committee_length=1,
+                          committees_at_slot=1, validator_committee_index=0))
+
+
+def _cluster(n, *, dead=(), timer_func=None):
+    fabric = MemTransport()
+    privs = [k1util.generate_private_key() for _ in range(n)]
+    pubkeys = {i: k1util.public_key(privs[i]) for i in range(n)}
+    comps = []
+    for i in range(n):
+        ep = fabric.endpoint()
+        if i in dead:
+            # Dead node: registered but never broadcasts or handles.
+            ep.register(None)
+            comps.append(None)
+            continue
+        comps.append(Component(
+            ep, peer_idx=i, nodes=n, privkey=privs[i],
+            peer_pubkeys=pubkeys, deadliner=None, gater=lambda d: True,
+            timer_func=timer_func or consensus.default_timer_func))
+    return comps, pubkeys, privs
+
+
+def test_component_all_agree():
+    async def run():
+        n = 3
+        comps, _, _ = _cluster(n)
+        decided = {i: [] for i in range(n)}
+        for i, c in enumerate(comps):
+            c.subscribe(lambda duty, ds, i=i: _record(decided[i], ds))
+        duty = Duty(10, DutyType.ATTESTER)
+        sets = [{f"0x{'ab'*49}": _att_data(seed=i)} for i in range(n)]
+        await asyncio.gather(*(c.propose(duty, sets[i])
+                               for i, c in enumerate(comps)))
+        await _wait(lambda: all(decided[i] for i in range(n)))
+        roots = {tuple(sorted((pk, d.hash_root().hex())
+                             for pk, d in ds.items()))
+                 for i in range(n) for ds in decided[i]}
+        assert len(roots) == 1  # agreement on one proposal
+        # Sniffer recorded the instance.
+        assert comps[0].sniffer.instances[0].duty == duty
+        assert comps[0].sniffer.instances[0].msgs
+
+    _run(run())
+
+
+def test_component_dead_node():
+    async def run():
+        n = 4
+        comps, _, _ = _cluster(n, dead={3})
+        decided = {i: [] for i in range(n) if comps[i] is not None}
+        for i in decided:
+            comps[i].subscribe(lambda duty, ds, i=i: _record(decided[i], ds))
+        # Choose a duty whose round-1 leader is the dead node: slot+type+1 ≡ 3
+        # (mod 4) → slot = 3 - 2 - 1 = 0 for ATTESTER(2).
+        duty = Duty(0, DutyType.ATTESTER)
+        assert consensus.leader(duty, 1, n) == 3
+        sets = {i: {f"0x{'cd'*49}": _att_data(seed=i)} for i in decided}
+        await asyncio.gather(*(comps[i].propose(duty, sets[i])
+                               for i in decided))
+        await _wait(lambda: all(decided[i] for i in decided))
+
+    _run(run())
+
+
+def test_component_forged_signature_dropped():
+    async def run():
+        n = 3
+        comps, pubkeys, privs = _cluster(n)
+        decided = {i: [] for i in range(n)}
+        for i, c in enumerate(comps):
+            c.subscribe(lambda duty, ds, i=i: _record(decided[i], ds))
+        duty = Duty(10, DutyType.ATTESTER)
+
+        # Forge a PRE-PREPARE claiming to be from the leader but signed with
+        # the wrong key; handle() must drop it before it reaches qbft.
+        lead = consensus.leader(duty, 1, n)
+        evil_set = {f"0x{'ee'*49}": {"type": "attestation_data", "value": {}}}
+        h = consensus.hash_value(evil_set)
+        forged = qbft.Msg(qbft.MsgType.PRE_PREPARE, duty, source=lead,
+                          round=1, value=h)
+        wrong_key = privs[(lead + 1) % n]
+        wire = consensus.encode_wire(forged, wrong_key, lead, {h: evil_set}, {})
+        await comps[0]._handle(wire)
+        assert comps[0]._instances.get(duty) is None  # dropped pre-instance
+
+        sets = [{f"0x{'ab'*49}": _att_data(seed=i)} for i in range(n)]
+        await asyncio.gather(*(c.propose(duty, sets[i])
+                               for i, c in enumerate(comps)))
+        await _wait(lambda: all(decided[i] for i in range(n)))
+        for i in range(n):
+            for ds in decided[i]:
+                for pk in ds:
+                    assert pk != f"0x{'ee'*49}"
+
+    _run(run())
+
+
+async def _record(lst, ds):
+    lst.append(ds)
+
+
+async def _wait(pred, timeout=10.0):
+    deadline = asyncio.get_event_loop().time() + timeout
+    while not pred():
+        if asyncio.get_event_loop().time() > deadline:
+            raise TimeoutError("condition not reached")
+        await asyncio.sleep(0.01)

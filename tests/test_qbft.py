@@ -1,0 +1,260 @@
+"""QBFT generic-algorithm tests, modeled on the reference's unit +
+simulation suite (reference core/qbft/qbft_internal_test.go): happy path,
+dead leader, byzantine value, late joiner catching up via DECIDED, and a
+delay-randomized simulation checking agreement + termination.
+"""
+
+import asyncio
+import random
+
+import pytest
+
+from charon_tpu.core import qbft
+from charon_tpu.core.qbft import Definition, Msg, MsgType, Transport
+
+
+class Fabric:
+    """In-memory broadcast fabric: per-process inbound queues; broadcast
+    delivers to every process including the sender. Supports dropping all
+    traffic from given sources and random per-message delays."""
+
+    def __init__(self, n, *, dead=(), delay=None, seed=0):
+        self.n = n
+        self.queues = {p: asyncio.Queue() for p in range(1, n + 1)}
+        self.dead = set(dead)
+        self.delay = delay
+        self.rng = random.Random(seed)
+
+    def transport(self, process):
+        async def broadcast(msg: Msg):
+            if process in self.dead:
+                return
+            for p, q in self.queues.items():
+                if self.delay is None or p == process:
+                    q.put_nowait(msg)
+                else:
+                    d = self.rng.uniform(0, self.delay)
+                    asyncio.get_running_loop().call_later(d, q.put_nowait, msg)
+
+        return Transport(broadcast, self.queues[process])
+
+
+def round_robin_leader(instance, round_, process):
+    return (round_ % 3) + 1 == process  # n=4: leaders cycle 1,2,3... offset
+
+
+def make_definition(n, decided, *, timer_base=0.05, leader_fn=None):
+    def decide(instance, value, qcommit):
+        decided.append(value)
+
+    return Definition(
+        is_leader=leader_fn or (lambda inst, r, p: (r - 1) % n + 1 == p),
+        new_timer=qbft.increasing_round_timer(base=timer_base, inc=timer_base),
+        decide=decide,
+        nodes=n,
+    )
+
+
+async def run_cluster(n, fabric, values, defs=None, timeout=10.0):
+    """Run n processes; return list of decided values per process."""
+    decided = {p: [] for p in range(1, n + 1)}
+    tasks = []
+    for p in range(1, n + 1):
+        d = defs[p] if defs else make_definition(n, decided[p])
+        if defs is None:
+            d = make_definition(n, decided[p])
+        tasks.append(asyncio.create_task(
+            qbft.run(d, fabric.transport(p), "inst", p, values.get(p))))
+
+    async def all_decided():
+        while any(not decided[p] for p in range(1, n + 1)
+                  if p not in fabric.dead):
+            await asyncio.sleep(0.01)
+
+    try:
+        await asyncio.wait_for(all_decided(), timeout)
+    finally:
+        for t in tasks:
+            t.cancel()
+        await asyncio.gather(*tasks, return_exceptions=True)
+    return decided
+
+
+def test_quorum_faulty():
+    d = Definition(is_leader=None, new_timer=None, decide=None, nodes=4)
+    assert d.quorum == 3 and d.faulty == 1
+    d = Definition(is_leader=None, new_timer=None, decide=None, nodes=7)
+    assert d.quorum == 5 and d.faulty == 2
+    d = Definition(is_leader=None, new_timer=None, decide=None, nodes=10)
+    assert d.quorum == 7 and d.faulty == 3
+
+
+async def _impl_test_happy_path_all_agree():
+    n = 4
+    fabric = Fabric(n)
+    values = {p: f"value-from-{p}" for p in range(1, n + 1)}
+    decided = await run_cluster(n, fabric, values)
+    got = {tuple(v) for v in decided.values()}
+    assert got == {("value-from-1",)}  # round-1 leader's proposal wins
+
+
+async def _impl_test_dead_leader_round_change():
+    """With the round-1 leader dead, the cluster round-changes and decides on
+    the round-2 leader's value."""
+    n = 4
+    fabric = Fabric(n, dead={1})
+    values = {p: f"value-from-{p}" for p in range(1, n + 1)}
+    decided = await run_cluster(n, fabric, values)
+    for p in (2, 3, 4):
+        assert decided[p] == ["value-from-2"]
+
+
+async def _impl_test_two_dead_nodes_still_decides():
+    """n=4 tolerates f=1; with the quorum barely intact (3 of 4, non-leader
+    dead) consensus still completes."""
+    n = 4
+    fabric = Fabric(n, dead={4})
+    values = {p: f"value-from-{p}" for p in range(1, n + 1)}
+    decided = await run_cluster(n, fabric, values)
+    for p in (1, 2, 3):
+        assert decided[p] == ["value-from-1"]
+
+
+async def _impl_test_byzantine_pre_prepare_rejected():
+    """A non-leader's PRE-PREPARE is unjustified and must be dropped; the
+    cluster still decides on the legitimate leader's value."""
+    n = 4
+    fabric = Fabric(n)
+    values = {p: f"value-from-{p}" for p in range(1, n + 1)}
+
+    # Byzantine node 3 spams a forged PRE-PREPARE claiming round 1.
+    forged = Msg(MsgType.PRE_PREPARE, "inst", source=3, round=1,
+                 value="evil-value")
+    for q in fabric.queues.values():
+        q.put_nowait(forged)
+
+    decided = await run_cluster(n, fabric, values)
+    for p in range(1, n + 1):
+        assert decided[p] == ["value-from-1"]
+
+
+async def _impl_test_unjustified_decided_rejected():
+    """DECIDED without quorum COMMIT justification must be ignored."""
+    n = 4
+    fabric = Fabric(n)
+    values = {p: f"value-from-{p}" for p in range(1, n + 1)}
+    forged = Msg(MsgType.DECIDED, "inst", source=2, round=1, value="evil",
+                 justification=(
+                     Msg(MsgType.COMMIT, "inst", source=2, round=1, value="evil"),))
+    for q in fabric.queues.values():
+        q.put_nowait(forged)
+    decided = await run_cluster(n, fabric, values)
+    for p in range(1, n + 1):
+        assert decided[p] == ["value-from-1"]
+
+
+async def _impl_test_leader_input_value_arrives_late():
+    """The round-1 leader may start without its value: the pre-prepare is
+    held until the input future resolves (reference broadcastOwnPrePrepare
+    qbft.go:211-225)."""
+    n = 4
+    fabric = Fabric(n)
+    loop = asyncio.get_running_loop()
+    fut = loop.create_future()
+    loop.call_later(0.05, fut.set_result, "late-value")
+    values = {1: fut, 2: "v2", 3: "v3", 4: "v4"}
+    decided = await run_cluster(n, fabric, values)
+    for p in range(1, n + 1):
+        assert decided[p] == ["late-value"]
+
+
+async def _impl_test_simulation_random_delays(seed):
+    """Randomized message delays (≫ round timeout) still terminate with
+    agreement — the liveness/agreement simulation shape of the reference's
+    strategysim tests."""
+    n = 4
+    fabric = Fabric(n, delay=0.15, seed=seed)
+    values = {p: f"value-from-{p}" for p in range(1, n + 1)}
+    decided = await run_cluster(n, fabric, values, timeout=20.0)
+    all_values = [tuple(v) for v in decided.values()]
+    assert len(set(all_values)) == 1, f"disagreement: {all_values}"
+    assert len(all_values[0]) == 1
+
+
+async def _impl_test_late_joiner_catches_up_via_decided():
+    """A process that joins after the cluster decided receives DECIDED in
+    response to its ROUND-CHANGE (algorithm 3:17)."""
+    n = 4
+    fabric = Fabric(n)
+    values = {p: f"value-from-{p}" for p in range(1, n + 1)}
+
+    decided = {p: [] for p in range(1, n + 1)}
+    tasks = {}
+    for p in (1, 2, 3):
+        d = make_definition(n, decided[p])
+        tasks[p] = asyncio.create_task(
+            qbft.run(d, fabric.transport(p), "inst", p, values[p]))
+
+    while any(not decided[p] for p in (1, 2, 3)):
+        await asyncio.sleep(0.01)
+
+    # Node 4 starts late with a short timer: its ROUND-CHANGE triggers
+    # DECIDED replies from the others.
+    d4 = make_definition(n, decided[4], timer_base=0.02)
+    tasks[4] = asyncio.create_task(
+        qbft.run(d4, fabric.transport(4), "inst", 4, values[4]))
+    try:
+        await asyncio.wait_for(_until(lambda: decided[4]), 5.0)
+    finally:
+        for t in tasks.values():
+            t.cancel()
+        await asyncio.gather(*tasks.values(), return_exceptions=True)
+    assert decided[4] == decided[1]
+
+
+async def _until(pred):
+    while not pred():
+        await asyncio.sleep(0.01)
+
+
+# -- sync wrappers (the repo's asyncio.run test style; no pytest-asyncio) ----
+
+
+def _run(coro, timeout=30.0):
+    async def wrapped():
+        return await asyncio.wait_for(coro, timeout=timeout)
+
+    return asyncio.run(wrapped())
+
+
+def test_happy_path_all_agree():
+    _run(_impl_test_happy_path_all_agree())
+
+
+def test_dead_leader_round_change():
+    _run(_impl_test_dead_leader_round_change())
+
+
+def test_two_dead_nodes_still_decides():
+    _run(_impl_test_two_dead_nodes_still_decides())
+
+
+def test_byzantine_pre_prepare_rejected():
+    _run(_impl_test_byzantine_pre_prepare_rejected())
+
+
+def test_unjustified_decided_rejected():
+    _run(_impl_test_unjustified_decided_rejected())
+
+
+def test_leader_input_value_arrives_late():
+    _run(_impl_test_leader_input_value_arrives_late())
+
+
+@pytest.mark.parametrize("seed", [1, 2, 3])
+def test_simulation_random_delays(seed):
+    _run(_impl_test_simulation_random_delays(seed), timeout=40.0)
+
+
+def test_late_joiner_catches_up_via_decided():
+    _run(_impl_test_late_joiner_catches_up_via_decided())
