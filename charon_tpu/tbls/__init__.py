@@ -4,8 +4,11 @@ Mirrors the reference's seam exactly (reference tbls/tbls.go:11-76): package-
 level functions delegate to a swappable global Implementation so the duty
 pipeline is backend-agnostic. Backends:
 
-  * PythonImpl (python_impl.py) — CPU reference / correctness oracle
-    (the reference's herumi analogue).
+  * NativeImpl (native_impl.py) — C++ BLS12-381 via ctypes (native/); the
+    production CPU backend and herumi-grade baseline — the analogue of the
+    reference's cgo-herumi backend (reference tbls/herumi.go:12). Default.
+  * PythonImpl (python_impl.py) — pure-Python correctness oracle; fallback
+    when the native toolchain is unavailable.
   * TPUImpl (tpu_impl.py)       — batched JAX kernels on TPU; the north-star
     offload (bulk partial-sig verification + Lagrange threshold aggregation).
 
@@ -64,12 +67,15 @@ _impl: Implementation | None = None
 
 
 def _default() -> Implementation:
+    """Default backend: the native C++ implementation when it builds/loads
+    (the reference's production default is likewise its native herumi
+    backend, tbls/herumi.go:12), falling back to the pure-Python oracle."""
     global _impl
     with _lock:
         if _impl is None:
-            from .python_impl import PythonImpl
+            from .native_impl import best_cpu_impl
 
-            _impl = PythonImpl()
+            _impl = best_cpu_impl()
     return _impl
 
 

@@ -1,0 +1,196 @@
+"""Native (C++) CPU backend for the tbls facade.
+
+This is the framework's analogue of the reference's herumi backend — the
+reference consumes the herumi C++ BLS library through cgo behind the tbls
+seam (reference tbls/herumi.go:12-37, tbls/tbls.go:28-76); we consume our own
+C++ BLS12-381 implementation (native/bls12381.cpp) through ctypes behind the
+same seam. It is bit-identical to PythonImpl on every output (enforced by
+tests/test_native_tbls.py) and serves as:
+
+  * the production CPU fast path for the duty pipeline, and
+  * the herumi-grade CPU baseline that bench.py measures the TPU backend
+    against (BASELINE.md north star).
+
+`load_library()` always invokes `make -C native` (a no-op when the .so is
+fresh, a rebuild when sources changed) and raises NativeUnavailable on any
+build/load/selftest failure so callers can fall back to PythonImpl.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import sys
+import threading
+
+from ..crypto import fields as F
+from .python_impl import FrScalarOps
+from .types import PrivateKey, PublicKey, Signature
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+_NATIVE_DIR = os.path.join(_REPO_ROOT, "native")
+_SO_PATH = os.path.join(_NATIVE_DIR, "libbls12381.so")
+
+_lock = threading.Lock()
+_lib: ctypes.CDLL | None = None
+
+# name -> (argtypes, restype). Sizes cross the FFI as c_size_t explicitly:
+# without these declarations ctypes would pass Python ints as 32-bit c_int.
+_SIG = {
+    "ct_selftest": ([], ctypes.c_int),
+    "ct_pubkey": ([ctypes.c_char_p, ctypes.c_void_p], ctypes.c_int),
+    "ct_sign": ([ctypes.c_char_p, ctypes.c_char_p, ctypes.c_size_t, ctypes.c_void_p], ctypes.c_int),
+    "ct_hash_to_g2": ([ctypes.c_char_p, ctypes.c_size_t, ctypes.c_void_p], ctypes.c_int),
+    "ct_verify": ([ctypes.c_char_p, ctypes.c_char_p, ctypes.c_size_t, ctypes.c_char_p], ctypes.c_int),
+    "ct_aggregate_g2": ([ctypes.c_char_p, ctypes.c_size_t, ctypes.c_void_p], ctypes.c_int),
+    "ct_aggregate_g1": ([ctypes.c_char_p, ctypes.c_size_t, ctypes.c_void_p], ctypes.c_int),
+    "ct_lincomb_g2": ([ctypes.c_char_p, ctypes.c_char_p, ctypes.c_size_t, ctypes.c_void_p], ctypes.c_int),
+    "ct_verify_batch": (
+        [ctypes.c_char_p, ctypes.c_char_p, ctypes.POINTER(ctypes.c_uint64), ctypes.c_char_p, ctypes.c_char_p, ctypes.c_size_t],
+        ctypes.c_int,
+    ),
+    "ct_g1_check": ([ctypes.c_char_p], ctypes.c_int),
+    "ct_g2_check": ([ctypes.c_char_p], ctypes.c_int),
+    "ct_g2_mul": ([ctypes.c_char_p, ctypes.c_char_p, ctypes.c_void_p], ctypes.c_int),
+}
+
+
+class NativeUnavailable(RuntimeError):
+    pass
+
+
+def load_library() -> ctypes.CDLL:
+    """Build (no-op when fresh), load, and selftest the native library."""
+    global _lib
+    with _lock:
+        if _lib is not None:
+            return _lib
+        try:
+            subprocess.run(
+                ["make", "-C", _NATIVE_DIR, f"PYTHON={sys.executable}"],
+                check=True,
+                capture_output=True,
+                timeout=300,
+            )
+        except (subprocess.SubprocessError, OSError) as exc:
+            raise NativeUnavailable(f"native build failed: {exc}") from exc
+        try:
+            lib = ctypes.CDLL(_SO_PATH)
+            for name, (argtypes, restype) in _SIG.items():
+                fn = getattr(lib, name)
+                fn.argtypes = argtypes
+                fn.restype = restype
+        except (OSError, AttributeError) as exc:
+            raise NativeUnavailable(f"cannot load {_SO_PATH}: {exc}") from exc
+        if lib.ct_selftest() != 1:
+            raise NativeUnavailable("native selftest failed")
+        _lib = lib
+        return lib
+
+
+class NativeImpl(FrScalarOps):
+    """C++ CPU implementation of the tbls Implementation seam.
+
+    Scalar-field (Fr) work — Shamir split/recover and Lagrange coefficients —
+    is inherited from FrScalarOps (shared with PythonImpl); all curve and
+    pairing work crosses into C++.
+    """
+
+    name = "native-cpp"
+
+    def __init__(self) -> None:
+        self._lib = load_library()
+
+    # -- key material ---------------------------------------------------------
+
+    def secret_to_public_key(self, secret: PrivateKey) -> PublicKey:
+        self._scalar(secret)
+        out = (ctypes.c_uint8 * 48)()
+        self._lib.ct_pubkey(bytes(secret), out)
+        return PublicKey(bytes(out))
+
+    # -- threshold aggregation -------------------------------------------------
+
+    def threshold_aggregate(self, partial_sigs: dict[int, Signature]) -> Signature:
+        """Lagrange-combine partial signatures into the root signature
+        (reference tbls/herumi.go:244-283); coefficients over Fr in Python,
+        the G2 linear combination in C++. Bit-identical to a direct signature
+        by the un-split key."""
+        if not partial_sigs:
+            raise ValueError("no partial signatures to aggregate")
+        ids = sorted(partial_sigs)
+        lam = F.lagrange_coefficients_at_zero(ids)
+        sigs = b"".join(bytes(partial_sigs[i]) for i in ids)
+        lams = b"".join(l.to_bytes(32, "big") for l in lam)
+        out = (ctypes.c_uint8 * 96)()
+        rc = self._lib.ct_lincomb_g2(sigs, lams, len(ids), out)
+        if rc != 0:
+            raise ValueError("invalid partial signature encoding")
+        return Signature(bytes(out))
+
+    def threshold_aggregate_batch(self, batches: list[dict[int, Signature]]) -> list[Signature]:
+        return [self.threshold_aggregate(b) for b in batches]
+
+    # -- signing / verification ------------------------------------------------
+
+    def sign(self, private_key: PrivateKey, data: bytes) -> Signature:
+        self._scalar(private_key)
+        out = (ctypes.c_uint8 * 96)()
+        self._lib.ct_sign(bytes(private_key), data, len(data), out)
+        return Signature(bytes(out))
+
+    def verify(self, public_key: PublicKey, data: bytes, signature: Signature) -> bool:
+        return self._lib.ct_verify(bytes(public_key), data, len(data), bytes(signature)) == 1
+
+    def aggregate(self, sigs: list[Signature]) -> Signature:
+        if not sigs:
+            raise ValueError("no signatures to aggregate")
+        out = (ctypes.c_uint8 * 96)()
+        rc = self._lib.ct_aggregate_g2(b"".join(bytes(s) for s in sigs), len(sigs), out)
+        if rc != 0:
+            raise ValueError("invalid signature encoding")
+        return Signature(bytes(out))
+
+    def verify_aggregate(self, public_keys: list[PublicKey], data: bytes, signature: Signature) -> bool:
+        """FastAggregateVerify: all keys signed the same message."""
+        if not public_keys:
+            return False
+        out = (ctypes.c_uint8 * 48)()
+        rc = self._lib.ct_aggregate_g1(b"".join(bytes(pk) for pk in public_keys), len(public_keys), out)
+        if rc != 0:
+            return False
+        return self.verify(PublicKey(bytes(out)), data, signature)
+
+    # -- batched extensions ----------------------------------------------------
+
+    def verify_batch(self, public_keys: list[PublicKey], datas: list[bytes], signatures: list[Signature]) -> bool:
+        """All-or-nothing batch verification via random linear combination
+        (one shared multi-Miller loop + final exponentiation in C++)."""
+        if not (len(public_keys) == len(datas) == len(signatures)):
+            raise ValueError("length mismatch")
+        n = len(public_keys)
+        if n == 0:
+            return True
+        pks = b"".join(bytes(pk) for pk in public_keys)
+        sigs = b"".join(bytes(s) for s in signatures)
+        msgcat = b"".join(datas)
+        offs = (ctypes.c_uint64 * (n + 1))()
+        pos = 0
+        for i, d in enumerate(datas):
+            offs[i] = pos
+            pos += len(d)
+        offs[n] = pos
+        # fresh CSPRNG coefficients (low bit forced to 1 so none is zero)
+        coefs = b"".join((int.from_bytes(os.urandom(16), "big") | 1).to_bytes(16, "big") for _ in range(n))
+        return self._lib.ct_verify_batch(pks, msgcat, offs, sigs, coefs, n) == 1
+
+
+def best_cpu_impl():
+    """NativeImpl when the toolchain/library is available, else PythonImpl."""
+    try:
+        return NativeImpl()
+    except NativeUnavailable:
+        from .python_impl import PythonImpl
+
+        return PythonImpl()

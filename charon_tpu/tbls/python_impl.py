@@ -33,25 +33,17 @@ from ..crypto.serialize import (
 from .types import PrivateKey, PublicKey, Signature
 
 
-class PythonImpl:
-    """CPU reference implementation of the tbls Implementation seam
-    (reference tbls/tbls.go:28-69)."""
-
-    name = "python-cpu"
-
-    # -- key generation ------------------------------------------------------
+class FrScalarOps:
+    """Shared scalar-field (Fr) operations: key generation and the Shamir
+    split/recover scheme are pure big-int math over Fr, identical for every
+    backend — the native and Python implementations both inherit them so the
+    logic cannot diverge."""
 
     def generate_secret_key(self) -> PrivateKey:
         while True:
             k = secrets.randbelow(F.R)
             if k != 0:
                 return PrivateKey(k.to_bytes(32, "big"))
-
-    def secret_to_public_key(self, secret: PrivateKey) -> PublicKey:
-        k = self._scalar(secret)
-        return PublicKey(g1_to_bytes(jac_mul(FqOps, g1_generator(), k)))
-
-    # -- threshold scheme ----------------------------------------------------
 
     def threshold_split(self, secret: PrivateKey, total: int, threshold: int) -> dict[int, PrivateKey]:
         """Shamir split over Fr; shares evaluated at x = 1..total
@@ -77,6 +69,24 @@ class PythonImpl:
         for i, l in zip(ids, lam):
             acc = (acc + l * self._scalar(shares[i])) % F.R
         return PrivateKey(acc.to_bytes(32, "big"))
+
+    @staticmethod
+    def _scalar(secret: PrivateKey) -> int:
+        k = int.from_bytes(bytes(secret), "big")
+        if k == 0 or k >= F.R:
+            raise ValueError("invalid secret scalar")
+        return k
+
+
+class PythonImpl(FrScalarOps):
+    """CPU reference implementation of the tbls Implementation seam
+    (reference tbls/tbls.go:28-69)."""
+
+    name = "python-cpu"
+
+    def secret_to_public_key(self, secret: PrivateKey) -> PublicKey:
+        k = self._scalar(secret)
+        return PublicKey(g1_to_bytes(jac_mul(FqOps, g1_generator(), k)))
 
     def threshold_aggregate(self, partial_sigs: dict[int, Signature]) -> Signature:
         """Lagrange-combine partial signatures into the root signature
@@ -168,12 +178,3 @@ class PythonImpl:
 
     def threshold_aggregate_batch(self, batches: list[dict[int, Signature]]) -> list[Signature]:
         return [self.threshold_aggregate(b) for b in batches]
-
-    # -- helpers -------------------------------------------------------------
-
-    @staticmethod
-    def _scalar(secret: PrivateKey) -> int:
-        k = int.from_bytes(bytes(secret), "big")
-        if k == 0 or k >= F.R:
-            raise ValueError("invalid secret scalar")
-        return k

@@ -1,0 +1,242 @@
+"""Cross-implementation tests: NativeImpl (C++) vs PythonImpl (oracle).
+
+Mirrors the reference's randomizedImpl cross-compatibility strategy
+(reference tbls/tbls_test.go:210-240): every output that crosses the seam
+must be bit-identical between backends, and the two backends must agree on
+every accept/reject decision, including serialization edge cases and
+subgroup membership (where the native backend uses the fast psi/phi
+endomorphism checks and the oracle uses slow order-r multiplication).
+"""
+
+import os
+import random
+import secrets
+
+import pytest
+
+from charon_tpu.crypto import fields as F
+from charon_tpu.crypto.curve import (
+    B_G2,
+    Fq2Ops,
+    g2_in_subgroup,
+    is_on_curve,
+    jac_mul,
+    to_jacobian,
+)
+from charon_tpu.crypto.serialize import g2_to_bytes
+from charon_tpu.tbls.python_impl import PythonImpl
+from charon_tpu.tbls.types import PrivateKey, PublicKey, Signature
+
+native_impl = pytest.importorskip("charon_tpu.tbls.native_impl")
+
+try:
+    NATIVE = native_impl.NativeImpl()
+except native_impl.NativeUnavailable:  # pragma: no cover - toolchain missing
+    pytest.skip("native backend unavailable", allow_module_level=True)
+
+PY = PythonImpl()
+rng = random.Random(0xC0FFEE)
+
+
+def _keypair():
+    sk = PY.generate_secret_key()
+    return sk, PY.secret_to_public_key(sk)
+
+
+def test_selftest_and_load():
+    lib = native_impl.load_library()
+    assert lib.ct_selftest() == 1
+
+
+def test_pubkey_bit_identical():
+    for _ in range(8):
+        sk = PY.generate_secret_key()
+        assert NATIVE.secret_to_public_key(sk) == PY.secret_to_public_key(sk)
+
+
+def test_sign_bit_identical():
+    sk, _ = _keypair()
+    for n in (0, 1, 32, 100):
+        msg = secrets.token_bytes(n)
+        assert NATIVE.sign(sk, msg) == PY.sign(sk, msg)
+
+
+def test_cross_verify():
+    """Signatures from one backend verify under the other."""
+    sk, pk = _keypair()
+    msg = secrets.token_bytes(32)
+    assert NATIVE.verify(pk, msg, PY.sign(sk, msg))
+    assert PY.verify(pk, msg, NATIVE.sign(sk, msg))
+
+
+def test_randomized_interleaved_impls():
+    """Each call randomly routed to either backend; the pipeline still holds
+    together (the reference's randomizedImpl pattern)."""
+    impls = [PY, NATIVE]
+
+    def pick():
+        return rng.choice(impls)
+
+    for _ in range(4):
+        sk = pick().generate_secret_key()
+        pk = pick().secret_to_public_key(sk)
+        shares = pick().threshold_split(sk, 5, 3)
+        msg = secrets.token_bytes(32)
+        psigs = {i: pick().sign(shares[i], msg) for i in rng.sample(sorted(shares), 3)}
+        agg = pick().threshold_aggregate(psigs)
+        assert agg == pick().sign(sk, msg)
+        assert pick().verify(pk, msg, agg)
+
+
+def test_threshold_aggregate_bit_identical():
+    sk, _ = _keypair()
+    shares = PY.threshold_split(sk, 7, 5)
+    msg = secrets.token_bytes(32)
+    ids = [1, 3, 4, 6, 7]
+    psigs = {i: PY.sign(shares[i], msg) for i in ids}
+    assert NATIVE.threshold_aggregate(psigs) == PY.threshold_aggregate(psigs)
+
+
+def test_aggregate_and_verify_aggregate():
+    msg = secrets.token_bytes(32)
+    keys = [_keypair() for _ in range(4)]
+    sigs = [NATIVE.sign(sk, msg) for sk, _ in keys]
+    pks = [pk for _, pk in keys]
+    agg_native = NATIVE.aggregate(sigs)
+    assert agg_native == PY.aggregate(sigs)
+    assert NATIVE.verify_aggregate(pks, msg, agg_native)
+    assert PY.verify_aggregate(pks, msg, agg_native)
+    assert not NATIVE.verify_aggregate(pks, b"other", agg_native)
+    assert not NATIVE.verify_aggregate(pks[:-1], msg, agg_native)
+    assert not NATIVE.verify_aggregate([], msg, agg_native)
+
+
+def test_verify_batch_and_culprit_agreement():
+    n = 12
+    keys = [_keypair() for _ in range(n)]
+    msgs = [secrets.token_bytes(32) for _ in range(n)]
+    sigs = [NATIVE.sign(sk, m) for (sk, _), m in zip(keys, msgs)]
+    pks = [pk for _, pk in keys]
+    assert NATIVE.verify_batch(pks, msgs, sigs)
+    assert PY.verify_batch(pks, msgs, sigs)
+    # corrupt one signature: both must reject the batch
+    bad = list(sigs)
+    bad[5] = NATIVE.sign(keys[5][0], b"wrong message")
+    assert not NATIVE.verify_batch(pks, msgs, bad)
+    assert not PY.verify_batch(pks, msgs, bad)
+    # empty batch is vacuously true
+    assert NATIVE.verify_batch([], [], [])
+
+
+def test_serialization_edge_cases_agree():
+    sk, pk = _keypair()
+    msg = b"edge"
+    sig = NATIVE.sign(sk, msg)
+
+    def both_reject(pk_b: bytes, sig_b: bytes):
+        assert not NATIVE.verify(PublicKey(pk_b), msg, Signature(sig_b))
+        assert not PY.verify(PublicKey(pk_b), msg, Signature(sig_b))
+
+    inf_g1 = bytes([0xC0]) + bytes(47)
+    inf_g2 = bytes([0xC0]) + bytes(95)
+    both_reject(inf_g1, bytes(sig))            # infinity pubkey
+    both_reject(bytes(pk), inf_g2)             # infinity signature fails pairing
+    both_reject(bytes(47 * b"\x00") + b"\x01", bytes(sig))  # no compression bit
+    # x >= p
+    bad_x = bytearray(bytes(pk))
+    bad_x[0] |= 0x1F
+    for i in range(1, 48):
+        bad_x[i] = 0xFF
+    both_reject(bytes(bad_x), bytes(sig))
+    # non-zero payload with infinity flag
+    bad_inf = bytearray(inf_g1)
+    bad_inf[20] = 1
+    both_reject(bytes(bad_inf), bytes(sig))
+    # sign-flag flip changes the key: valid encoding, wrong key
+    flip = bytearray(bytes(pk))
+    flip[0] ^= 0x20
+    both_reject(bytes(flip), bytes(sig))
+
+
+def test_subgroup_check_agreement():
+    """The native fast psi-based G2 membership check must agree with the
+    oracle's slow order-r check, on curve points inside AND outside G2."""
+    lib = native_impl.load_library()
+
+    # members: random multiples of a hashed point
+    from charon_tpu.crypto.hash_to_curve import hash_to_g2
+
+    base = hash_to_g2(b"subgroup-test")
+    for k in (1, 2, 12345, F.R - 1):
+        member = jac_mul(Fq2Ops, base, k)
+        enc = g2_to_bytes(member)
+        assert lib.ct_g2_check(enc) == 1
+        assert g2_in_subgroup(member)
+
+    # non-members: search curve points (y^2 = x^3 + b) with small x whose
+    # order is not r (the cofactor is huge, so a random curve point is
+    # essentially never in G2)
+    found = 0
+    x0 = 1
+    while found < 3 and x0 < 200:
+        x = (x0, 0)
+        y2 = F.fq2_add(F.fq2_mul(F.fq2_sqr(x), x), B_G2)
+        y = F.fq2_sqrt(y2)
+        x0 += 1
+        if y is None:
+            continue
+        pt = to_jacobian(Fq2Ops, (x, y))
+        if not is_on_curve(Fq2Ops, (x, y), B_G2):
+            continue
+        if g2_in_subgroup(pt):
+            continue  # astronomically unlikely
+        enc = g2_to_bytes(pt)
+        assert lib.ct_g2_check(enc) == 0, f"native accepted non-subgroup point x={x0 - 1}"
+        found += 1
+    assert found == 3
+
+
+def test_g1_subgroup_check_agreement():
+    """The native fast phi-based G1 membership check must agree with the
+    oracle on curve points outside G1 (rogue-pubkey confinement)."""
+    from charon_tpu.crypto.curve import B_G1, FqOps, g1_in_subgroup
+    from charon_tpu.crypto.serialize import g1_to_bytes
+
+    lib = native_impl.load_library()
+    found = 0
+    x = 1
+    while found < 3 and x < 500:
+        y2 = (x * x * x + B_G1) % F.P
+        y = F.fq_sqrt(y2)
+        x += 1
+        if y is None:
+            continue
+        pt = to_jacobian(FqOps, (x - 1, y))
+        if g1_in_subgroup(pt):
+            continue  # cofactor is ~2^125, essentially never
+        enc = g1_to_bytes(pt)
+        assert lib.ct_g1_check(enc) == 0, f"native accepted non-subgroup G1 point x={x - 1}"
+        found += 1
+    assert found == 3
+    # and members are accepted
+    sk, pk = _keypair()
+    assert lib.ct_g1_check(bytes(pk)) == 1
+
+
+def test_hash_to_g2_known_msgs_bit_identical():
+    import ctypes
+
+    lib = native_impl.load_library()
+    from charon_tpu.crypto.hash_to_curve import hash_to_g2
+
+    for msg in (b"", b"a", b"\x00" * 32, os.urandom(77)):
+        out = (ctypes.c_uint8 * 96)()
+        lib.ct_hash_to_g2(msg, len(msg), out)
+        assert bytes(out) == g2_to_bytes(hash_to_g2(msg))
+
+
+def test_invalid_scalar_rejected():
+    with pytest.raises(ValueError):
+        NATIVE.sign(PrivateKey(bytes(32)), b"msg")
+    with pytest.raises(ValueError):
+        NATIVE.secret_to_public_key(PrivateKey(F.R.to_bytes(32, "big")))

@@ -13,9 +13,22 @@ from charon_tpu.tbls.python_impl import PythonImpl
 from charon_tpu.tbls.types import PrivateKey, PublicKey, Signature
 
 
-@pytest.fixture(scope="module")
-def impl():
-    return PythonImpl()
+def _impls():
+    impls = [pytest.param(PythonImpl(), id="python-cpu")]
+    from charon_tpu.tbls.native_impl import NativeImpl, NativeUnavailable
+
+    try:
+        impls.append(pytest.param(NativeImpl(), id="native-cpp"))
+    except NativeUnavailable as exc:  # toolchain missing — visible skip, not silence
+        impls.append(
+            pytest.param(None, id="native-cpp", marks=pytest.mark.skip(reason=f"native unavailable: {exc}"))
+        )
+    return impls
+
+
+@pytest.fixture(scope="module", params=_impls())
+def impl(request):
+    return request.param
 
 
 @pytest.fixture(scope="module")
