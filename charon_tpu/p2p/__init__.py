@@ -1,0 +1,42 @@
+"""p2p — the distributed communication backend (reference p2p/ package).
+
+A from-scratch asyncio TCP fabric with the reference's p2p capability set
+(reference p2p/p2p.go, sender.go, receive.go, relay.go, gater.go and
+app/peerinfo): authenticated-encrypted channels between cluster identities,
+per-protocol handler registry, SendAsync/SendReceive semantics with
+retry/backoff, circuit relay for NAT traversal, ping and peerinfo services,
+and adapters that run ParSigEx / consensus / leadercast over real sockets.
+"""
+
+from .adapters import (
+    PROTO_CONSENSUS,
+    PROTO_LEADERCAST,
+    PROTO_PARSIGEX,
+    ConsensusTCPEndpoint,
+    LeadercastTCPTransport,
+    ParSigExTCPTransport,
+)
+from .channel import HandshakeError, SecureChannel, TCPFrameStream
+from .node import PeerSpec, TCPNode, peer_id
+from .peerinfo import PeerInfo
+from .ping import PingService
+from .relay import RelayClient, RelayServer
+
+__all__ = [
+    "ConsensusTCPEndpoint",
+    "HandshakeError",
+    "LeadercastTCPTransport",
+    "ParSigExTCPTransport",
+    "PeerInfo",
+    "PeerSpec",
+    "PingService",
+    "PROTO_CONSENSUS",
+    "PROTO_LEADERCAST",
+    "PROTO_PARSIGEX",
+    "RelayClient",
+    "RelayServer",
+    "SecureChannel",
+    "TCPFrameStream",
+    "TCPNode",
+    "peer_id",
+]

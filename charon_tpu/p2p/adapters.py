@@ -1,0 +1,126 @@
+"""Adapters running the core duty-pipeline components over the TCP fabric.
+
+The core components are transport-agnostic (ParSigEx takes a transport with
+register/broadcast, the consensus component takes an endpoint — mirroring the
+reference, where both ride p2p send/receive handlers registered on the libp2p
+host: core/parsigex/parsigex.go:23,105, core/consensus/component.go:31,444).
+These adapters serialize the duty payloads with the core JSON codec
+(core/types.py encode/decode — the wire codec, the reference's corepb
+protobuf analogue) and move them over TCPNode protocols:
+
+  /charon/parsigex/2.0.0        partial-signature sets
+  /charon/consensus/qbft/2.0.0  signed QBFT wire messages
+  /charon/leadercast/1.0.0      leadercast proposals
+"""
+
+from __future__ import annotations
+
+import json
+
+from ..core.types import (
+    Duty,
+    DutyType,
+    ParSignedData,
+    ParSignedDataSet,
+    UnsignedDataSet,
+    clone_set,
+    decode_unsigned,
+    encode_unsigned,
+)
+from ..utils import log
+from .node import TCPNode
+
+_log = log.with_topic("p2p")
+
+PROTO_PARSIGEX = "/charon/parsigex/2.0.0"
+PROTO_CONSENSUS = "/charon/consensus/qbft/2.0.0"
+PROTO_LEADERCAST = "/charon/leadercast/1.0.0"
+
+
+def _encode_duty(duty: Duty) -> dict:
+    return {"slot": duty.slot, "type": int(duty.type)}
+
+
+def _decode_duty(obj: dict) -> Duty:
+    return Duty(int(obj["slot"]), DutyType(int(obj["type"])))
+
+
+class ParSigExTCPTransport:
+    """The reference's real parsigex path: direct n^2 broadcast over p2p
+    streams (core/parsigex/parsigex.go:105-130); replaces MemTransport."""
+
+    def __init__(self, node: TCPNode):
+        self._node = node
+        self._handler = None
+        node.register_handler(PROTO_PARSIGEX, self._on_message)
+
+    def register(self, peer_idx: int, handler) -> None:
+        # peer_idx is implicit in the node identity; kept for interface parity
+        self._handler = handler
+
+    async def broadcast(self, from_idx: int, duty: Duty, parsigs: ParSignedDataSet) -> None:
+        payload = json.dumps({
+            "duty": _encode_duty(duty),
+            "parsigs": {pk: psd.to_json() for pk, psd in parsigs.items()},
+        }).encode()
+        self._node.broadcast(PROTO_PARSIGEX, payload)
+
+    async def _on_message(self, sender_idx: int, payload: bytes) -> None:
+        if self._handler is None:
+            return None
+        obj = json.loads(payload.decode())
+        duty = _decode_duty(obj["duty"])
+        parsigs = {pk: ParSignedData.from_json(v) for pk, v in obj["parsigs"].items()}
+        await self._handler(duty, parsigs)
+        return None
+
+
+class ConsensusTCPEndpoint:
+    """QBFT wire-message endpoint (reference core/consensus/component.go:444
+    broadcast/handle over /charon/consensus/qbft/2.0.0). Messages are already
+    k1-signed by the consensus component; the channel adds transport auth."""
+
+    def __init__(self, node: TCPNode):
+        self._node = node
+        self._handler = None
+        node.register_handler(PROTO_CONSENSUS, self._on_message)
+
+    def register(self, handler) -> None:
+        self._handler = handler
+
+    async def broadcast(self, wire: dict) -> None:
+        self._node.broadcast(PROTO_CONSENSUS, json.dumps(wire).encode())
+
+    async def _on_message(self, sender_idx: int, payload: bytes) -> None:
+        if self._handler is None:
+            return None
+        await self._handler(json.loads(payload.decode()))
+        return None
+
+
+class LeadercastTCPTransport:
+    """Leadercast proposals over TCP (reference core/leadercast/transport.go)."""
+
+    def __init__(self, node: TCPNode):
+        self._node = node
+        self._handler = None
+        node.register_handler(PROTO_LEADERCAST, self._on_message)
+
+    def register(self, peer_idx: int, handler) -> None:
+        self._handler = handler
+
+    async def broadcast(self, from_idx: int, duty: Duty, data: UnsignedDataSet) -> None:
+        payload = json.dumps({
+            "duty": _encode_duty(duty),
+            "data": {pk: encode_unsigned(v) for pk, v in data.items()},
+        }).encode()
+        self._node.broadcast(PROTO_LEADERCAST, payload)
+
+    async def _on_message(self, sender_idx: int, payload: bytes) -> None:
+        if self._handler is None:
+            return None
+        obj = json.loads(payload.decode())
+        duty = _decode_duty(obj["duty"])
+        data = {pk: decode_unsigned(v) for pk, v in obj["data"].items()}
+        await self._handler(duty, clone_set(data))
+        return None

@@ -1,0 +1,188 @@
+"""Authenticated-encrypted frame channel for the p2p fabric.
+
+The reference rides libp2p, whose connections are mutually authenticated and
+encrypted (noise/tls) below every charon protocol (reference p2p/p2p.go:35-90).
+This module provides the same property from scratch over any frame stream:
+
+  * mutual authentication of secp256k1 node identities (the cluster's peer
+    keys — reference app/k1util + cluster lock peer IDs),
+  * forward-secret encryption: ephemeral-ephemeral ECDH bound to the static
+    identities by signatures, HKDF-SHA256 key derivation, AES-128-GCM frames.
+
+Handshake (initiator I, responder R; `sig_X` is k1util.Sign by X's static key):
+
+  I -> R: static_I (33) || eph_I (33) || sig_I( H("charon/ike/1:i" || eph_I || static_R) )
+  R -> I: static_R (33) || eph_R (33) || sig_R( H("charon/ike/1:r" || eph_R || eph_I || static_I) )
+
+Binding the peer's expected static key into the signed transcript prevents
+man-in-the-middle relaying; the responder gates `static_I` against the cluster
+allowlist (the reference's conn gater, p2p/gater.go).
+
+`SecureChannel` itself implements the FrameStream interface (read/write of
+whole frames), so channels nest — the relay path (relay.py) runs an
+end-to-end channel *inside* a node<->relay channel exactly this way.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import hashlib
+import hmac
+import os
+import struct
+
+from cryptography.hazmat.primitives.ciphers.aead import AESGCM
+
+from ..utils import errors, k1util
+
+_MAX_FRAME = 32 * 1024 * 1024  # hard cap; duty payloads are << 1 MiB
+
+
+class HandshakeError(RuntimeError):
+    pass
+
+
+def _hkdf_sha256(ikm: bytes, salt: bytes, info: bytes, length: int) -> bytes:
+    """RFC 5869 HKDF-SHA256."""
+    prk = hmac.new(salt, ikm, hashlib.sha256).digest()
+    okm = b""
+    t = b""
+    i = 1
+    while len(okm) < length:
+        t = hmac.new(prk, t + info + bytes([i]), hashlib.sha256).digest()
+        okm += t
+        i += 1
+    return okm[:length]
+
+
+class TCPFrameStream:
+    """u32-big-endian length-delimited frames over an asyncio TCP stream."""
+
+    def __init__(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter):
+        self._reader = reader
+        self._writer = writer
+
+    async def read(self) -> bytes:
+        hdr = await self._reader.readexactly(4)
+        (n,) = struct.unpack(">I", hdr)
+        if n > _MAX_FRAME:
+            raise errors.new("oversized p2p frame", size=n)
+        return await self._reader.readexactly(n)
+
+    async def write(self, frame: bytes) -> None:
+        if len(frame) > _MAX_FRAME:
+            raise errors.new("oversized p2p frame", size=len(frame))
+        self._writer.write(struct.pack(">I", len(frame)) + frame)
+        await self._writer.drain()
+
+    async def close(self) -> None:
+        # Abortive close: a graceful close flushes buffered frames, which can
+        # stall forever against a peer that already stopped reading (teardown
+        # with in-flight traffic). Dropping frames is fine — every protocol on
+        # top is either fire-and-forget-with-retry or timeout-bounded RPC.
+        try:
+            transport = self._writer.transport
+            if transport is not None:
+                transport.abort()
+            self._writer.close()
+            try:
+                await asyncio.wait_for(self._writer.wait_closed(), 1.0)
+            except (asyncio.TimeoutError, OSError):
+                pass
+        except (OSError, asyncio.CancelledError):
+            pass
+
+
+class SecureChannel:
+    """An authenticated AES-GCM channel over an inner FrameStream.
+
+    Build with `await SecureChannel.initiate(...)` (dialer) or
+    `await SecureChannel.respond(...)` (listener). Implements the FrameStream
+    interface itself, so channels nest (relay path).
+    """
+
+    def __init__(self, inner, send_aead: AESGCM, recv_aead: AESGCM,
+                 send_salt: bytes, recv_salt: bytes, peer_pubkey: bytes):
+        self._inner = inner
+        self._send = send_aead
+        self._recv = recv_aead
+        self._send_salt = send_salt
+        self._recv_salt = recv_salt
+        self._send_seq = 0
+        self._recv_seq = 0
+        self.peer_pubkey = peer_pubkey  # authenticated static identity
+
+    # -- handshake -----------------------------------------------------------
+
+    @classmethod
+    async def initiate(cls, inner, privkey: bytes, expected_peer: bytes) -> "SecureChannel":
+        static_i = k1util.public_key(privkey)
+        eph_priv = k1util.generate_private_key()
+        eph_i = k1util.public_key(eph_priv)
+        digest = hashlib.sha256(b"charon/ike/1:i" + eph_i + expected_peer).digest()
+        await inner.write(static_i + eph_i + k1util.sign(privkey, digest))
+
+        resp = await inner.read()
+        if len(resp) != 33 + 33 + 65:
+            raise HandshakeError("malformed responder hello")
+        static_r, eph_r, sig_r = resp[:33], resp[33:66], resp[66:]
+        if static_r != expected_peer:
+            raise HandshakeError("responder identity mismatch")
+        digest_r = hashlib.sha256(b"charon/ike/1:r" + eph_r + eph_i + static_i).digest()
+        if not k1util.verify(static_r, digest_r, sig_r):
+            raise HandshakeError("responder signature invalid")
+        return cls._derive(inner, eph_priv, eph_i, eph_r, static_r, initiator=True)
+
+    @classmethod
+    async def respond(cls, inner, privkey: bytes, allow) -> "SecureChannel":
+        """`allow(static_pubkey) -> bool` is the connection gater."""
+        static_r = k1util.public_key(privkey)
+        hello = await inner.read()
+        if len(hello) != 33 + 33 + 65:
+            raise HandshakeError("malformed initiator hello")
+        static_i, eph_i, sig_i = hello[:33], hello[33:66], hello[66:]
+        if not allow(static_i):
+            raise HandshakeError("peer not in cluster allowlist")
+        digest_i = hashlib.sha256(b"charon/ike/1:i" + eph_i + static_r).digest()
+        if not k1util.verify(static_i, digest_i, sig_i):
+            raise HandshakeError("initiator signature invalid")
+        eph_priv = k1util.generate_private_key()
+        eph_r = k1util.public_key(eph_priv)
+        digest_r = hashlib.sha256(b"charon/ike/1:r" + eph_r + eph_i + static_i).digest()
+        await inner.write(static_r + eph_r + k1util.sign(privkey, digest_r))
+        return cls._derive(inner, eph_priv, eph_r, eph_i, static_i, initiator=False)
+
+    @classmethod
+    def _derive(cls, inner, eph_priv: bytes, eph_own: bytes, eph_peer: bytes,
+                peer_static: bytes, initiator: bool) -> "SecureChannel":
+        secret = k1util.ecdh(eph_priv, eph_peer)
+        # transcript-ordered salt: initiator eph first
+        ei, er = (eph_own, eph_peer) if initiator else (eph_peer, eph_own)
+        salt = hashlib.sha256(ei + er).digest()
+        okm = _hkdf_sha256(secret, salt, b"charon/aes/1", 56)
+        key_i2r, key_r2i = okm[:16], okm[16:32]
+        salt_i2r, salt_r2i = okm[32:44], okm[44:56]
+        if initiator:
+            return cls(inner, AESGCM(key_i2r), AESGCM(key_r2i), salt_i2r, salt_r2i, peer_static)
+        return cls(inner, AESGCM(key_r2i), AESGCM(key_i2r), salt_r2i, salt_i2r, peer_static)
+
+    # -- FrameStream interface (encrypted) -----------------------------------
+
+    @staticmethod
+    def _nonce(salt: bytes, seq: int) -> bytes:
+        ctr = struct.pack(">Q", seq)
+        return salt[:4] + bytes(a ^ b for a, b in zip(salt[4:], ctr))
+
+    async def write(self, frame: bytes) -> None:
+        ct = self._send.encrypt(self._nonce(self._send_salt, self._send_seq), frame, b"")
+        self._send_seq += 1
+        await self._inner.write(ct)
+
+    async def read(self) -> bytes:
+        ct = await self._inner.read()
+        pt = self._recv.decrypt(self._nonce(self._recv_salt, self._recv_seq), ct, b"")
+        self._recv_seq += 1
+        return pt
+
+    async def close(self) -> None:
+        await self._inner.close()

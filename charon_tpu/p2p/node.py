@@ -1,0 +1,338 @@
+"""TCP p2p node: the framework's libp2p-host analogue.
+
+Mirrors the reference's p2p layer capability-for-capability with an
+asyncio-native design (reference p2p/p2p.go:35 NewTCPNode, p2p/sender.go:107
+SendAsync / :127 SendReceive, p2p/receive.go:40 RegisterHandler,
+p2p/gater.go conn gater):
+
+  * static peer set from the cluster config; identities are secp256k1 keys;
+  * every connection runs the mutually-authenticated AES-GCM channel
+    (channel.py) — the conn gater rejects non-cluster identities during the
+    handshake, before any protocol traffic;
+  * per-protocol handler registry; one multiplexed connection per peer
+    direction (the dialer's requests ride its outbound connection, responses
+    return on the same connection — the reference's one-stream-per-message
+    model collapsed onto a persistent connection);
+  * SendAsync with state-tracked retry/backoff, SendReceive RPC with
+    timeouts (reference p2p/sender.go:56-147 Sender semantics);
+  * relay fallback when a direct dial fails (relay.py; reference
+    p2p/relay.go circuit-relay-v2 reservations).
+
+Frame body layout inside the encrypted channel:
+  u8 kind (0 oneway | 1 request | 2 response | 3 error)
+  u64 request id (BE)
+  u16 protocol length (BE) || protocol utf-8
+  payload bytes
+"""
+
+from __future__ import annotations
+
+import asyncio
+import struct
+from dataclasses import dataclass, field
+from typing import Awaitable, Callable
+
+from ..utils import aio, errors, expbackoff, k1util, log, metrics
+from .channel import HandshakeError, SecureChannel, TCPFrameStream
+
+_log = log.with_topic("p2p")
+
+_msg_counter = metrics.counter("p2p_messages_total", "P2P messages", ("direction", "result"))
+_peer_gauge = metrics.gauge("p2p_peer_connected", "Peer connection state", ("peer",))
+
+KIND_ONEWAY, KIND_REQUEST, KIND_RESPONSE, KIND_ERROR = 0, 1, 2, 3
+
+Handler = Callable[[int, bytes], Awaitable[bytes | None]]
+
+
+@dataclass
+class PeerSpec:
+    """A cluster peer: index + identity + dial address (from the cluster
+    lock's peer ENRs in the reference, cluster/definition.go Operator)."""
+
+    index: int
+    pubkey: bytes  # compressed secp256k1 (33 bytes)
+    host: str = ""
+    port: int = 0
+
+    @property
+    def id(self) -> str:
+        return peer_id(self.pubkey)
+
+
+def peer_id(pubkey: bytes) -> str:
+    """Short human-readable peer ID derived from the identity key."""
+    import hashlib
+
+    return hashlib.sha256(pubkey).hexdigest()[:16]
+
+
+def encode_frame(kind: int, req_id: int, protocol: str, payload: bytes) -> bytes:
+    proto = protocol.encode()
+    return struct.pack(">BQH", kind, req_id, len(proto)) + proto + payload
+
+
+def decode_frame(frame: bytes) -> tuple[int, int, str, bytes]:
+    if len(frame) < 11:
+        raise errors.new("short p2p frame")
+    kind, req_id, plen = struct.unpack(">BQH", frame[:11])
+    if len(frame) < 11 + plen:
+        raise errors.new("truncated p2p frame")
+    proto = frame[11 : 11 + plen].decode()
+    return kind, req_id, proto, frame[11 + plen :]
+
+
+class _PeerConn:
+    """Our outbound multiplexed connection to one peer."""
+
+    def __init__(self, node: "TCPNode", spec: PeerSpec):
+        self.node = node
+        self.spec = spec
+        self.channel: SecureChannel | None = None
+        self.lock = asyncio.Lock()
+        self.next_req = 1
+        self.pending: dict[int, asyncio.Future] = {}
+        self.reader_task: asyncio.Task | None = None
+
+    DIAL_TIMEOUT = 10.0
+
+    async def ensure(self) -> SecureChannel:
+        async with self.lock:
+            if self.channel is not None:
+                return self.channel
+            # Bounded: a blackholed peer must not block the per-peer lock
+            # forever (it would freeze every queued send and the ping loop).
+            ch = await asyncio.wait_for(self.node._dial(self.spec), self.DIAL_TIMEOUT)
+            self.channel = ch
+            self.reader_task = aio.spawn(self._read_loop(ch), name=f"p2p-conn-{self.spec.index}")
+            _peer_gauge.set(1, self.spec.id)
+            return ch
+
+    async def _read_loop(self, ch: SecureChannel) -> None:
+        try:
+            while True:
+                kind, req_id, proto, payload = decode_frame(await ch.read())
+                if kind in (KIND_RESPONSE, KIND_ERROR):
+                    fut = self.pending.pop(req_id, None)
+                    if fut is not None and not fut.done():
+                        if kind == KIND_RESPONSE:
+                            fut.set_result(payload)
+                        else:
+                            fut.set_exception(errors.new("peer error", detail=payload.decode("utf-8", "replace"), proto=proto))
+                # requests never arrive on our outbound connection
+        except (asyncio.IncompleteReadError, ConnectionError, OSError):
+            pass  # normal peer disconnect
+        except Exception as exc:  # noqa: BLE001 — e.g. AEAD decrypt failure
+            _log.warn("p2p connection read loop error", peer=self.spec.id, err=exc)
+        finally:
+            await self._teardown()
+
+    async def _teardown(self) -> None:
+        async with self.lock:
+            ch, self.channel = self.channel, None
+            for fut in self.pending.values():
+                if not fut.done():
+                    fut.set_exception(errors.new("peer connection lost", peer=self.spec.id))
+            self.pending.clear()
+            _peer_gauge.set(0, self.spec.id)
+            if ch is not None:
+                await ch.close()
+
+    async def request(self, protocol: str, payload: bytes, timeout: float) -> bytes:
+        ch = await self.ensure()
+        req_id = self.next_req
+        self.next_req += 1
+        fut: asyncio.Future = asyncio.get_running_loop().create_future()
+        self.pending[req_id] = fut
+        try:
+            await ch.write(encode_frame(KIND_REQUEST, req_id, protocol, payload))
+            return await asyncio.wait_for(fut, timeout)
+        finally:
+            self.pending.pop(req_id, None)
+
+    async def send_oneway(self, protocol: str, payload: bytes) -> None:
+        ch = await self.ensure()
+        await ch.write(encode_frame(KIND_ONEWAY, 0, protocol, payload))
+
+
+class TCPNode:
+    """The p2p host (reference p2p/p2p.go:35).
+
+    `relay_dialer(spec) -> SecureChannel` may be installed by relay.py to
+    provide NAT-traversal fallback when direct dialing fails.
+    """
+
+    def __init__(self, privkey: bytes, own_index: int, peers: list[PeerSpec],
+                 listen_host: str = "127.0.0.1", listen_port: int = 0,
+                 own_spec: PeerSpec | None = None):
+        self.privkey = privkey
+        self.pubkey = k1util.public_key(privkey)
+        self.own_index = own_index
+        self.peers = {p.index: p for p in peers if p.index != own_index}
+        self._by_pubkey = {p.pubkey: p for p in peers}
+        self.listen_host = listen_host
+        self.listen_port = listen_port
+        # When the cluster shares PeerSpec objects (simnet with OS-assigned
+        # ports), start() publishes the bound address into our own spec.
+        self._own_spec = own_spec
+        self._server: asyncio.AbstractServer | None = None
+        self._handlers: dict[str, Handler] = {}
+        self._conns: dict[int, _PeerConn] = {i: _PeerConn(self, p) for i, p in self.peers.items()}
+        self._inbound: set[SecureChannel] = set()
+        self.relay_dialer: Callable[[PeerSpec], Awaitable[SecureChannel]] | None = None
+        self._send_retries = 3
+        self._closed = False
+
+    # -- lifecycle -----------------------------------------------------------
+
+    async def start(self) -> None:
+        self._server = await asyncio.start_server(self._on_inbound, self.listen_host, self.listen_port)
+        self.listen_port = self._server.sockets[0].getsockname()[1]
+        if self._own_spec is not None:
+            self._own_spec.host = self.listen_host
+            self._own_spec.port = self.listen_port
+        _log.info("p2p node listening", addr=f"{self.listen_host}:{self.listen_port}",
+                  peer_id=peer_id(self.pubkey))
+
+    async def stop(self) -> None:
+        self._closed = True
+        if self._server is not None:
+            self._server.close()
+        # Close live channels FIRST: Server.wait_closed() blocks until every
+        # connection handler returns, and inbound serve loops only return on
+        # channel close/EOF.
+        for ch in list(self._inbound):
+            await ch.close()
+        for conn in self._conns.values():
+            await conn._teardown()
+        if self._server is not None:
+            await self._server.wait_closed()
+
+    # -- handler registry (reference p2p/receive.go:40) ------------------------
+
+    def register_handler(self, protocol: str, handler: Handler) -> None:
+        self._handlers[protocol] = handler
+
+    # -- outbound (reference p2p/sender.go) ------------------------------------
+
+    async def send_receive(self, peer_index: int, protocol: str, payload: bytes,
+                           timeout: float = 10.0) -> bytes:
+        """RPC: send a request, await the peer's response."""
+        conn = self._conn(peer_index)
+        try:
+            resp = await conn.request(protocol, payload, timeout)
+            _msg_counter.inc("out", "ok")
+            return resp
+        except Exception:
+            _msg_counter.inc("out", "error")
+            await conn._teardown()
+            raise
+
+    def send_async(self, peer_index: int, protocol: str, payload: bytes) -> None:
+        """Fire-and-forget with retry/backoff (reference p2p/sender.go:107
+        SendAsync: async, state-tracked retries, logs on state change)."""
+        aio.spawn(self._send_with_retry(peer_index, protocol, payload),
+                  name=f"p2p-send-{peer_index}-{protocol}")
+
+    def broadcast(self, protocol: str, payload: bytes) -> None:
+        for idx in self.peers:
+            self.send_async(idx, protocol, payload)
+
+    async def _send_with_retry(self, peer_index: int, protocol: str, payload: bytes) -> None:
+        conn = self._conn(peer_index)
+        backoff = expbackoff.Backoff(expbackoff.Config(base=0.1, max_delay=2.0))
+        for attempt in range(self._send_retries):
+            if self._closed:
+                return
+            try:
+                await conn.send_oneway(protocol, payload)
+                _msg_counter.inc("out", "ok")
+                return
+            except Exception as exc:  # noqa: BLE001 — retried, then logged
+                await conn._teardown()
+                if self._closed:
+                    return
+                if attempt == self._send_retries - 1:
+                    _msg_counter.inc("out", "error")
+                    _log.warn("p2p send failed", peer=conn.spec.id, proto=protocol, err=exc)
+                    return
+                await backoff.wait()
+
+    def _conn(self, peer_index: int) -> _PeerConn:
+        conn = self._conns.get(peer_index)
+        if conn is None:
+            raise errors.new("unknown peer index", index=peer_index)
+        return conn
+
+    # -- dialing ---------------------------------------------------------------
+
+    async def _dial(self, spec: PeerSpec) -> SecureChannel:
+        try:
+            reader, writer = await asyncio.open_connection(spec.host, spec.port)
+            stream = TCPFrameStream(reader, writer)
+            return await SecureChannel.initiate(stream, self.privkey, spec.pubkey)
+        except (OSError, HandshakeError, asyncio.IncompleteReadError) as exc:
+            if self.relay_dialer is not None:
+                _log.info("direct dial failed; trying relay", peer=spec.id, err=exc)
+                return await self.relay_dialer(spec)
+            raise
+
+    # -- inbound ---------------------------------------------------------------
+
+    def _gate(self, static_pubkey: bytes) -> bool:
+        """Conn gater: only cluster identities may connect
+        (reference p2p/gater.go)."""
+        return static_pubkey in self._by_pubkey
+
+    async def _on_inbound(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter) -> None:
+        stream = TCPFrameStream(reader, writer)
+        try:
+            ch = await SecureChannel.respond(stream, self.privkey, self._gate)
+        except (HandshakeError, asyncio.IncompleteReadError, ConnectionError, OSError) as exc:
+            _log.warn("inbound handshake rejected", err=exc)
+            await stream.close()
+            return
+        await self.serve_channel(ch)
+
+    async def serve_channel(self, ch: SecureChannel) -> None:
+        """Serve requests arriving on an authenticated channel (also used by
+        the relay path for spliced end-to-end channels)."""
+        spec = self._by_pubkey.get(ch.peer_pubkey)
+        sender_idx = spec.index if spec is not None else -1
+        self._inbound.add(ch)
+        try:
+            while True:
+                kind, req_id, proto, payload = decode_frame(await ch.read())
+                if kind not in (KIND_ONEWAY, KIND_REQUEST):
+                    continue  # responses never arrive on inbound channels
+                handler = self._handlers.get(proto)
+                if handler is None:
+                    _msg_counter.inc("in", "unknown_proto")
+                    if kind == KIND_REQUEST:
+                        await ch.write(encode_frame(KIND_ERROR, req_id, proto, b"unknown protocol"))
+                    continue
+                aio.spawn(self._dispatch(ch, kind, req_id, proto, payload, handler, sender_idx),
+                          name=f"p2p-dispatch-{proto}")
+        except (asyncio.IncompleteReadError, ConnectionError, OSError):
+            pass
+        except Exception as exc:  # noqa: BLE001 — connection-scoped failure
+            _log.warn("p2p serve loop error", err=exc)
+        finally:
+            self._inbound.discard(ch)
+            await ch.close()
+
+    async def _dispatch(self, ch: SecureChannel, kind: int, req_id: int, proto: str,
+                        payload: bytes, handler: Handler, sender_idx: int) -> None:
+        try:
+            resp = await handler(sender_idx, payload)
+            _msg_counter.inc("in", "ok")
+            if kind == KIND_REQUEST:
+                await ch.write(encode_frame(KIND_RESPONSE, req_id, proto, resp or b""))
+        except Exception as exc:  # noqa: BLE001 — handler failure -> error frame
+            _msg_counter.inc("in", "handler_error")
+            _log.warn("p2p handler error", proto=proto, err=exc)
+            if kind == KIND_REQUEST:
+                try:
+                    await ch.write(encode_frame(KIND_ERROR, req_id, proto, str(exc).encode()))
+                except (ConnectionError, OSError):
+                    pass

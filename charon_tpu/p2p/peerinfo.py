@@ -1,0 +1,84 @@
+"""Peer metadata exchange (reference app/peerinfo/peerinfo.go, protocol
+/charon/peerinfo/2.0.0): version / git hash / start time / clock offset,
+feeding version-compatibility gauges and the health checks."""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import time
+
+from ..utils import aio, log, metrics, version
+from .node import TCPNode
+
+_log = log.with_topic("peerinfo")
+
+PROTOCOL = "/charon/peerinfo/2.0.0"
+
+_clock_offset = metrics.gauge("p2p_peerinfo_clock_offset_seconds", "Peer clock offset", ("peer",))
+_peer_version = metrics.gauge("p2p_peerinfo_version", "Peer version seen (1=same)", ("peer", "version"))
+
+
+class PeerInfo:
+    def __init__(self, node: TCPNode, interval: float = 60.0):
+        self._node = node
+        self._interval = interval
+        self._start_time = time.time()
+        self._task: asyncio.Task | None = None
+        self.infos: dict[int, dict] = {}
+        node.register_handler(PROTOCOL, self._handle)
+
+    def _own_info(self) -> dict:
+        return {
+            "version": version.VERSION,
+            "git_hash": version.git_commit(),
+            "start_time": self._start_time,
+            "sent_at": time.time(),
+        }
+
+    async def _handle(self, sender_idx: int, payload: bytes) -> bytes:
+        try:
+            info = json.loads(payload.decode())
+            if sender_idx >= 0:
+                self._record(sender_idx, info, rtt=None)
+        except (ValueError, KeyError):
+            pass
+        return json.dumps(self._own_info()).encode()
+
+    def _record(self, idx: int, info: dict, rtt: float | None) -> None:
+        self.infos[idx] = info
+        spec = self._node.peers.get(idx)
+        pid = spec.id if spec else str(idx)
+        if rtt is not None and "sent_at" in info:
+            # peer stamped sent_at when responding; offset ~ peer_time - (t0 + rtt/2)
+            offset = float(info["sent_at"]) - (time.time() - rtt / 2)
+            _clock_offset.set(offset, pid)
+        _peer_version.set(1.0 if info.get("version") == version.VERSION else 0.0,
+                          pid, str(info.get("version")))
+
+    def start(self) -> None:
+        self._task = aio.spawn(self._loop(), name="peerinfo")
+
+    def stop(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+
+    async def exchange_once(self, idx: int) -> dict:
+        t0 = time.time()
+        resp = await self._node.send_receive(
+            idx, PROTOCOL, json.dumps(self._own_info()).encode(), timeout=5.0)
+        rtt = time.time() - t0
+        info = json.loads(resp.decode())
+        self._record(idx, info, rtt)
+        return info
+
+    async def _loop(self) -> None:
+        while True:
+            for idx in list(self._node.peers):
+                try:
+                    await self.exchange_once(idx)
+                except asyncio.CancelledError:
+                    return
+                except Exception:  # noqa: BLE001 — ping covers liveness logging
+                    pass
+            await asyncio.sleep(self._interval)

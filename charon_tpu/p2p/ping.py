@@ -1,0 +1,74 @@
+"""Periodic peer ping with RTT metrics (reference p2p/ping.go NewPingService,
+wired at app/app.go:324): the liveness signal feeding /readyz's
+quorum-peers-connected check (reference app/monitoringapi.go:107)."""
+
+from __future__ import annotations
+
+import asyncio
+import os
+import time
+
+from ..utils import aio, log, metrics
+from .node import TCPNode
+
+_log = log.with_topic("ping")
+
+PROTOCOL = "/charon/ping/1.0.0"
+
+_rtt_hist = metrics.histogram("p2p_ping_latency_seconds", "Ping RTT per peer", ("peer",))
+_ping_success = metrics.gauge("p2p_ping_success", "1 if last ping succeeded", ("peer",))
+
+
+class PingService:
+    def __init__(self, node: TCPNode, interval: float = 10.0):
+        self._node = node
+        self._interval = interval
+        self._task: asyncio.Task | None = None
+        self.rtts: dict[int, float] = {}
+        self.alive: dict[int, bool] = {}
+        node.register_handler(PROTOCOL, self._handle)
+
+    async def _handle(self, sender_idx: int, payload: bytes) -> bytes:
+        return payload  # echo
+
+    def start(self) -> None:
+        self._task = aio.spawn(self._loop(), name="ping-service")
+
+    def stop(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+
+    def connected_count(self) -> int:
+        return sum(1 for up in self.alive.values() if up)
+
+    async def ping_once(self, peer_idx: int) -> float:
+        nonce = os.urandom(8)
+        t0 = time.monotonic()
+        resp = await self._node.send_receive(peer_idx, PROTOCOL, nonce, timeout=5.0)
+        rtt = time.monotonic() - t0
+        if resp != nonce:
+            raise ValueError("ping payload mismatch")
+        return rtt
+
+    async def _loop(self) -> None:
+        spec_ids = {i: p.id for i, p in self._node.peers.items()}
+        while True:
+            for idx in list(self._node.peers):
+                try:
+                    rtt = await self.ping_once(idx)
+                    self.rtts[idx] = rtt
+                    was = self.alive.get(idx)
+                    self.alive[idx] = True
+                    _rtt_hist.observe(rtt, spec_ids[idx])
+                    _ping_success.set(1, spec_ids[idx])
+                    if was is False:
+                        _log.info("peer is back up", peer=spec_ids[idx])
+                except asyncio.CancelledError:
+                    return
+                except Exception as exc:  # noqa: BLE001 — peer down
+                    was = self.alive.get(idx)
+                    self.alive[idx] = False
+                    _ping_success.set(0, spec_ids[idx])
+                    if was is not False:
+                        _log.warn("peer is down", peer=spec_ids[idx], err=exc)
+            await asyncio.sleep(self._interval)

@@ -1,0 +1,225 @@
+"""Circuit relay: NAT traversal for nodes that cannot dial each other
+directly (reference p2p/relay.go circuit-relay-v2 reservations via
+Obol-operated relays, cmd/relay standalone server).
+
+Protocol (all frames ride authenticated node<->relay channels):
+
+  * a node REGISTERs its identity with the relay and keeps the registration
+    connection open (the reference's relay "reservation");
+  * a dialer sends DIAL(target-peer-pubkey); the relay notifies the target
+    over its registration connection (INCOMING), the target opens a fresh
+    ACCEPT connection, and the relay splices the two connections together,
+    blindly forwarding frames;
+  * the dialer then runs the normal end-to-end SecureChannel handshake with
+    the target *through* the splice — the relay never sees plaintext and
+    cannot impersonate either side (channel.py signatures bind the cluster
+    identities).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+
+from ..utils import aio, errors, k1util, log
+from .channel import SecureChannel, TCPFrameStream
+
+_log = log.with_topic("relay")
+
+PROTOCOL = "/charon/relay/1.0.0"
+
+
+class RelayServer:
+    """Standalone relay (reference cmd/relay/relay.go:33). Gating is open by
+    default — the reference's public relays likewise accept any peer and the
+    end-to-end channel security never depends on the relay."""
+
+    def __init__(self, privkey: bytes, listen_host: str = "127.0.0.1", listen_port: int = 0,
+                 allow=None):
+        self.privkey = privkey
+        self.pubkey = k1util.public_key(privkey)
+        self.listen_host = listen_host
+        self.listen_port = listen_port
+        self._allow = allow or (lambda pk: True)
+        self._server: asyncio.AbstractServer | None = None
+        self._registered: dict[bytes, SecureChannel] = {}
+        self._awaiting_accept: dict[bytes, asyncio.Future] = {}
+        self._live: set[SecureChannel] = set()
+
+    async def start(self) -> None:
+        self._server = await asyncio.start_server(self._on_conn, self.listen_host, self.listen_port)
+        self.listen_port = self._server.sockets[0].getsockname()[1]
+        _log.info("relay listening", addr=f"{self.listen_host}:{self.listen_port}")
+
+    async def stop(self) -> None:
+        if self._server is not None:
+            self._server.close()
+        # Close channels before wait_closed(): handler coroutines only return
+        # on channel close/EOF, and wait_closed() waits for all of them.
+        for ch in list(self._live):
+            await ch.close()
+        self._live.clear()
+        self._registered.clear()
+        if self._server is not None:
+            await self._server.wait_closed()
+
+    async def _on_conn(self, reader, writer) -> None:
+        stream = TCPFrameStream(reader, writer)
+        try:
+            ch = await SecureChannel.respond(stream, self.privkey, self._allow)
+            cmd = json.loads((await ch.read()).decode())
+        except Exception as exc:  # noqa: BLE001 — bad client
+            _log.warn("relay conn rejected", err=exc)
+            await stream.close()
+            return
+        self._live.add(ch)
+        try:
+            await self._handle(ch, cmd)
+        finally:
+            self._live.discard(ch)
+
+    async def _handle(self, ch: SecureChannel, cmd: dict) -> None:
+        kind = cmd.get("cmd")
+        peer = ch.peer_pubkey
+        if kind == "register":
+            old = self._registered.get(peer)
+            self._registered[peer] = ch
+            if old is not None:
+                await old.close()
+            _log.info("peer registered with relay", peer=peer.hex()[:12])
+            try:
+                # hold the registration connection open; it carries INCOMING
+                # notifications and nothing else inbound.
+                while True:
+                    await ch.read()
+            except Exception:  # noqa: BLE001 — registration dropped
+                if self._registered.get(peer) is ch:
+                    del self._registered[peer]
+        elif kind == "dial":
+            target = bytes.fromhex(cmd.get("target", ""))
+            reg = self._registered.get(target)
+            if reg is None:
+                await ch.write(json.dumps({"ok": False, "error": "target not registered"}).encode())
+                await ch.close()
+                return
+            fut: asyncio.Future = asyncio.get_running_loop().create_future()
+            self._awaiting_accept[peer + target] = fut
+            try:
+                await reg.write(json.dumps({"cmd": "incoming", "from": peer.hex()}).encode())
+                accept_ch = await asyncio.wait_for(fut, timeout=10.0)
+            except (asyncio.TimeoutError, Exception):  # noqa: BLE001
+                self._awaiting_accept.pop(peer + target, None)
+                await ch.write(json.dumps({"ok": False, "error": "target did not accept"}).encode())
+                await ch.close()
+                return
+            await ch.write(json.dumps({"ok": True}).encode())
+            await self._splice(ch, accept_ch)
+        elif kind == "accept":
+            dialer = bytes.fromhex(cmd.get("from", ""))
+            fut = self._awaiting_accept.pop(dialer + peer, None)
+            if fut is None or fut.done():
+                await ch.close()
+                return
+            fut.set_result(ch)
+            # splicing is driven by the dial-side handler
+        else:
+            await ch.close()
+
+    @staticmethod
+    async def _splice(a: SecureChannel, b: SecureChannel) -> None:
+        """Blind bidirectional frame forwarding."""
+
+        async def pump(src: SecureChannel, dst: SecureChannel) -> None:
+            try:
+                while True:
+                    await dst.write(await src.read())
+            except Exception:  # noqa: BLE001 — either side closing ends the splice
+                pass
+
+        t1 = aio.spawn(pump(a, b), name="relay-splice-ab")
+        t2 = aio.spawn(pump(b, a), name="relay-splice-ba")
+        await asyncio.wait([t1, t2], return_when=asyncio.FIRST_COMPLETED)
+        await a.close()
+        await b.close()
+
+
+class RelayClient:
+    """Node-side relay integration: keeps a registration with each relay and
+    provides the `relay_dialer` fallback installed on TCPNode."""
+
+    def __init__(self, node, relay_addrs: list[tuple[str, int, bytes]]):
+        """relay_addrs: (host, port, relay_pubkey) triples."""
+        self._node = node
+        self._relays = relay_addrs
+        self._tasks: list[asyncio.Task] = []
+        node.relay_dialer = self.dial_via_relay
+
+    async def start(self) -> None:
+        for host, port, pub in self._relays:
+            self._tasks.append(aio.spawn(self._register_loop(host, port, pub),
+                                         name=f"relay-register-{host}:{port}"))
+
+    async def stop(self) -> None:
+        for t in self._tasks:
+            t.cancel()
+
+    async def _register_loop(self, host: str, port: int, relay_pub: bytes) -> None:
+        from ..utils import expbackoff
+
+        backoff = expbackoff.Backoff(expbackoff.Config(base=0.2, max_delay=10.0))
+        while True:
+            ch = None
+            try:
+                ch = await self._connect_relay(host, port, relay_pub)
+                await ch.write(json.dumps({"cmd": "register"}).encode())
+                backoff.reset()
+                _log.info("registered with relay", relay=f"{host}:{port}")
+                while True:
+                    note = json.loads((await ch.read()).decode())
+                    if note.get("cmd") == "incoming":
+                        dialer = bytes.fromhex(note["from"])
+                        aio.spawn(self._accept(host, port, relay_pub, dialer),
+                                  name="relay-accept")
+            except asyncio.CancelledError:
+                if ch is not None:
+                    await ch.close()
+                return
+            except Exception as exc:  # noqa: BLE001 — reconnect with backoff
+                if ch is not None:
+                    await ch.close()
+                _log.warn("relay registration lost", relay=f"{host}:{port}", err=exc)
+                await backoff.wait()
+
+    async def _connect_relay(self, host: str, port: int, relay_pub: bytes) -> SecureChannel:
+        reader, writer = await asyncio.open_connection(host, port)
+        return await SecureChannel.initiate(TCPFrameStream(reader, writer),
+                                            self._node.privkey, relay_pub)
+
+    async def _accept(self, host: str, port: int, relay_pub: bytes, dialer_pub: bytes) -> None:
+        """Open the accept leg, then serve the end-to-end channel as inbound."""
+        outer = await self._connect_relay(host, port, relay_pub)
+        await outer.write(json.dumps({"cmd": "accept", "from": dialer_pub.hex()}).encode())
+        try:
+            inner = await SecureChannel.respond(outer, self._node.privkey, self._node._gate)
+        except Exception as exc:  # noqa: BLE001 — handshake through relay failed
+            _log.warn("relayed inbound handshake failed", err=exc)
+            await outer.close()
+            return
+        await self._node.serve_channel(inner)
+
+    async def dial_via_relay(self, spec) -> SecureChannel:
+        last: Exception | None = None
+        for host, port, relay_pub in self._relays:
+            outer: SecureChannel | None = None
+            try:
+                outer = await self._connect_relay(host, port, relay_pub)
+                await outer.write(json.dumps({"cmd": "dial", "target": spec.pubkey.hex()}).encode())
+                resp = json.loads((await outer.read()).decode())
+                if not resp.get("ok"):
+                    raise errors.new("relay dial refused", reason=resp.get("error"))
+                return await SecureChannel.initiate(outer, self._node.privkey, spec.pubkey)
+            except Exception as exc:  # noqa: BLE001 — try next relay
+                last = exc
+                if outer is not None:
+                    await outer.close()
+        raise errors.new("all relays failed", peer=spec.id) from last

@@ -41,6 +41,7 @@ class SimNode:
     aggsig_db: aggsigdb.MemDB
     retryer: retry_util.Retryer
     consensus: object = None
+    tcp_node: object = None
     tasks: list[asyncio.Task] = field(default_factory=list)
 
     async def start(self) -> None:
@@ -59,6 +60,8 @@ class SimNode:
         for t in self.tasks:
             t.cancel()
         await asyncio.gather(*self.tasks, return_exceptions=True)
+        if self.tcp_node is not None:
+            await self.tcp_node.stop()
 
 
 @dataclass
@@ -68,6 +71,11 @@ class SimCluster:
     root_secrets: list[tbls.PrivateKey]
 
     async def start(self) -> None:
+        # TCP fabric first: every node must be listening (ports published to
+        # the shared PeerSpecs) before any duty traffic can dial out.
+        for n in self.nodes:
+            if n.tcp_node is not None:
+                await n.tcp_node.start()
         for n in self.nodes:
             await n.start()
 
@@ -80,11 +88,15 @@ def new_simnet(num_validators: int = 2, threshold: int = 3, num_nodes: int = 4,
                seconds_per_slot: float = 0.2, slots_per_epoch: int = 8,
                genesis_delay: float = 0.3, use_vmock: bool = True,
                verify_peer_partials: bool = True,
-               consensus_type: str = "qbft") -> SimCluster:
+               consensus_type: str = "qbft",
+               transport: str = "mem") -> SimCluster:
     """Assemble an n-node in-process cluster sharing one beaconmock.
 
     consensus_type: "qbft" (the production default, like the reference) or
     "leadercast" (the reference's legacy/test-only bootstrap path).
+    transport: "mem" (in-memory fabrics) or "tcp" (real sockets — the
+    reference's simnet likewise runs over real TCP libp2p,
+    testutil/integration/simnet_test.go).
     """
     root_secrets, node_keys = new_cluster_for_t(num_validators, threshold, num_nodes)
     root_pubkey_bytes = [
@@ -96,21 +108,40 @@ def new_simnet(num_validators: int = 2, threshold: int = 3, num_nodes: int = 4,
                         slots_per_epoch=slots_per_epoch)
     chain = beacon._spec
 
-    lcast_transport = leadercast.MemTransport()
-    parsig_transport = parsigex.MemTransport()
-    consensus_fabric = consensus_mod.MemTransport()
     # Node identity keys (p2p/consensus signing, reference app/k1util).
     identity_keys = [k1util.generate_private_key() for _ in range(num_nodes)]
     identity_pubkeys = {i: k1util.public_key(k)
                         for i, k in enumerate(identity_keys)}
 
+    tcp_nodes: list = [None] * num_nodes
+    if transport == "tcp":
+        from ..p2p import (ConsensusTCPEndpoint, LeadercastTCPTransport,
+                           ParSigExTCPTransport, PeerSpec, TCPNode)
+
+        specs = [PeerSpec(i, identity_pubkeys[i]) for i in range(num_nodes)]
+        tcp_nodes = [TCPNode(identity_keys[i], i, specs, own_spec=specs[i])
+                     for i in range(num_nodes)]
+        lcast_transports = [LeadercastTCPTransport(n) for n in tcp_nodes]
+        parsig_transports = [ParSigExTCPTransport(n) for n in tcp_nodes]
+        consensus_endpoints = [ConsensusTCPEndpoint(n) for n in tcp_nodes]
+    elif transport == "mem":
+        lcast_shared = leadercast.MemTransport()
+        parsig_shared = parsigex.MemTransport()
+        consensus_fabric = consensus_mod.MemTransport()
+        lcast_transports = [lcast_shared] * num_nodes
+        parsig_transports = [parsig_shared] * num_nodes
+        consensus_endpoints = [consensus_fabric.endpoint() for _ in range(num_nodes)]
+    else:
+        raise ValueError(f"unknown transport {transport!r}")
+
     nodes = []
     for i, keys in enumerate(node_keys):
-        node = _build_node(i, keys, beacon, chain, lcast_transport,
-                           parsig_transport, num_nodes, use_vmock,
+        node = _build_node(i, keys, beacon, chain, lcast_transports[i],
+                           parsig_transports[i], num_nodes, use_vmock,
                            verify_peer_partials, consensus_type,
-                           consensus_fabric, identity_keys[i],
+                           consensus_endpoints[i], identity_keys[i],
                            identity_pubkeys)
+        node.tcp_node = tcp_nodes[i]
         nodes.append(node)
     return SimCluster(beacon, nodes, root_secrets)
 
@@ -118,7 +149,7 @@ def new_simnet(num_validators: int = 2, threshold: int = 3, num_nodes: int = 4,
 def _build_node(idx: int, keys: KeyShares, beacon: BeaconMock, chain,
                 lcast_transport, parsig_transport, num_nodes: int,
                 use_vmock: bool, verify_peer_partials: bool,
-                consensus_type: str, consensus_fabric, identity_key: bytes,
+                consensus_type: str, consensus_endpoint, identity_key: bytes,
                 identity_pubkeys: dict[int, bytes]) -> SimNode:
     """The reference's wireCoreWorkflow (app/app.go:333-527) in miniature."""
     deadline_fn = new_duty_deadline_func(chain)
@@ -131,7 +162,7 @@ def _build_node(idx: int, keys: KeyShares, beacon: BeaconMock, chain,
     parsig_db = parsigdb.MemDB(keys.threshold, Deadliner(deadline_fn))
     if consensus_type == "qbft":
         consensus = consensus_mod.Component(
-            consensus_fabric.endpoint(), peer_idx=idx, nodes=num_nodes,
+            consensus_endpoint, peer_idx=idx, nodes=num_nodes,
             privkey=identity_key, peer_pubkeys=identity_pubkeys,
             deadliner=Deadliner(deadline_fn), gater=new_duty_gater(chain))
     elif consensus_type == "leadercast":

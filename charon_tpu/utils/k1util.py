@@ -2,13 +2,14 @@
 
 Node identity keys: every charon node holds a secp256k1 private key used for
 p2p identity (ENR), consensus-message signatures, cluster-definition operator
-signatures (EIP-712) and DKG node signatures. The reference uses the decred
-implementation; this is a from-scratch pure-Python implementation of the
-curve + RFC-6979 deterministic ECDSA with low-S normalization and public-key
-recovery (65-byte [R || S || V] signatures, matching k1util.Sign65).
-
-Pure Python is fast enough here: identity signatures are per-message
-consensus/DKG traffic (a few dozen per slot), not the BLS hot path.
+signatures (EIP-712) and DKG node signatures. The reference uses the native
+decred implementation; we likewise route the hot operations (sign, verify,
+recover, ecdh, pubkey) to the native C++ implementation in
+native/secp256k1.cpp when it loads — consensus traffic k1-verifies every
+wire message per receiver, which melts the event loop at ~20 ms/verify in
+pure Python (~0.5 ms native). The pure-Python implementation below remains
+the correctness oracle and the fallback when the toolchain is unavailable
+(cross-validated bit-for-bit by tests/test_native_k1.py).
 """
 
 from __future__ import annotations
@@ -200,3 +201,123 @@ def recover(digest: bytes, sig: bytes) -> bytes:
         raise ValueError("recovered infinity")
     qx, qy = Q
     return bytes([2 + (qy & 1)]) + qx.to_bytes(32, "big")
+
+
+def ecdh(privkey: bytes, peer_pubkey: bytes) -> bytes:
+    """ECDH shared secret: sha256 of the compressed shared point
+    (used by the p2p secure channel's handshake, charon_tpu/p2p/channel.py)."""
+    k = _scalar(privkey)
+    pt = _mul(decompress(peer_pubkey), k)
+    if pt is _INF:
+        raise ValueError("ECDH produced infinity")
+    x, y = pt
+    comp = bytes([2 + (y & 1)]) + x.to_bytes(32, "big")
+    return hashlib.sha256(comp).digest()
+
+
+# ---------------------------------------------------------------------------
+# Native (C++) fast path — semantics bit-identical to the functions above.
+# Activated lazily on first use (not at import: loading may invoke the native
+# build). ctypes argtypes/restype are declared by native_impl._SIG.
+# ---------------------------------------------------------------------------
+
+_PY_PUBLIC_KEY = public_key
+_PY_SIGN = sign
+_PY_VERIFY = verify
+_PY_RECOVER = recover
+_PY_ECDH = ecdh
+
+_impl = {
+    "public_key": _PY_PUBLIC_KEY,
+    "sign": _PY_SIGN,
+    "verify": _PY_VERIFY,
+    "recover": _PY_RECOVER,
+    "ecdh": _PY_ECDH,
+}
+_native_checked = False
+
+
+def _try_native() -> None:
+    """Route hot k1 ops through native/secp256k1.cpp when it loads (once)."""
+    global _native_checked
+    if _native_checked:
+        return
+    _native_checked = True
+    try:
+        import ctypes
+
+        from ..tbls.native_impl import load_library
+
+        lib = load_library()
+        if lib.k1_selftest() != 1:
+            return
+    except Exception:  # noqa: BLE001 — any failure keeps the Python path
+        return
+
+    def n_public_key(privkey: bytes) -> bytes:
+        if len(privkey) != 32:
+            raise ValueError("private key must be 32 bytes")
+        out = (ctypes.c_uint8 * 33)()
+        if lib.k1_pubkey(bytes(privkey), out) != 0:
+            raise ValueError("invalid private key scalar")
+        return bytes(out)
+
+    def n_sign(privkey: bytes, digest: bytes) -> bytes:
+        if len(privkey) != 32:
+            raise ValueError("private key must be 32 bytes")
+        if len(digest) != 32:
+            raise ValueError("digest must be 32 bytes")
+        out = (ctypes.c_uint8 * 65)()
+        if lib.k1_sign(bytes(privkey), digest, out) != 0:
+            raise ValueError("invalid private key scalar")
+        return bytes(out)
+
+    def n_verify(pubkey: bytes, digest: bytes, sig: bytes) -> bool:
+        if len(sig) not in (64, 65) or len(digest) != 32 or len(pubkey) != 33:
+            # other encodings (65-byte uncompressed keys) use the Python oracle
+            return _PY_VERIFY(pubkey, digest, sig)
+        return lib.k1_verify(bytes(pubkey), digest, bytes(sig), len(sig)) == 1
+
+    def n_recover(digest: bytes, sig: bytes) -> bytes:
+        if len(sig) != 65 or len(digest) != 32:
+            raise ValueError("need 65-byte sig and 32-byte digest")
+        out = (ctypes.c_uint8 * 33)()
+        if lib.k1_recover(digest, bytes(sig), out) != 0:
+            raise ValueError("invalid signature")
+        return bytes(out)
+
+    def n_ecdh(privkey: bytes, peer_pubkey: bytes) -> bytes:
+        if len(privkey) != 32 or len(peer_pubkey) != 33:
+            return _PY_ECDH(privkey, peer_pubkey)
+        out = (ctypes.c_uint8 * 32)()
+        if lib.k1_ecdh(bytes(privkey), bytes(peer_pubkey), out) != 0:
+            raise ValueError("invalid ECDH inputs")
+        return bytes(out)
+
+    _impl.update(public_key=n_public_key, sign=n_sign, verify=n_verify,
+                 recover=n_recover, ecdh=n_ecdh)
+
+
+def public_key(privkey: bytes) -> bytes:  # noqa: F811 — lazy-native dispatcher
+    _try_native()
+    return _impl["public_key"](privkey)
+
+
+def sign(privkey: bytes, digest: bytes) -> bytes:  # noqa: F811
+    _try_native()
+    return _impl["sign"](privkey, digest)
+
+
+def verify(pubkey: bytes, digest: bytes, sig: bytes) -> bool:  # noqa: F811
+    _try_native()
+    return _impl["verify"](pubkey, digest, sig)
+
+
+def recover(digest: bytes, sig: bytes) -> bytes:  # noqa: F811
+    _try_native()
+    return _impl["recover"](digest, sig)
+
+
+def ecdh(privkey: bytes, peer_pubkey: bytes) -> bytes:  # noqa: F811
+    _try_native()
+    return _impl["ecdh"](privkey, peer_pubkey)

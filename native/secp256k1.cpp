@@ -1,0 +1,764 @@
+// Native secp256k1 (k1) ECDSA for node identities — the hot path of
+// consensus-message signing/verification (every QBFT wire message is
+// k1-signed and verified per receiver; the reference likewise uses a native
+// secp256k1 implementation via decred, reference app/k1util/k1util.go).
+//
+// From-scratch 4x64-limb Montgomery implementation. Semantics are
+// bit-identical to the pure-Python charon_tpu/utils/k1util.py (RFC 6979
+// deterministic nonces, low-S normalization, 65-byte [R||S||V] signatures,
+// sha256-of-compressed-point ECDH) — enforced by tests/test_native_k1.py.
+
+#include <cstdint>
+#include <cstring>
+
+#include "sha256.h"
+
+typedef unsigned __int128 u128;
+
+#define K1_API extern "C" __attribute__((visibility("default")))
+
+namespace k1 {
+
+// ---------------------------------------------------------------------------
+// generic 4x64 Montgomery field (used for both Fp and Fn)
+// ---------------------------------------------------------------------------
+
+struct FieldCtx {
+    uint64_t mod[4];
+    uint64_t inv64;   // -mod^-1 mod 2^64
+    uint64_t r2[4];   // 2^512 mod mod
+    uint64_t one[4];  // 2^256 mod mod (Montgomery 1)
+};
+
+struct Fe {
+    uint64_t v[4];
+};
+
+static const Fe FE_ZERO = {{0, 0, 0, 0}};
+
+static inline bool fe_is_zero(const Fe &a) {
+    return (a.v[0] | a.v[1] | a.v[2] | a.v[3]) == 0;
+}
+
+static inline bool fe_eq(const Fe &a, const Fe &b) {
+    return ((a.v[0] ^ b.v[0]) | (a.v[1] ^ b.v[1]) | (a.v[2] ^ b.v[2]) | (a.v[3] ^ b.v[3])) == 0;
+}
+
+static inline bool geq(const uint64_t *a, const uint64_t *b) {
+    for (int i = 3; i >= 0; i--) {
+        if (a[i] > b[i]) return true;
+        if (a[i] < b[i]) return false;
+    }
+    return true;
+}
+
+__attribute__((unused)) static inline void fe_sub_mod(const FieldCtx &C, Fe &a) {
+    if (geq(a.v, C.mod)) {
+        u128 borrow = 0;
+        for (int i = 0; i < 4; i++) {
+            u128 d = (u128)a.v[i] - C.mod[i] - borrow;
+            a.v[i] = (uint64_t)d;
+            borrow = (d >> 64) & 1;
+        }
+    }
+}
+
+static void fe_add(const FieldCtx &C, Fe &o, const Fe &a, const Fe &b) {
+    u128 carry = 0;
+    uint64_t tmp[4];
+    for (int i = 0; i < 4; i++) {
+        u128 s = (u128)a.v[i] + b.v[i] + carry;
+        tmp[i] = (uint64_t)s;
+        carry = s >> 64;
+    }
+    if (carry || geq(tmp, C.mod)) {
+        u128 borrow = 0;
+        for (int i = 0; i < 4; i++) {
+            u128 d = (u128)tmp[i] - C.mod[i] - borrow;
+            o.v[i] = (uint64_t)d;
+            borrow = (d >> 64) & 1;
+        }
+    } else {
+        memcpy(o.v, tmp, sizeof(tmp));
+    }
+}
+
+static void fe_sub(const FieldCtx &C, Fe &o, const Fe &a, const Fe &b) {
+    u128 borrow = 0;
+    for (int i = 0; i < 4; i++) {
+        u128 d = (u128)a.v[i] - b.v[i] - borrow;
+        o.v[i] = (uint64_t)d;
+        borrow = (d >> 64) & 1;
+    }
+    if (borrow) {
+        u128 carry = 0;
+        for (int i = 0; i < 4; i++) {
+            u128 s = (u128)o.v[i] + C.mod[i] + carry;
+            o.v[i] = (uint64_t)s;
+            carry = s >> 64;
+        }
+    }
+}
+
+static void fe_neg(const FieldCtx &C, Fe &o, const Fe &a) {
+    if (fe_is_zero(a)) { o = a; return; }
+    u128 borrow = 0;
+    for (int i = 0; i < 4; i++) {
+        u128 d = (u128)C.mod[i] - a.v[i] - borrow;
+        o.v[i] = (uint64_t)d;
+        borrow = (d >> 64) & 1;
+    }
+}
+
+// CIOS Montgomery multiplication (4 limbs)
+static void fe_mul(const FieldCtx &C, Fe &o, const Fe &a, const Fe &b) {
+    uint64_t t[6] = {0};
+    for (int i = 0; i < 4; i++) {
+        u128 carry = 0;
+        uint64_t ai = a.v[i];
+        for (int j = 0; j < 4; j++) {
+            u128 s = (u128)t[j] + (u128)ai * b.v[j] + carry;
+            t[j] = (uint64_t)s;
+            carry = s >> 64;
+        }
+        u128 s = (u128)t[4] + carry;
+        t[4] = (uint64_t)s;
+        t[5] = (uint64_t)(s >> 64);
+
+        uint64_t m = t[0] * C.inv64;
+        carry = ((u128)t[0] + (u128)m * C.mod[0]) >> 64;
+        for (int j = 1; j < 4; j++) {
+            u128 s2 = (u128)t[j] + (u128)m * C.mod[j] + carry;
+            t[j - 1] = (uint64_t)s2;
+            carry = s2 >> 64;
+        }
+        s = (u128)t[4] + carry;
+        t[3] = (uint64_t)s;
+        t[4] = t[5] + (uint64_t)(s >> 64);
+        t[5] = 0;
+    }
+    // Result < 2*mod but mod is within 2^32 of 2^256, so the result can
+    // carry into t[4]; one subtraction of mod (with 2^256 wraparound)
+    // normalizes since result - mod < mod < 2^256.
+    memcpy(o.v, t, 32);
+    if (t[4] || geq(o.v, C.mod)) {
+        u128 borrow = 0;
+        for (int i = 0; i < 4; i++) {
+            u128 d = (u128)o.v[i] - C.mod[i] - borrow;
+            o.v[i] = (uint64_t)d;
+            borrow = (d >> 64) & 1;
+        }
+    }
+}
+
+static inline void fe_sqr(const FieldCtx &C, Fe &o, const Fe &a) { fe_mul(C, o, a, a); }
+
+static void fe_pow(const FieldCtx &C, Fe &o, const Fe &a, const uint64_t *exp) {
+    Fe result, base = a;
+    bool started = false;
+    for (int i = 3; i >= 0; i--) {
+        for (int b = 63; b >= 0; b--) {
+            if (started) fe_sqr(C, result, result);
+            if ((exp[i] >> b) & 1) {
+                if (started) fe_mul(C, result, result, base);
+                else { result = base; started = true; }
+            }
+        }
+    }
+    if (started) o = result;
+    else memcpy(o.v, C.one, 32);
+}
+
+static void fe_to_mont(const FieldCtx &C, Fe &o, const uint64_t n[4]) {
+    Fe r2, t;
+    memcpy(r2.v, C.r2, 32);
+    memcpy(t.v, n, 32);
+    fe_mul(C, o, t, r2);
+}
+
+static void fe_from_mont(const FieldCtx &C, uint64_t o[4], const Fe &a) {
+    Fe one_n = {{1, 0, 0, 0}};
+    Fe t;
+    fe_mul(C, t, a, one_n);
+    memcpy(o, t.v, 32);
+}
+
+static void be32_to_limbs(uint64_t o[4], const uint8_t in[32]) {
+    for (int i = 0; i < 4; i++) {
+        uint64_t limb = 0;
+        for (int j = 0; j < 8; j++) limb = (limb << 8) | in[i * 8 + j];
+        o[3 - i] = limb;
+    }
+}
+
+static void limbs_to_be32(uint8_t o[32], const uint64_t in[4]) {
+    for (int i = 0; i < 4; i++) {
+        uint64_t limb = in[3 - i];
+        for (int j = 0; j < 8; j++) o[i * 8 + j] = (uint8_t)(limb >> (56 - 8 * j));
+    }
+}
+
+// ---------------------------------------------------------------------------
+// curve contexts (constants computed at static-init from the moduli)
+// ---------------------------------------------------------------------------
+
+// p = 2^256 - 2^32 - 977, n = group order
+static const uint64_t P_MOD[4] = {0xFFFFFFFEFFFFFC2FULL, 0xFFFFFFFFFFFFFFFFULL,
+                                  0xFFFFFFFFFFFFFFFFULL, 0xFFFFFFFFFFFFFFFFULL};
+static const uint64_t N_MOD[4] = {0xBFD25E8CD0364141ULL, 0xBAAEDCE6AF48A03BULL,
+                                  0xFFFFFFFFFFFFFFFEULL, 0xFFFFFFFFFFFFFFFFULL};
+static const uint64_t GX[4] = {0x59F2815B16F81798ULL, 0x029BFCDB2DCE28D9ULL,
+                               0x55A06295CE870B07ULL, 0x79BE667EF9DCBBACULL};
+static const uint64_t GY[4] = {0x9C47D08FFB10D4B8ULL, 0xFD17B448A6855419ULL,
+                               0x5DA4FBFC0E1108A8ULL, 0x483ADA7726A3C465ULL};
+
+static uint64_t compute_inv64(const uint64_t mod0) {
+    // Newton iteration for -mod^-1 mod 2^64
+    uint64_t inv = 1;
+    for (int i = 0; i < 6; i++) inv *= 2 - mod0 * inv;
+    return (uint64_t)(0 - inv);
+}
+
+static void compute_r2(const uint64_t mod[4], uint64_t r2[4]) {
+    // 2^512 mod m by repeated doubling of (2^256 mod m)
+    // first: r = 2^256 mod m = 2^256 - m (since m > 2^255)
+    uint64_t r[4];
+    u128 borrow = 0;
+    for (int i = 0; i < 4; i++) {
+        u128 d = (u128)0 - mod[i] - borrow;
+        r[i] = (uint64_t)d;
+        borrow = 1;  // 2^256 - m always borrows beyond the top
+    }
+    // now double 256 times mod m
+    for (int k = 0; k < 256; k++) {
+        u128 carry = 0;
+        uint64_t t[4];
+        for (int i = 0; i < 4; i++) {
+            u128 s = ((u128)r[i] << 1) | carry;
+            t[i] = (uint64_t)s;
+            carry = s >> 64;
+        }
+        if (carry || geq(t, mod)) {
+            u128 b2 = 0;
+            for (int i = 0; i < 4; i++) {
+                u128 d = (u128)t[i] - mod[i] - b2;
+                r[i] = (uint64_t)d;
+                b2 = (d >> 64) & 1;
+            }
+        } else {
+            memcpy(r, t, 32);
+        }
+    }
+    memcpy(r2, r, 32);
+}
+
+static FieldCtx make_ctx(const uint64_t mod[4]) {
+    FieldCtx c;
+    memcpy(c.mod, mod, 32);
+    c.inv64 = compute_inv64(mod[0]);
+    compute_r2(mod, c.r2);
+    // one = mont(1) = 2^256 mod m = r2 "demontgomeried"... compute via to_mont(1)
+    Fe one_n = {{1, 0, 0, 0}}, r2fe, res;
+    memcpy(r2fe.v, c.r2, 32);
+    // mont_mul(1, r2) = r2 * 1 * R^-1 = 2^256 mod m
+    // (temporarily construct ctx pieces needed by fe_mul: mod+inv64 suffice)
+    FieldCtx tmp = c;
+    fe_mul(tmp, res, one_n, r2fe);
+    memcpy(c.one, res.v, 32);
+    return c;
+}
+
+static const FieldCtx FP = make_ctx(P_MOD);
+static const FieldCtx FN = make_ctx(N_MOD);
+
+// exponents for inversion/sqrt over Fp: p-2, (p+1)/4; over Fn: n-2
+static void sub_small(uint64_t o[4], const uint64_t a[4], uint64_t k) {
+    u128 borrow = 0;
+    for (int i = 0; i < 4; i++) {
+        u128 d = (u128)a[i] - (i == 0 ? k : 0) - borrow;
+        o[i] = (uint64_t)d;
+        borrow = (d >> 64) & 1;
+    }
+}
+
+static void fp_inv(Fe &o, const Fe &a) {
+    uint64_t e[4];
+    sub_small(e, P_MOD, 2);
+    fe_pow(FP, o, a, e);
+}
+
+static bool fp_sqrt(Fe &o, const Fe &a) {
+    // p % 4 == 3: sqrt = a^((p+1)/4) = a^((p>>2)+1)
+    uint64_t e[4];
+    for (int i = 0; i < 4; i++) {
+        e[i] = P_MOD[i] >> 2;
+        if (i < 3) e[i] |= (P_MOD[i + 1] & 3) << 62;
+    }
+    e[0] += 1;  // no carry: (p>>2) low limb cannot be all-ones
+    Fe s, chk;
+    fe_pow(FP, s, a, e);
+    fe_sqr(FP, chk, s);
+    if (!fe_eq(chk, a)) return false;
+    o = s;
+    return true;
+}
+
+static void fn_inv(Fe &o, const Fe &a) {
+    uint64_t e[4];
+    sub_small(e, N_MOD, 2);
+    fe_pow(FN, o, a, e);
+}
+
+// ---------------------------------------------------------------------------
+// point arithmetic (Jacobian, a=0, b=7) over Fp
+// ---------------------------------------------------------------------------
+
+struct Pt {
+    Fe X, Y, Z;  // Z==0 -> infinity
+};
+
+static Pt pt_infinity() {
+    Pt p;
+    memcpy(p.X.v, FP.one, 32);
+    memcpy(p.Y.v, FP.one, 32);
+    p.Z = FE_ZERO;
+    return p;
+}
+
+static inline bool pt_is_inf(const Pt &p) { return fe_is_zero(p.Z); }
+
+static void pt_double(Pt &o, const Pt &p) {
+    if (fe_is_zero(p.Z) || fe_is_zero(p.Y)) { o = pt_infinity(); return; }
+    Fe A, B, Cc, D, E, F, t, X3, Y3, Z3;
+    fe_sqr(FP, A, p.X);
+    fe_sqr(FP, B, p.Y);
+    fe_sqr(FP, Cc, B);
+    fe_add(FP, t, p.X, B);
+    fe_sqr(FP, t, t);
+    fe_sub(FP, t, t, A);
+    fe_sub(FP, t, t, Cc);
+    fe_add(FP, D, t, t);
+    fe_add(FP, E, A, A);
+    fe_add(FP, E, E, A);
+    fe_sqr(FP, F, E);
+    fe_add(FP, t, D, D);
+    fe_sub(FP, X3, F, t);
+    fe_sub(FP, t, D, X3);
+    fe_mul(FP, t, E, t);
+    Fe c8;
+    fe_add(FP, c8, Cc, Cc);
+    fe_add(FP, c8, c8, c8);
+    fe_add(FP, c8, c8, c8);
+    fe_sub(FP, Y3, t, c8);
+    fe_mul(FP, t, p.Y, p.Z);
+    fe_add(FP, Z3, t, t);
+    o.X = X3; o.Y = Y3; o.Z = Z3;
+}
+
+static void pt_add(Pt &o, const Pt &p1, const Pt &p2) {
+    if (fe_is_zero(p1.Z)) { o = p2; return; }
+    if (fe_is_zero(p2.Z)) { o = p1; return; }
+    Fe Z1Z1, Z2Z2, U1, U2, S1, S2, t;
+    fe_sqr(FP, Z1Z1, p1.Z);
+    fe_sqr(FP, Z2Z2, p2.Z);
+    fe_mul(FP, U1, p1.X, Z2Z2);
+    fe_mul(FP, U2, p2.X, Z1Z1);
+    fe_mul(FP, t, p1.Y, p2.Z);
+    fe_mul(FP, S1, t, Z2Z2);
+    fe_mul(FP, t, p2.Y, p1.Z);
+    fe_mul(FP, S2, t, Z1Z1);
+    if (fe_eq(U1, U2)) {
+        if (fe_eq(S1, S2)) { pt_double(o, p1); return; }
+        o = pt_infinity();
+        return;
+    }
+    Fe H, I, J, r, V, X3, Y3, Z3;
+    fe_sub(FP, H, U2, U1);
+    fe_add(FP, t, H, H);
+    fe_sqr(FP, I, t);
+    fe_mul(FP, J, H, I);
+    fe_sub(FP, t, S2, S1);
+    fe_add(FP, r, t, t);
+    fe_mul(FP, V, U1, I);
+    fe_sqr(FP, X3, r);
+    fe_sub(FP, X3, X3, J);
+    fe_add(FP, t, V, V);
+    fe_sub(FP, X3, X3, t);
+    fe_sub(FP, t, V, X3);
+    fe_mul(FP, t, r, t);
+    Fe sj;
+    fe_mul(FP, sj, S1, J);
+    fe_add(FP, sj, sj, sj);
+    fe_sub(FP, Y3, t, sj);
+    fe_mul(FP, t, p1.Z, p2.Z);
+    fe_add(FP, t, t, t);
+    fe_mul(FP, Z3, t, H);
+    o.X = X3; o.Y = Y3; o.Z = Z3;
+}
+
+static void pt_mul(Pt &o, const Pt &p, const uint64_t k[4]) {
+    Pt acc = pt_infinity();
+    bool started = false;
+    for (int i = 3; i >= 0; i--) {
+        for (int b = 63; b >= 0; b--) {
+            if (started) pt_double(acc, acc);
+            if ((k[i] >> b) & 1) {
+                if (started) pt_add(acc, acc, p);
+                else { acc = p; started = true; }
+            }
+        }
+    }
+    o = started ? acc : pt_infinity();
+}
+
+static Pt generator() {
+    Pt g;
+    fe_to_mont(FP, g.X, GX);
+    fe_to_mont(FP, g.Y, GY);
+    memcpy(g.Z.v, FP.one, 32);
+    return g;
+}
+
+struct Aff {
+    Fe x, y;
+    bool inf;
+};
+
+static Aff pt_affine(const Pt &p) {
+    if (fe_is_zero(p.Z)) return {FE_ZERO, FE_ZERO, true};
+    Fe zi, zi2, zi3, x, y;
+    fp_inv(zi, p.Z);
+    fe_sqr(FP, zi2, zi);
+    fe_mul(FP, zi3, zi2, zi);
+    fe_mul(FP, x, p.X, zi2);
+    fe_mul(FP, y, p.Y, zi3);
+    return {x, y, false};
+}
+
+// compressed SEC1 encode/decode
+static void pt_compress(uint8_t out[33], const Aff &a) {
+    uint64_t xn[4], yn[4];
+    fe_from_mont(FP, xn, a.x);
+    fe_from_mont(FP, yn, a.y);
+    out[0] = 2 + (yn[0] & 1);
+    limbs_to_be32(out + 1, xn);
+}
+
+static bool pt_decompress(Pt &o, const uint8_t in[33]) {
+    if (in[0] != 2 && in[0] != 3) return false;
+    uint64_t xn[4];
+    be32_to_limbs(xn, in + 1);
+    if (geq(xn, P_MOD)) return false;
+    Fe x, y2, y, seven;
+    fe_to_mont(FP, x, xn);
+    fe_sqr(FP, y2, x);
+    fe_mul(FP, y2, y2, x);
+    uint64_t sevn[4] = {7, 0, 0, 0};
+    fe_to_mont(FP, seven, sevn);
+    fe_add(FP, y2, y2, seven);
+    if (!fp_sqrt(y, y2)) return false;
+    uint64_t yn[4];
+    fe_from_mont(FP, yn, y);
+    if ((yn[0] & 1) != (uint64_t)(in[0] & 1)) fe_neg(FP, y, y);
+    o.X = x; o.Y = y;
+    memcpy(o.Z.v, FP.one, 32);
+    return true;
+}
+
+// ---------------------------------------------------------------------------
+// scalar (mod n) helpers over byte arrays
+// ---------------------------------------------------------------------------
+
+static bool scalar_valid(const uint64_t k[4]) {
+    if ((k[0] | k[1] | k[2] | k[3]) == 0) return false;
+    return !geq(k, N_MOD);
+}
+
+// n/2 for low-S check
+static void half_n(uint64_t o[4]) {
+    uint64_t c = 0;
+    for (int i = 3; i >= 0; i--) {
+        uint64_t cur = N_MOD[i];
+        o[i] = (cur >> 1) | (c << 63);
+        c = cur & 1;
+    }
+}
+
+// ---------------------------------------------------------------------------
+// RFC 6979 deterministic nonce (HMAC-SHA256), matching k1util._rfc6979_k
+// ---------------------------------------------------------------------------
+
+static void hmac_sha256(uint8_t out[32], const uint8_t key[32], size_t keylen,
+                        const uint8_t *data, size_t datalen) {
+    uint8_t k0[64] = {0};
+    memcpy(k0, key, keylen);
+    uint8_t ipad[64], opad[64];
+    for (int i = 0; i < 64; i++) {
+        ipad[i] = k0[i] ^ 0x36;
+        opad[i] = k0[i] ^ 0x5C;
+    }
+    uint8_t inner[32];
+    {
+        Sha256 s;
+        s.update(ipad, 64);
+        s.update(data, datalen);
+        s.final(inner);
+    }
+    Sha256 s;
+    s.update(opad, 64);
+    s.update(inner, 32);
+    s.final(out);
+}
+
+// derive k per RFC 6979 (qlen = 256, HMAC-SHA256); h1 = digest bytes
+static void rfc6979_k(uint64_t out_k[4], const uint8_t x32[32], const uint8_t h1[32]) {
+    uint8_t V[32], K[32];
+    memset(V, 0x01, 32);
+    memset(K, 0x00, 32);
+    uint8_t buf[32 + 1 + 32 + 32];
+    // K = HMAC(K, V || 0x00 || x || h1)
+    memcpy(buf, V, 32);
+    buf[32] = 0x00;
+    memcpy(buf + 33, x32, 32);
+    memcpy(buf + 65, h1, 32);
+    hmac_sha256(K, K, 32, buf, sizeof(buf));
+    hmac_sha256(V, K, 32, V, 32);
+    memcpy(buf, V, 32);
+    buf[32] = 0x01;
+    hmac_sha256(K, K, 32, buf, sizeof(buf));
+    hmac_sha256(V, K, 32, V, 32);
+    while (true) {
+        hmac_sha256(V, K, 32, V, 32);
+        uint64_t k[4];
+        be32_to_limbs(k, V);
+        if (scalar_valid(k)) {
+            memcpy(out_k, k, 32);
+            return;
+        }
+        memcpy(buf, V, 32);
+        buf[32] = 0x00;
+        hmac_sha256(K, K, 32, buf, 33);
+        hmac_sha256(V, K, 32, V, 32);
+    }
+}
+
+}  // namespace k1
+
+// ---------------------------------------------------------------------------
+// public C API (charon_tpu/utils/k1util.py routes here when available)
+// ---------------------------------------------------------------------------
+
+using namespace k1;
+
+K1_API int k1_selftest(void) {
+    // G * 2 == G + G, and pubkey of scalar 1 == compressed G
+    Pt g = generator(), d1, d2;
+    pt_double(d1, g);
+    pt_add(d2, g, g);
+    Aff a1 = pt_affine(d1), a2 = pt_affine(d2);
+    if (!fe_eq(a1.x, a2.x) || !fe_eq(a1.y, a2.y)) return 0;
+    // n*G == infinity
+    Pt ng;
+    pt_mul(ng, g, N_MOD);
+    if (!pt_is_inf(ng)) return 0;
+    return 1;
+}
+
+K1_API int k1_pubkey(const uint8_t *priv32, uint8_t *out33) {
+    uint64_t k[4];
+    be32_to_limbs(k, priv32);
+    if (!scalar_valid(k)) return -1;
+    Pt g = generator(), r;
+    pt_mul(r, g, k);
+    pt_compress(out33, pt_affine(r));
+    return 0;
+}
+
+K1_API int k1_sign(const uint8_t *priv32, const uint8_t *digest32, uint8_t *out65) {
+    uint64_t x[4];
+    be32_to_limbs(x, priv32);
+    if (!scalar_valid(x)) return -1;
+    uint8_t h1[32];
+    memcpy(h1, digest32, 32);
+    Fe xm;
+    fe_to_mont(FN, xm, x);
+    while (true) {
+        uint64_t kn[4];
+        rfc6979_k(kn, priv32, h1);
+        Pt g = generator(), R;
+        pt_mul(R, g, kn);
+        Aff ra = pt_affine(R);
+        uint64_t px[4], py[4];
+        fe_from_mont(FP, px, ra.x);
+        fe_from_mont(FP, py, ra.y);
+        // r = px mod n
+        uint64_t r[4];
+        memcpy(r, px, 32);
+        bool overflow = geq(r, N_MOD);
+        if (overflow) {
+            u128 borrow = 0;
+            for (int i = 0; i < 4; i++) {
+                u128 d = (u128)r[i] - N_MOD[i] - borrow;
+                r[i] = (uint64_t)d;
+                borrow = (d >> 64) & 1;
+            }
+        }
+        if ((r[0] | r[1] | r[2] | r[3]) == 0) {
+            sha256(h1, h1, 32);
+            continue;
+        }
+        // s = (z + r*x) / k mod n
+        uint64_t z[4];
+        be32_to_limbs(z, h1);
+        // z mod n
+        if (geq(z, N_MOD)) {
+            u128 borrow = 0;
+            for (int i = 0; i < 4; i++) {
+                u128 d = (u128)z[i] - N_MOD[i] - borrow;
+                z[i] = (uint64_t)d;
+                borrow = (d >> 64) & 1;
+            }
+        }
+        Fe zm, rm, km, ki, s;
+        fe_to_mont(FN, zm, z);
+        fe_to_mont(FN, rm, r);
+        fe_to_mont(FN, km, kn);
+        fe_mul(FN, s, rm, xm);
+        fe_add(FN, s, s, zm);
+        fn_inv(ki, km);
+        fe_mul(FN, s, s, ki);
+        uint64_t sn[4];
+        fe_from_mont(FN, sn, s);
+        if ((sn[0] | sn[1] | sn[2] | sn[3]) == 0) {
+            sha256(h1, h1, 32);
+            continue;
+        }
+        int v = (int)(py[0] & 1) ^ (overflow ? 1 : 0);
+        uint64_t nh[4];
+        half_n(nh);
+        if (geq(sn, nh) && memcmp(sn, nh, 32) != 0) {
+            // s > n/2 (geq and not equal): negate
+            u128 borrow = 0;
+            uint64_t s2[4];
+            for (int i = 0; i < 4; i++) {
+                u128 d = (u128)N_MOD[i] - sn[i] - borrow;
+                s2[i] = (uint64_t)d;
+                borrow = (d >> 64) & 1;
+            }
+            memcpy(sn, s2, 32);
+            v ^= 1;
+        }
+        limbs_to_be32(out65, r);
+        limbs_to_be32(out65 + 32, sn);
+        out65[64] = (uint8_t)v;
+        return 0;
+    }
+}
+
+K1_API int k1_verify(const uint8_t *pub33, const uint8_t *digest32, const uint8_t *sig, size_t siglen) {
+    if (siglen != 64 && siglen != 65) return 0;
+    Pt Q;
+    if (!pt_decompress(Q, pub33)) return 0;
+    uint64_t r[4], s[4];
+    be32_to_limbs(r, sig);
+    be32_to_limbs(s, sig + 32);
+    if (!scalar_valid(r) || !scalar_valid(s)) return 0;
+    uint64_t z[4];
+    be32_to_limbs(z, digest32);
+    if (geq(z, N_MOD)) {
+        u128 borrow = 0;
+        for (int i = 0; i < 4; i++) {
+            u128 d = (u128)z[i] - N_MOD[i] - borrow;
+            z[i] = (uint64_t)d;
+            borrow = (d >> 64) & 1;
+        }
+    }
+    Fe sm, si, zm, rm, u1m, u2m;
+    fe_to_mont(FN, sm, s);
+    fn_inv(si, sm);
+    fe_to_mont(FN, zm, z);
+    fe_to_mont(FN, rm, r);
+    fe_mul(FN, u1m, zm, si);
+    fe_mul(FN, u2m, rm, si);
+    uint64_t u1[4], u2[4];
+    fe_from_mont(FN, u1, u1m);
+    fe_from_mont(FN, u2, u2m);
+    Pt g = generator(), a, b, sum;
+    pt_mul(a, g, u1);
+    pt_mul(b, Q, u2);
+    pt_add(sum, a, b);
+    if (pt_is_inf(sum)) return 0;
+    Aff aff = pt_affine(sum);
+    uint64_t xn[4];
+    fe_from_mont(FP, xn, aff.x);
+    if (geq(xn, N_MOD)) {
+        u128 borrow = 0;
+        for (int i = 0; i < 4; i++) {
+            u128 d = (u128)xn[i] - N_MOD[i] - borrow;
+            xn[i] = (uint64_t)d;
+            borrow = (d >> 64) & 1;
+        }
+    }
+    return memcmp(xn, r, 32) == 0 ? 1 : 0;
+}
+
+K1_API int k1_recover(const uint8_t *digest32, const uint8_t *sig65, uint8_t *out33) {
+    uint64_t r[4], s[4];
+    be32_to_limbs(r, sig65);
+    be32_to_limbs(s, sig65 + 32);
+    int v = sig65[64];
+    if (v != 0 && v != 1) return -1;
+    if (!scalar_valid(r) || !scalar_valid(s)) return -1;
+    // x = r (v < 2 means no overflow case)
+    if (geq(r, P_MOD)) return -1;
+    uint8_t comp[33];
+    comp[0] = 2 + (v & 1);
+    limbs_to_be32(comp + 1, r);
+    Pt R;
+    if (!pt_decompress(R, comp)) return -1;
+    uint64_t z[4];
+    be32_to_limbs(z, digest32);
+    if (geq(z, N_MOD)) {
+        u128 borrow = 0;
+        for (int i = 0; i < 4; i++) {
+            u128 d = (u128)z[i] - N_MOD[i] - borrow;
+            z[i] = (uint64_t)d;
+            borrow = (d >> 64) & 1;
+        }
+    }
+    // Q = r^-1 (s*R - z*G)
+    Fe rm, ri, sm, zm;
+    fe_to_mont(FN, rm, r);
+    fn_inv(ri, rm);
+    fe_to_mont(FN, sm, s);
+    fe_to_mont(FN, zm, z);
+    Fe negz;
+    fe_neg(FN, negz, zm);
+    Fe u1m, u2m;
+    fe_mul(FN, u1m, negz, ri);  // -z/r
+    fe_mul(FN, u2m, sm, ri);    // s/r
+    uint64_t u1[4], u2[4];
+    fe_from_mont(FN, u1, u1m);
+    fe_from_mont(FN, u2, u2m);
+    Pt g = generator(), a, b, Q;
+    pt_mul(a, g, u1);
+    pt_mul(b, R, u2);
+    pt_add(Q, a, b);
+    if (pt_is_inf(Q)) return -1;
+    pt_compress(out33, pt_affine(Q));
+    return 0;
+}
+
+K1_API int k1_ecdh(const uint8_t *priv32, const uint8_t *pub33, uint8_t *out32) {
+    uint64_t k[4];
+    be32_to_limbs(k, priv32);
+    if (!scalar_valid(k)) return -1;
+    Pt Q, R;
+    if (!pt_decompress(Q, pub33)) return -1;
+    pt_mul(R, Q, k);
+    if (pt_is_inf(R)) return -1;
+    uint8_t comp[33];
+    pt_compress(comp, pt_affine(R));
+    sha256(out32, comp, 33);
+    return 0;
+}

@@ -1,0 +1,94 @@
+"""Cross-validation: native C++ secp256k1 vs the pure-Python oracle.
+
+The native path (native/secp256k1.cpp, routed through charon_tpu.utils.k1util
+at import) must be bit-identical on signatures (RFC 6979 nonces, low-S,
+recovery id) and agree on every accept/reject decision."""
+
+import ctypes
+import hashlib
+import secrets
+
+import pytest
+
+from charon_tpu.utils import k1util
+
+native_impl = pytest.importorskip("charon_tpu.tbls.native_impl")
+
+try:
+    lib = native_impl.load_library()
+except native_impl.NativeUnavailable:  # pragma: no cover
+    pytest.skip("native library unavailable", allow_module_level=True)
+
+if lib.k1_selftest() != 1:  # pragma: no cover
+    pytest.skip("native k1 selftest failed", allow_module_level=True)
+
+
+def test_native_routing_active():
+    k1util._try_native()
+    assert k1util._impl["sign"] is not k1util._PY_SIGN
+
+
+def test_sign_verify_recover_bit_identical():
+    for _ in range(6):
+        priv = k1util.generate_private_key()
+        pub_py = k1util._PY_PUBLIC_KEY(priv)
+        digest = hashlib.sha256(secrets.token_bytes(24)).digest()
+
+        out = (ctypes.c_uint8 * 33)()
+        assert lib.k1_pubkey(priv, out) == 0
+        assert bytes(out) == pub_py
+
+        sig_py = k1util._PY_SIGN(priv, digest)
+        sig_c = (ctypes.c_uint8 * 65)()
+        assert lib.k1_sign(priv, digest, sig_c) == 0
+        assert bytes(sig_c) == sig_py
+
+        assert lib.k1_verify(pub_py, digest, sig_py, 65) == 1
+        assert k1util._PY_VERIFY(pub_py, digest, bytes(sig_c))
+
+        rec = (ctypes.c_uint8 * 33)()
+        assert lib.k1_recover(digest, sig_py, rec) == 0
+        assert bytes(rec) == pub_py == k1util._PY_RECOVER(digest, sig_py)
+
+
+def test_reject_agreement():
+    priv = k1util.generate_private_key()
+    pub = k1util._PY_PUBLIC_KEY(priv)
+    digest = hashlib.sha256(b"msg").digest()
+    sig = k1util._PY_SIGN(priv, digest)
+
+    # bit flips anywhere in r/s must be rejected by both
+    for pos in (0, 15, 33, 63):
+        bad = bytearray(sig)
+        bad[pos] ^= 1
+        assert lib.k1_verify(pub, digest, bytes(bad), 65) == 0
+        assert not k1util._PY_VERIFY(pub, digest, bytes(bad))
+    # wrong digest
+    other = hashlib.sha256(b"other").digest()
+    assert lib.k1_verify(pub, other, sig, 65) == 0
+    assert not k1util._PY_VERIFY(pub, other, sig)
+    # zero r/s invalid
+    assert lib.k1_verify(pub, digest, bytes(64), 64) == 0
+    assert not k1util._PY_VERIFY(pub, digest, bytes(64))
+    # invalid pubkey encoding
+    assert lib.k1_verify(b"\x05" + bytes(32), digest, sig, 65) == 0
+    assert not k1util._PY_VERIFY(b"\x05" + bytes(32), digest, sig)
+
+
+def test_ecdh_bit_identical_and_symmetric():
+    a = k1util.generate_private_key()
+    b = k1util.generate_private_key()
+    pa = k1util.public_key(a)
+    pb = k1util.public_key(b)
+    s1 = k1util.ecdh(a, pb)
+    s2 = k1util.ecdh(b, pa)
+    assert s1 == s2 == k1util._PY_ECDH(a, pb)
+
+
+def test_high_level_functions_route_native():
+    priv = k1util.generate_private_key()
+    digest = hashlib.sha256(b"routed").digest()
+    sig = k1util.sign(priv, digest)
+    assert sig == k1util._PY_SIGN(priv, digest)
+    assert k1util.verify(k1util.public_key(priv), digest, sig)
+    assert k1util.recover(digest, sig) == k1util.public_key(priv)
