@@ -78,11 +78,9 @@ class Component:
 
     # -- duties (proxied to the BN with share→root pubkey mapping) ----------
 
-    async def attester_duties(self, epoch: int,
-                              share_pubkeys: list[bytes]) -> list[spec.AttesterDuty]:
-        """Serve duties keyed by the VC's share pubkeys: map share → root,
-        query the BN for the root validators, substitute share pubkeys back
-        (reference validatorapi.go getDutiesFunc mapping)."""
+    async def _share_index_map(self, share_pubkeys: list[bytes]) -> dict[int, bytes]:
+        """validator index -> VC share pubkey for this node's validators
+        (the shared half of the reference's getDutiesFunc mapping)."""
         roots = [self._keys.root_by_share_pubkey(pk) for pk in share_pubkeys]
         vals = await self._beacon.validators_by_pubkey(
             [pubkey_to_bytes(r) for r in roots])
@@ -91,23 +89,40 @@ class Component:
             v = vals.get(bytes(pubkey_to_bytes(root)))
             if v is not None:
                 idx_to_share[v.index] = bytes(share_pk)
-        duties = await self._beacon.attester_duties(epoch, sorted(idx_to_share))
+        return idx_to_share
+
+    async def _map_share_duties(self, share_pubkeys: list[bytes], fetch):
+        """Serve duties keyed by the VC's share pubkeys: map share → root,
+        query the BN for the root validators, substitute share pubkeys back
+        (reference validatorapi.go getDutiesFunc mapping).
+        `fetch(indices)` is the per-duty-type BN call."""
+        idx_to_share = await self._share_index_map(share_pubkeys)
+        duties = await fetch(sorted(idx_to_share))
         return [dataclasses.replace(d, pubkey=idx_to_share[d.validator_index])
                 for d in duties if d.validator_index in idx_to_share]
 
+    async def share_pubkeys_by_index(self, indices: list[int]) -> list[bytes]:
+        """Resolve validator indices to this node's share pubkeys (used by the
+        HTTP router when a spec-standard VC posts index bodies)."""
+        all_shares = [bytes(self._keys.my_share_pubkey(r))
+                      for r in self._keys.root_pubkeys]
+        idx_to_share = await self._share_index_map(all_shares)
+        return [idx_to_share[i] for i in indices if i in idx_to_share]
+
+    async def attester_duties(self, epoch: int,
+                              share_pubkeys: list[bytes]) -> list[spec.AttesterDuty]:
+        return await self._map_share_duties(
+            share_pubkeys, lambda idx: self._beacon.attester_duties(epoch, idx))
+
     async def proposer_duties(self, epoch: int,
                               share_pubkeys: list[bytes]) -> list[spec.ProposerDuty]:
-        roots = [self._keys.root_by_share_pubkey(pk) for pk in share_pubkeys]
-        vals = await self._beacon.validators_by_pubkey(
-            [pubkey_to_bytes(r) for r in roots])
-        idx_to_share: dict[int, bytes] = {}
-        for share_pk, root in zip(share_pubkeys, roots):
-            v = vals.get(bytes(pubkey_to_bytes(root)))
-            if v is not None:
-                idx_to_share[v.index] = bytes(share_pk)
-        duties = await self._beacon.proposer_duties(epoch, sorted(idx_to_share))
-        return [dataclasses.replace(d, pubkey=idx_to_share[d.validator_index])
-                for d in duties if d.validator_index in idx_to_share]
+        return await self._map_share_duties(
+            share_pubkeys, lambda idx: self._beacon.proposer_duties(epoch, idx))
+
+    async def sync_committee_duties(self, epoch: int,
+                                    share_pubkeys: list[bytes]) -> list[spec.SyncCommitteeDuty]:
+        return await self._map_share_duties(
+            share_pubkeys, lambda idx: self._beacon.sync_committee_duties(epoch, idx))
 
     # -- attestations --------------------------------------------------------
 

@@ -1,0 +1,297 @@
+"""ValidatorAPI HTTP router — the beacon-API server the downstream validator
+client connects to (reference core/validatorapi/router.go:92-207).
+
+Intercepts the DVT-relevant endpoints and maps them onto the in-process
+Component (validatorapi.py); every other request is transparently proxied to
+the upstream beacon node (router.go proxy handler). Error responses use the
+beacon-API JSON error shape {"code": N, "message": "..."}.
+
+Intercepted surface (matching the reference's router.go endpoints table):
+  GET  /eth/v1/node/version
+  POST /eth/v1/validator/duties/attester/{epoch}
+  GET  /eth/v1/validator/duties/proposer/{epoch}
+  POST /eth/v1/validator/duties/sync/{epoch}
+  GET  /eth/v1/validator/attestation_data
+  POST /eth/v1/beacon/pool/attestations
+  GET  /eth/v2/validator/blocks/{slot}
+  POST /eth/v1/beacon/blocks                (and /eth/v2/beacon/blocks)
+  GET  /eth/v1/validator/aggregate_attestation
+  POST /eth/v1/validator/aggregate_and_proofs
+  POST /eth/v1/beacon/pool/sync_committees
+  GET  /eth/v1/validator/sync_committee_contribution
+  POST /eth/v1/validator/contribution_and_proofs
+  POST /eth/v1/validator/beacon_committee_selections   (DVT-specific)
+  POST /eth/v1/validator/sync_committee_selections     (DVT-specific)
+  POST /eth/v1/beacon/pool/voluntary_exits
+  POST /eth/v1/validator/register_validator
+"""
+
+from __future__ import annotations
+
+import asyncio
+
+from aiohttp import ClientSession, ClientTimeout, web
+
+from ..eth2 import json_codec as jc
+from ..eth2 import spec
+from ..utils import errors, log, metrics, version
+from .validatorapi import Component
+
+_log = log.with_topic("vapi")
+
+_req_hist = metrics.histogram("core_validatorapi_request_latency_seconds",
+                              "VAPI request latency", ("endpoint",))
+
+
+def _data(payload) -> web.Response:
+    return web.json_response({"data": payload})
+
+
+def _err(status: int, message: str) -> web.Response:
+    return web.json_response({"code": status, "message": message}, status=status)
+
+
+def _hex_arg(request: web.Request, name: str) -> bytes:
+    raw = request.query.get(name, "")
+    if not raw:
+        raise errors.new(f"missing query parameter {name}")
+    return bytes.fromhex(raw[2:] if raw.startswith("0x") else raw)
+
+
+class VapiRouter:
+    """aiohttp server wrapping a validatorapi Component with BN passthrough."""
+
+    def __init__(self, component: Component, bn_base_url: str | None = None,
+                 host: str = "127.0.0.1", port: int = 0):
+        self._comp = component
+        self._bn_url = (bn_base_url or "").rstrip("/") or None
+        self.host = host
+        self.port = port
+        self._runner: web.AppRunner | None = None
+        self._proxy_session: ClientSession | None = None
+        app = web.Application()
+        app.router.add_get("/eth/v1/node/version", self._node_version)
+        app.router.add_post("/eth/v1/validator/duties/attester/{epoch}", self._attester_duties)
+        app.router.add_get("/eth/v1/validator/duties/proposer/{epoch}", self._proposer_duties)
+        app.router.add_post("/eth/v1/validator/duties/sync/{epoch}", self._sync_duties)
+        app.router.add_get("/eth/v1/validator/attestation_data", self._attestation_data)
+        app.router.add_post("/eth/v1/beacon/pool/attestations", self._submit_attestations)
+        app.router.add_get("/eth/v2/validator/blocks/{slot}", self._block_proposal)
+        app.router.add_post("/eth/v1/beacon/blocks", self._submit_block)
+        app.router.add_post("/eth/v2/beacon/blocks", self._submit_block)
+        app.router.add_get("/eth/v1/validator/aggregate_attestation", self._aggregate_attestation)
+        app.router.add_post("/eth/v1/validator/aggregate_and_proofs", self._submit_aggregates)
+        app.router.add_post("/eth/v1/beacon/pool/sync_committees", self._submit_sync_messages)
+        app.router.add_get("/eth/v1/validator/sync_committee_contribution", self._sync_contribution)
+        app.router.add_post("/eth/v1/validator/contribution_and_proofs", self._submit_contributions)
+        app.router.add_post("/eth/v1/validator/beacon_committee_selections", self._bc_selections)
+        app.router.add_post("/eth/v1/validator/sync_committee_selections", self._sc_selections)
+        app.router.add_post("/eth/v1/beacon/pool/voluntary_exits", self._submit_exit)
+        app.router.add_post("/eth/v1/validator/register_validator", self._register)
+        app.router.add_route("*", "/{tail:.*}", self._proxy)
+        app.middlewares.append(_error_middleware)
+        self._app = app
+
+    # -- lifecycle -----------------------------------------------------------
+
+    async def start(self) -> None:
+        self._runner = web.AppRunner(self._app, access_log=None)
+        await self._runner.setup()
+        site = web.TCPSite(self._runner, self.host, self.port)
+        await site.start()
+        self.port = site._server.sockets[0].getsockname()[1]
+        _log.info("validatorapi listening", addr=f"{self.host}:{self.port}",
+                  proxy=self._bn_url or "disabled")
+
+    async def stop(self) -> None:
+        if self._proxy_session is not None:
+            await self._proxy_session.close()
+        if self._runner is not None:
+            await self._runner.cleanup()
+
+    @property
+    def base_url(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+    # -- intercepted handlers -------------------------------------------------
+
+    async def _node_version(self, request: web.Request) -> web.Response:
+        return _data({"version": f"charon-tpu/{version.VERSION}"})
+
+    async def _duty_body_share_pubkeys(self, body) -> list[bytes]:
+        """Resolve a duties request body to share pubkeys. The beacon API
+        standard body is decimal validator-index strings; 0x-hex pubkeys are
+        also accepted (the DVT extension validatormock uses)."""
+        pubkeys: list[bytes] = []
+        indices: list[int] = []
+        for x in body:
+            if isinstance(x, str) and x.startswith("0x"):
+                pubkeys.append(bytes.fromhex(x[2:]))
+            elif isinstance(x, (int, str)):
+                indices.append(int(x))
+            else:
+                raise ValueError(f"invalid duties body entry {x!r}")
+        if indices:
+            pubkeys.extend(await self._comp.share_pubkeys_by_index(indices))
+        return pubkeys
+
+    async def _attester_duties(self, request: web.Request) -> web.Response:
+        with _req_hist.observe_time("attester_duties"):
+            epoch = int(request.match_info["epoch"])
+            share_pubkeys = await self._duty_body_share_pubkeys(await request.json())
+            duties = await self._comp.attester_duties(epoch, share_pubkeys)
+            return _data([jc.encode_attester_duty(d) for d in duties])
+
+    async def _proposer_duties(self, request: web.Request) -> web.Response:
+        with _req_hist.observe_time("proposer_duties"):
+            epoch = int(request.match_info["epoch"])
+            pks = request.query.get("pubkeys", "")
+            share_pubkeys = [bytes.fromhex(x[2:] if x.startswith("0x") else x)
+                            for x in pks.split(",") if x]
+            duties = await self._comp.proposer_duties(epoch, share_pubkeys)
+            return _data([jc.encode_proposer_duty(d) for d in duties])
+
+    async def _sync_duties(self, request: web.Request) -> web.Response:
+        with _req_hist.observe_time("sync_duties"):
+            epoch = int(request.match_info["epoch"])
+            share_pubkeys = await self._duty_body_share_pubkeys(await request.json())
+            duties = await self._comp.sync_committee_duties(epoch, share_pubkeys)
+            return _data([jc.encode_sync_duty(d) for d in duties])
+
+    async def _attestation_data(self, request: web.Request) -> web.Response:
+        with _req_hist.observe_time("attestation_data"):
+            slot = int(request.query["slot"])
+            committee_index = int(request.query.get("committee_index", 0))
+            data = await self._comp.attestation_data(slot, committee_index)
+            return _data(jc.encode_container(data))
+
+    async def _submit_attestations(self, request: web.Request) -> web.Response:
+        with _req_hist.observe_time("submit_attestations"):
+            body = await request.json()
+            atts = [jc.decode_container(spec.Attestation, o) for o in body]
+            await self._comp.submit_attestations(atts)
+            return web.json_response({})
+
+    async def _block_proposal(self, request: web.Request) -> web.Response:
+        with _req_hist.observe_time("block_proposal"):
+            slot = int(request.match_info["slot"])
+            randao = _hex_arg(request, "randao_reveal")
+            graffiti = request.query.get("graffiti", "")
+            block = await self._comp.block_proposal(
+                slot, randao, bytes.fromhex(graffiti[2:]) if graffiti else b"")
+            return web.json_response({
+                "version": "charon-opaque",
+                "execution_payload_blinded": block.blinded,
+                "data": jc.encode_beacon_block(block),
+            })
+
+    async def _submit_block(self, request: web.Request) -> web.Response:
+        with _req_hist.observe_time("submit_block"):
+            body = await request.json()
+            await self._comp.submit_block(jc.decode_signed_beacon_block(body))
+            return web.json_response({})
+
+    async def _aggregate_attestation(self, request: web.Request) -> web.Response:
+        with _req_hist.observe_time("aggregate_attestation"):
+            slot = int(request.query["slot"])
+            root = _hex_arg(request, "attestation_data_root")
+            att = await self._comp.aggregate_attestation(slot, root)
+            return _data(jc.encode_container(att))
+
+    async def _submit_aggregates(self, request: web.Request) -> web.Response:
+        with _req_hist.observe_time("submit_aggregates"):
+            body = await request.json()
+            aggs = [jc.decode_container(spec.SignedAggregateAndProof, o) for o in body]
+            await self._comp.submit_aggregate_attestations(aggs)
+            return web.json_response({})
+
+    async def _submit_sync_messages(self, request: web.Request) -> web.Response:
+        with _req_hist.observe_time("submit_sync_messages"):
+            body = await request.json()
+            msgs = [jc.decode_container(spec.SyncCommitteeMessage, o) for o in body]
+            await self._comp.submit_sync_committee_messages(msgs)
+            return web.json_response({})
+
+    async def _sync_contribution(self, request: web.Request) -> web.Response:
+        with _req_hist.observe_time("sync_contribution"):
+            slot = int(request.query["slot"])
+            subcommittee = int(request.query["subcommittee_index"])
+            root = _hex_arg(request, "beacon_block_root")
+            contrib = await self._comp.sync_committee_contribution(slot, subcommittee, root)
+            return _data(jc.encode_container(contrib))
+
+    async def _submit_contributions(self, request: web.Request) -> web.Response:
+        with _req_hist.observe_time("submit_contributions"):
+            body = await request.json()
+            contribs = [jc.decode_container(spec.SignedContributionAndProof, o) for o in body]
+            await self._comp.submit_contribution_and_proofs(contribs)
+            return web.json_response({})
+
+    async def _bc_selections(self, request: web.Request) -> web.Response:
+        with _req_hist.observe_time("beacon_committee_selections"):
+            body = await request.json()
+            sels = [jc.decode_container(spec.BeaconCommitteeSelection, o) for o in body]
+            combined = await self._comp.aggregate_beacon_committee_selections(sels)
+            return _data([jc.encode_container(s) for s in combined])
+
+    async def _sc_selections(self, request: web.Request) -> web.Response:
+        with _req_hist.observe_time("sync_committee_selections"):
+            body = await request.json()
+            sels = [jc.decode_container(spec.SyncCommitteeSelection, o) for o in body]
+            combined = await self._comp.aggregate_sync_committee_selections(sels)
+            return _data([jc.encode_container(s) for s in combined])
+
+    async def _submit_exit(self, request: web.Request) -> web.Response:
+        with _req_hist.observe_time("voluntary_exit"):
+            body = await request.json()
+            await self._comp.submit_voluntary_exit(
+                jc.decode_container(spec.SignedVoluntaryExit, body))
+            return web.json_response({})
+
+    async def _register(self, request: web.Request) -> web.Response:
+        with _req_hist.observe_time("register_validator"):
+            body = await request.json()
+            regs = [jc.decode_container(spec.SignedValidatorRegistration, o) for o in body]
+            await self._comp.submit_validator_registrations(regs)
+            return web.json_response({})
+
+    # -- passthrough proxy (reference router.go proxyHandler) ------------------
+
+    async def _proxy(self, request: web.Request) -> web.Response:
+        if self._bn_url is None:
+            return _err(404, f"unknown endpoint {request.path} (no upstream BN configured)")
+        if self._proxy_session is None:
+            self._proxy_session = ClientSession(timeout=ClientTimeout(total=30))
+        url = self._bn_url + request.path_qs
+        body = await request.read()
+        try:
+            async with self._proxy_session.request(
+                    request.method, url, data=body or None,
+                    headers={k: v for k, v in request.headers.items()
+                             if k.lower() not in ("host", "content-length")}) as resp:
+                payload = await resp.read()
+                return web.Response(body=payload, status=resp.status,
+                                    content_type=resp.content_type)
+        except (OSError, asyncio.TimeoutError) as exc:
+            _log.warn("BN proxy failed", url=url, err=exc)
+            return _err(502, f"upstream beacon node unreachable: {exc}")
+
+
+# aiohttp handlers raise; convert component errors to beacon-API error JSON.
+@web.middleware
+async def _error_middleware(request: web.Request, handler):
+    try:
+        return await handler(request)
+    except web.HTTPException:
+        raise
+    except asyncio.TimeoutError:
+        return _err(408, "request timed out awaiting consensus data")
+    except (KeyError, ValueError) as exc:
+        return _err(400, f"bad request: {exc}")
+    except errors.CharonError as exc:
+        # component rejections (unknown pubkey, invalid partial sig, bad
+        # parameters) are client errors, not node failures
+        return _err(400, str(exc))
+    except Exception as exc:  # noqa: BLE001 — component-level failure
+        _log.warn("vapi handler error", path=request.path, err=exc)
+        return _err(500, str(exc))

@@ -1,0 +1,167 @@
+"""Beacon-API JSON codec for the SSZ container types.
+
+The beacon API (and go-eth2-client in the reference) serializes consensus
+types as JSON with uints as decimal strings, byte vectors as 0x-hex, and
+bitfields as 0x-hex of their SSZ encoding. This codec derives both directions
+generically from each container's `ssz_fields` descriptors so the HTTP
+router (core/vapi_router.py) and client (eth2/vapi_client.py) cannot drift
+from the SSZ definitions.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from . import spec
+from .ssz import Bitlist, Bitvector, ByteList, ByteVector, Container, List, SSZType, UintN, Vector
+
+
+def _bits_to_hex(typ: Bitlist | Bitvector, bits: list[bool]) -> str:
+    return "0x" + typ.serialize(bits).hex()
+
+
+def _bitlist_from_hex(h: str, limit: int) -> list[bool]:
+    raw = bytes.fromhex(h[2:] if h.startswith("0x") else h)
+    if not raw:
+        raise ValueError("empty bitlist encoding")
+    as_int = int.from_bytes(raw, "little")
+    if as_int == 0:
+        raise ValueError("invalid bitlist encoding: missing sentinel bit")
+    length = as_int.bit_length() - 1  # sentinel bit position
+    if length > limit:
+        raise ValueError("bitlist over limit")
+    return [bool((as_int >> i) & 1) for i in range(length)]
+
+
+def _bitvector_from_hex(h: str, length: int) -> list[bool]:
+    raw = bytes.fromhex(h[2:] if h.startswith("0x") else h)
+    as_int = int.from_bytes(raw, "little")
+    return [bool((as_int >> i) & 1) for i in range(length)]
+
+
+def encode_value(typ: SSZType, value: Any) -> Any:
+    if isinstance(typ, UintN):
+        return str(int(value))
+    if isinstance(typ, (ByteVector, ByteList)):
+        return "0x" + bytes(value).hex()
+    if isinstance(typ, (Bitlist, Bitvector)):
+        return _bits_to_hex(typ, value)
+    if isinstance(typ, (List, Vector)):
+        return [encode_value(typ.elem, v) for v in value]
+    if isinstance(typ, Container):
+        return encode_container(value)
+    raise TypeError(f"unsupported SSZ type {type(typ).__name__}")
+
+
+def decode_value(typ: SSZType, obj: Any) -> Any:
+    if isinstance(typ, UintN):
+        return int(obj)
+    if isinstance(typ, (ByteVector, ByteList)):
+        return bytes.fromhex(obj[2:] if obj.startswith("0x") else obj)
+    if isinstance(typ, Bitlist):
+        return _bitlist_from_hex(obj, typ.limit)
+    if isinstance(typ, Bitvector):
+        return _bitvector_from_hex(obj, typ.length)
+    if isinstance(typ, (List, Vector)):
+        return [decode_value(typ.elem, v) for v in obj]
+    if isinstance(typ, Container):
+        return decode_container(typ.cls, obj)
+    raise TypeError(f"unsupported SSZ type {type(typ).__name__}")
+
+
+def encode_container(value: Any) -> dict:
+    cont = Container(type(value))
+    return {name: encode_value(t, getattr(value, name)) for name, t in cont.fields}
+
+
+def decode_container(cls: type, obj: dict) -> Any:
+    cont = Container(cls)
+    kwargs = {name: decode_value(t, obj[name]) for name, t in cont.fields}
+    return cls(**kwargs)
+
+
+# -- blocks (opaque-body dataclasses, eth2/spec.py BeaconBlock) ---------------
+
+def encode_beacon_block(b: spec.BeaconBlock) -> dict:
+    return {
+        "slot": str(b.slot),
+        "proposer_index": str(b.proposer_index),
+        "parent_root": "0x" + bytes(b.parent_root).hex(),
+        "state_root": "0x" + bytes(b.state_root).hex(),
+        "body_root": "0x" + bytes(b.body_root).hex(),
+        "body": b.body,
+        "blinded": bool(b.blinded),
+    }
+
+
+def decode_beacon_block(o: dict) -> spec.BeaconBlock:
+    return spec.BeaconBlock(
+        slot=int(o["slot"]),
+        proposer_index=int(o["proposer_index"]),
+        parent_root=bytes.fromhex(o["parent_root"][2:]),
+        state_root=bytes.fromhex(o["state_root"][2:]),
+        body_root=bytes.fromhex(o["body_root"][2:]),
+        body=o.get("body"),
+        blinded=bool(o.get("blinded", False)),
+    )
+
+
+def encode_signed_beacon_block(b: spec.SignedBeaconBlock) -> dict:
+    return {"message": encode_beacon_block(b.message),
+            "signature": "0x" + bytes(b.signature).hex()}
+
+
+def decode_signed_beacon_block(o: dict) -> spec.SignedBeaconBlock:
+    return spec.SignedBeaconBlock(message=decode_beacon_block(o["message"]),
+                                  signature=bytes.fromhex(o["signature"][2:]))
+
+
+# -- plain-dataclass duty types (not SSZ containers) --------------------------
+
+def encode_attester_duty(d: spec.AttesterDuty) -> dict:
+    return {
+        "pubkey": "0x" + bytes(d.pubkey).hex(),
+        "slot": str(d.slot),
+        "validator_index": str(d.validator_index),
+        "committee_index": str(d.committee_index),
+        "committee_length": str(d.committee_length),
+        "committees_at_slot": str(d.committees_at_slot),
+        "validator_committee_index": str(d.validator_committee_index),
+    }
+
+
+def decode_attester_duty(o: dict) -> spec.AttesterDuty:
+    return spec.AttesterDuty(
+        pubkey=bytes.fromhex(o["pubkey"][2:]),
+        slot=int(o["slot"]),
+        validator_index=int(o["validator_index"]),
+        committee_index=int(o["committee_index"]),
+        committee_length=int(o["committee_length"]),
+        committees_at_slot=int(o["committees_at_slot"]),
+        validator_committee_index=int(o["validator_committee_index"]),
+    )
+
+
+def encode_proposer_duty(d: spec.ProposerDuty) -> dict:
+    return {"pubkey": "0x" + bytes(d.pubkey).hex(), "slot": str(d.slot),
+            "validator_index": str(d.validator_index)}
+
+
+def decode_proposer_duty(o: dict) -> spec.ProposerDuty:
+    return spec.ProposerDuty(pubkey=bytes.fromhex(o["pubkey"][2:]),
+                             slot=int(o["slot"]),
+                             validator_index=int(o["validator_index"]))
+
+
+def encode_sync_duty(d: spec.SyncCommitteeDuty) -> dict:
+    return {"pubkey": "0x" + bytes(d.pubkey).hex(),
+            "validator_index": str(d.validator_index),
+            "validator_sync_committee_indices":
+                [str(i) for i in d.validator_sync_committee_indices]}
+
+
+def decode_sync_duty(o: dict) -> spec.SyncCommitteeDuty:
+    return spec.SyncCommitteeDuty(
+        pubkey=bytes.fromhex(o["pubkey"][2:]),
+        validator_index=int(o["validator_index"]),
+        validator_sync_committee_indices=[int(i) for i in o["validator_sync_committee_indices"]])

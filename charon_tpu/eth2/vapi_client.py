@@ -1,0 +1,150 @@
+"""HTTP client for the ValidatorAPI — the VC side of the beacon-API wire.
+
+Speaks the endpoints served by core/vapi_router.py with the same method
+surface as the in-process validatorapi.Component, so a ValidatorMock (or any
+VC harness) can drive a charon node purely over HTTP — the acceptance shape
+for router parity with the reference (core/validatorapi/router.go).
+"""
+
+from __future__ import annotations
+
+from aiohttp import ClientSession, ClientTimeout
+
+from . import json_codec as jc
+from . import spec
+
+
+class VapiHTTPError(RuntimeError):
+    def __init__(self, status: int, message: str):
+        super().__init__(f"vapi http {status}: {message}")
+        self.status = status
+
+
+class HTTPValidatorClient:
+    """Duck-type compatible with validatorapi.Component for VC-side use."""
+
+    def __init__(self, base_url: str, timeout: float = 30.0):
+        self._base = base_url.rstrip("/")
+        self._timeout = ClientTimeout(total=timeout)
+        self._session: ClientSession | None = None
+
+    async def close(self) -> None:
+        if self._session is not None:
+            await self._session.close()
+            self._session = None
+
+    def _sess(self) -> ClientSession:
+        if self._session is None:
+            self._session = ClientSession(timeout=self._timeout)
+        return self._session
+
+    async def _req(self, method: str, path: str, *, json_body=None, params=None):
+        async with self._sess().request(method, self._base + path, json=json_body,
+                                        params=params) as resp:
+            payload = await resp.json(content_type=None)
+            if resp.status >= 400:
+                msg = payload.get("message", "") if isinstance(payload, dict) else str(payload)
+                raise VapiHTTPError(resp.status, msg)
+            return payload
+
+    # -- duties ----------------------------------------------------------------
+
+    async def attester_duties(self, epoch: int, share_pubkeys: list[bytes]) -> list[spec.AttesterDuty]:
+        out = await self._req("POST", f"/eth/v1/validator/duties/attester/{epoch}",
+                              json_body=["0x" + bytes(pk).hex() for pk in share_pubkeys])
+        return [jc.decode_attester_duty(o) for o in out["data"]]
+
+    async def proposer_duties(self, epoch: int, share_pubkeys: list[bytes]) -> list[spec.ProposerDuty]:
+        params = {"pubkeys": ",".join("0x" + bytes(pk).hex() for pk in share_pubkeys)}
+        out = await self._req("GET", f"/eth/v1/validator/duties/proposer/{epoch}", params=params)
+        return [jc.decode_proposer_duty(o) for o in out["data"]]
+
+    async def sync_committee_duties(self, epoch: int, share_pubkeys: list[bytes]) -> list[spec.SyncCommitteeDuty]:
+        out = await self._req("POST", f"/eth/v1/validator/duties/sync/{epoch}",
+                              json_body=["0x" + bytes(pk).hex() for pk in share_pubkeys])
+        return [jc.decode_sync_duty(o) for o in out["data"]]
+
+    # -- attestations ----------------------------------------------------------
+
+    async def attestation_data(self, slot: int, committee_index: int) -> spec.AttestationData:
+        out = await self._req("GET", "/eth/v1/validator/attestation_data",
+                              params={"slot": str(slot), "committee_index": str(committee_index)})
+        return jc.decode_container(spec.AttestationData, out["data"])
+
+    async def submit_attestations(self, atts: list[spec.Attestation]) -> None:
+        await self._req("POST", "/eth/v1/beacon/pool/attestations",
+                        json_body=[jc.encode_container(a) for a in atts])
+
+    async def aggregate_attestation(self, slot: int, att_data_root: bytes) -> spec.Attestation:
+        out = await self._req("GET", "/eth/v1/validator/aggregate_attestation",
+                              params={"slot": str(slot),
+                                      "attestation_data_root": "0x" + att_data_root.hex()})
+        return jc.decode_container(spec.Attestation, out["data"])
+
+    async def submit_aggregate_attestations(self, aggs: list[spec.SignedAggregateAndProof]) -> None:
+        await self._req("POST", "/eth/v1/validator/aggregate_and_proofs",
+                        json_body=[jc.encode_container(a) for a in aggs])
+
+    async def aggregate_beacon_committee_selections(
+            self, selections: list[spec.BeaconCommitteeSelection]) -> list[spec.BeaconCommitteeSelection]:
+        out = await self._req("POST", "/eth/v1/validator/beacon_committee_selections",
+                              json_body=[jc.encode_container(s) for s in selections])
+        return [jc.decode_container(spec.BeaconCommitteeSelection, o) for o in out["data"]]
+
+    # -- blocks ----------------------------------------------------------------
+
+    async def block_proposal(self, slot: int, randao_reveal: bytes,
+                             graffiti: bytes = b"") -> spec.BeaconBlock:
+        params = {"randao_reveal": "0x" + bytes(randao_reveal).hex()}
+        if graffiti:
+            params["graffiti"] = "0x" + graffiti.hex()
+        out = await self._req("GET", f"/eth/v2/validator/blocks/{slot}", params=params)
+        return jc.decode_beacon_block(out["data"])
+
+    async def submit_block(self, block: spec.SignedBeaconBlock) -> None:
+        await self._req("POST", "/eth/v2/beacon/blocks",
+                        json_body=jc.encode_signed_beacon_block(block))
+
+    # -- sync committee --------------------------------------------------------
+
+    async def submit_sync_committee_messages(self, msgs: list[spec.SyncCommitteeMessage]) -> None:
+        await self._req("POST", "/eth/v1/beacon/pool/sync_committees",
+                        json_body=[jc.encode_container(m) for m in msgs])
+
+    async def aggregate_sync_committee_selections(
+            self, selections: list[spec.SyncCommitteeSelection]) -> list[spec.SyncCommitteeSelection]:
+        out = await self._req("POST", "/eth/v1/validator/sync_committee_selections",
+                              json_body=[jc.encode_container(s) for s in selections])
+        return [jc.decode_container(spec.SyncCommitteeSelection, o) for o in out["data"]]
+
+    async def sync_committee_contribution(self, slot: int, subcommittee_index: int,
+                                          beacon_block_root: bytes) -> spec.SyncCommitteeContribution:
+        out = await self._req("GET", "/eth/v1/validator/sync_committee_contribution",
+                              params={"slot": str(slot),
+                                      "subcommittee_index": str(subcommittee_index),
+                                      "beacon_block_root": "0x" + beacon_block_root.hex()})
+        return jc.decode_container(spec.SyncCommitteeContribution, out["data"])
+
+    async def submit_contribution_and_proofs(self, contribs: list[spec.SignedContributionAndProof]) -> None:
+        await self._req("POST", "/eth/v1/validator/contribution_and_proofs",
+                        json_body=[jc.encode_container(c) for c in contribs])
+
+    # -- exits / registrations -------------------------------------------------
+
+    async def submit_voluntary_exit(self, exit_: spec.SignedVoluntaryExit) -> None:
+        await self._req("POST", "/eth/v1/beacon/pool/voluntary_exits",
+                        json_body=jc.encode_container(exit_))
+
+    async def submit_validator_registrations(self, regs: list[spec.SignedValidatorRegistration]) -> None:
+        await self._req("POST", "/eth/v1/validator/register_validator",
+                        json_body=[jc.encode_container(r) for r in regs])
+
+    # -- misc ------------------------------------------------------------------
+
+    async def node_version(self) -> str:
+        out = await self._req("GET", "/eth/v1/node/version")
+        return out["data"]["version"]
+
+    async def raw(self, method: str, path: str, **kw):
+        """Escape hatch for proxied endpoints (passthrough to the BN)."""
+        return await self._req(method, path, **kw)

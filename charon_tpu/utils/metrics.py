@@ -79,20 +79,12 @@ class Histogram(_Metric):
             counts[bisect_right(self.buckets, value)] += 1
             self._sums[key] = self._sums.get(key, 0.0) + value
 
-    def time(self, *label_values: str):
-        """Context manager measuring elapsed seconds."""
-        hist = self
+    def observe_time(self, *label_values: str):
+        """Context manager timing the enclosed block into the histogram."""
+        return _Timer(self, label_values)
 
-        class _Timer:
-            def __enter__(self):
-                self.t0 = time.monotonic()
-                return self
-
-            def __exit__(self, *exc):
-                hist.observe(time.monotonic() - self.t0, *label_values)
-                return False
-
-        return _Timer()
+    # back-compat alias (both names exist in the wild in this codebase)
+    time = observe_time
 
     def quantile(self, q: float, *label_values: str) -> float:
         """Approximate quantile from bucket counts (upper bucket bound)."""
@@ -109,6 +101,20 @@ class Histogram(_Metric):
                 if acc >= target:
                     return self.buckets[i] if i < len(self.buckets) else float("inf")
             return float("inf")
+
+
+class _Timer:
+    def __init__(self, hist: Histogram, label_values: tuple[str, ...]):
+        self._hist = hist
+        self._labels = label_values
+        self._t0 = 0.0
+
+    def __enter__(self) -> "_Timer":
+        self._t0 = time.monotonic()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self._hist.observe(time.monotonic() - self._t0, *self._labels)
 
 
 class Registry:

@@ -1,0 +1,178 @@
+"""ValidatorAPI HTTP router tests: a validatormock drives the cluster purely
+over HTTP (the acceptance shape for reference core/validatorapi/router.go
+parity), plus BN passthrough proxying and error mapping."""
+
+import asyncio
+
+import pytest
+from aiohttp import web
+
+from charon_tpu.core.vapi_router import VapiRouter
+from charon_tpu.eth2.vapi_client import HTTPValidatorClient, VapiHTTPError
+from charon_tpu.testutil.simnet import new_simnet
+from charon_tpu.testutil.validatormock import ValidatorMock
+
+
+def _run(coro, timeout=90):
+    async def wrapped():
+        return await asyncio.wait_for(coro, timeout)
+
+    return asyncio.run(wrapped())
+
+
+async def _http_cluster(**kw):
+    """Simnet with the in-process vmocks replaced by HTTP-driven ones."""
+    sim = new_simnet(use_vmock=False, **kw)
+    routers, clients, vmocks = [], [], []
+    for node in sim.nodes:
+        router = VapiRouter(node.vapi)
+        await router.start()
+        client = HTTPValidatorClient(router.base_url)
+        vmock = ValidatorMock(client, node.keys, sim.beacon._spec)
+        node.sched.subscribe_slots(vmock.on_slot)
+        routers.append(router)
+        clients.append(client)
+        vmocks.append(vmock)
+    await sim.start()
+    return sim, routers, clients
+
+
+async def _teardown(sim, routers, clients):
+    import contextlib
+
+    with contextlib.suppress(asyncio.TimeoutError):
+        await asyncio.wait_for(sim.stop(), 10)
+    for c in clients:
+        await c.close()
+    for r in routers:
+        await r.stop()
+
+
+class TestHTTPPipeline:
+    def test_attestation_and_proposal_via_http(self):
+        async def run():
+            # generous timing: survives a CPU-loaded full-suite environment
+            sim, routers, clients = await _http_cluster(
+                num_validators=1, threshold=3, num_nodes=4,
+                seconds_per_slot=0.6, genesis_delay=1.5)
+            try:
+                deadline = asyncio.get_running_loop().time() + 60
+                while asyncio.get_running_loop().time() < deadline:
+                    if sim.beacon.attestations and sim.beacon.blocks:
+                        break
+                    await asyncio.sleep(0.1)
+                assert sim.beacon.attestations, "no attestation completed over HTTP"
+                assert sim.beacon.blocks, "no block proposal completed over HTTP"
+            finally:
+                await _teardown(sim, routers, clients)
+
+        _run(run())
+
+    def test_duties_accept_spec_standard_index_body(self):
+        """A spec-compliant VC posts decimal validator-index strings; the
+        router must resolve them to this node's share pubkeys."""
+
+        async def run():
+            sim, routers, clients = await _http_cluster(
+                num_validators=2, threshold=2, num_nodes=3,
+                seconds_per_slot=0.5, genesis_delay=10.0)
+            try:
+                out = await clients[0].raw(
+                    "POST", "/eth/v1/validator/duties/attester/0",
+                    json_body=["0", "1"])
+                duties = out["data"]
+                assert isinstance(duties, list)
+                # share pubkeys (not the DV roots) come back in the response
+                node_keys = sim.nodes[0].keys
+                share_pks = {"0x" + bytes(node_keys.my_share_pubkey(r)).hex()
+                             for r in node_keys.root_pubkeys}
+                for d in duties:
+                    assert d["pubkey"] in share_pks
+            finally:
+                await _teardown(sim, routers, clients)
+
+        _run(run())
+
+    def test_node_version_endpoint(self):
+        async def run():
+            sim, routers, clients = await _http_cluster(
+                num_validators=1, threshold=2, num_nodes=3,
+                seconds_per_slot=0.5, genesis_delay=5.0)
+            try:
+                version = await clients[0].node_version()
+                assert version.startswith("charon-tpu/")
+            finally:
+                await _teardown(sim, routers, clients)
+
+        _run(run())
+
+
+class TestProxy:
+    def test_passthrough_to_upstream_bn(self):
+        async def run():
+            # minimal upstream BN serving one endpoint
+            async def syncing(request):
+                return web.json_response({"data": {"is_syncing": False, "head_slot": "7"}})
+
+            upstream = web.Application()
+            upstream.router.add_get("/eth/v1/node/syncing", syncing)
+            runner = web.AppRunner(upstream)
+            await runner.setup()
+            site = web.TCPSite(runner, "127.0.0.1", 0)
+            await site.start()
+            bn_port = site._server.sockets[0].getsockname()[1]
+
+            sim = new_simnet(num_validators=1, threshold=2, num_nodes=3,
+                             use_vmock=False, genesis_delay=30.0)
+            router = VapiRouter(sim.nodes[0].vapi,
+                                bn_base_url=f"http://127.0.0.1:{bn_port}")
+            await router.start()
+            client = HTTPValidatorClient(router.base_url)
+            try:
+                out = await client.raw("GET", "/eth/v1/node/syncing")
+                assert out["data"]["is_syncing"] is False
+                assert out["data"]["head_slot"] == "7"
+            finally:
+                await client.close()
+                await router.stop()
+                await runner.cleanup()
+
+        _run(run())
+
+    def test_unknown_endpoint_without_bn_is_404(self):
+        async def run():
+            sim = new_simnet(num_validators=1, threshold=2, num_nodes=3,
+                             use_vmock=False, genesis_delay=30.0)
+            router = VapiRouter(sim.nodes[0].vapi)
+            await router.start()
+            client = HTTPValidatorClient(router.base_url)
+            try:
+                with pytest.raises(VapiHTTPError) as exc_info:
+                    await client.raw("GET", "/eth/v1/config/spec")
+                assert exc_info.value.status == 404
+            finally:
+                await client.close()
+                await router.stop()
+
+        _run(run())
+
+
+class TestErrorMapping:
+    def test_bad_request_is_beacon_api_error(self):
+        async def run():
+            sim = new_simnet(num_validators=1, threshold=2, num_nodes=3,
+                             use_vmock=False, genesis_delay=30.0)
+            router = VapiRouter(sim.nodes[0].vapi)
+            await router.start()
+            client = HTTPValidatorClient(router.base_url)
+            try:
+                # malformed body: not valid attestation JSON
+                with pytest.raises(VapiHTTPError) as exc_info:
+                    await client.raw("POST", "/eth/v1/beacon/pool/attestations",
+                                     json_body=[{"nonsense": True}])
+                assert exc_info.value.status in (400, 500)
+            finally:
+                await client.close()
+                await router.stop()
+
+        _run(run())
