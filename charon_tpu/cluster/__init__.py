@@ -1,0 +1,166 @@
+"""cluster — durable cluster configuration & identity (reference cluster/).
+
+Definition/lock JSON artifacts with SSZ config/definition/lock hashes and
+EIP-712 operator signatures, manifest mutation log, EIP-2335 share keystores,
+node identity keys (ENR), `create_cluster` (the `charon create cluster`
+trusted-dealer flow) and `combine` (root-key recovery)."""
+
+from __future__ import annotations
+
+import json
+import time
+from pathlib import Path
+
+from .. import tbls
+from ..core.keyshares import KeyShares
+from ..core.types import pubkey_from_bytes
+from ..eth2 import deposit as deposit_mod
+from ..eth2 import enr as enr_mod
+from ..eth2 import keystore
+from ..utils import errors, k1util
+from .combine import combine
+from .definition import Definition, Operator
+from .lock import DistValidator, Lock
+from . import manifest
+
+__all__ = [
+    "Definition", "DistValidator", "KeyShares", "Lock", "Operator",
+    "combine", "create_cluster", "keyshares_from_lock",
+    "keyshares_from_validators", "load_node", "manifest",
+]
+
+
+def keyshares_from_validators(validators: list[DistValidator], threshold: int,
+                              node_index: int,
+                              share_secrets: list[tbls.PrivateKey] | None = None) -> KeyShares:
+    """Build the runtime share topology from a validator list (lock and/or
+    manifest-added — the reference builds these maps in app wiring from the
+    materialised manifest, app/app.go:339-383). node_index is 0-based; share
+    indices are 1-based."""
+    share_pubkeys = {}
+    my_secrets = {}
+    for v_idx, dv in enumerate(validators):
+        root = pubkey_from_bytes(dv.public_key)
+        share_pubkeys[root] = {
+            i + 1: tbls.PublicKey(pk) for i, pk in enumerate(dv.public_shares)}
+        if share_secrets is not None:
+            my_secrets[root] = share_secrets[v_idx]
+    return KeyShares(
+        my_share_idx=node_index + 1,
+        threshold=threshold,
+        share_pubkeys=share_pubkeys,
+        my_share_secrets=my_secrets,
+    )
+
+
+def keyshares_from_lock(lock: Lock, node_index: int,
+                        share_secrets: list[tbls.PrivateKey] | None = None) -> KeyShares:
+    return keyshares_from_validators(lock.validators, lock.definition.threshold,
+                                     node_index, share_secrets)
+
+
+def create_cluster(name: str, num_validators: int, num_nodes: int, threshold: int,
+                   out_dir: str | Path, *, fork_version: bytes = b"\x00\x00\x00\x00",
+                   withdrawal_addr20: bytes = b"\x11" * 20,
+                   insecure_keys: bool = True) -> Lock:
+    """The `charon create cluster` trusted-dealer flow (reference
+    cmd/createcluster.go): generate identity + DV keys centrally, split,
+    write per-node data dirs (node{i}/charon-enr-private-key, cluster-lock,
+    validator_keys/), and the deposit-data file."""
+    out_dir = Path(out_dir)
+    identity_keys = [k1util.generate_private_key() for _ in range(num_nodes)]
+    enrs = [enr_mod.new(k) for k in identity_keys]
+
+    definition = Definition(
+        name=name, num_validators=num_validators, threshold=threshold,
+        operators=[Operator(enr=r.encode()) for r in enrs],
+        fork_version=fork_version, dkg_algorithm="trusted-dealer",
+        timestamp=time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        withdrawal_address="0x" + withdrawal_addr20.hex(),
+    )
+    for i, key in enumerate(identity_keys):
+        definition = definition.sign_operator(i, key)
+
+    validators: list[DistValidator] = []
+    node_share_secrets: list[list[tbls.PrivateKey]] = [[] for _ in range(num_nodes)]
+    for _ in range(num_validators):
+        root_secret = tbls.generate_secret_key()
+        root_pub = tbls.secret_to_public_key(root_secret)
+        shares = tbls.threshold_split(root_secret, num_nodes, threshold)
+        for i in range(num_nodes):
+            node_share_secrets[i].append(shares[i + 1])
+        msg = deposit_mod.new_message(root_pub, withdrawal_addr20)
+        dep_sig = tbls.sign(tbls.PrivateKey(root_secret),
+                            deposit_mod.signing_root(msg, fork_version))
+        dep_data = deposit_mod.DepositData(bytes(root_pub),
+                                           msg.withdrawal_credentials,
+                                           msg.amount, bytes(dep_sig))
+        validators.append(DistValidator(
+            public_key=bytes(root_pub),
+            public_shares=[bytes(tbls.secret_to_public_key(shares[i + 1]))
+                           for i in range(num_nodes)],
+            deposit_data_root=deposit_mod.data_root(dep_data),
+            deposit_signature=bytes(dep_sig),
+        ))
+
+    lock = Lock(definition=definition, validators=validators)
+    h = lock.lock_hash()
+    share_sigs = [tbls.sign(node_share_secrets[i][v], h)
+                  for v in range(num_validators) for i in range(num_nodes)]
+    lock.aggregate_share_signatures(share_sigs)
+    lock.node_signatures = [k1util.sign(k, h) for k in identity_keys]
+    lock.verify()
+
+    for i in range(num_nodes):
+        node_dir = out_dir / f"node{i}"
+        node_dir.mkdir(parents=True, exist_ok=True)
+        key_path = node_dir / "charon-enr-private-key"
+        key_path.write_text(identity_keys[i].hex())
+        key_path.chmod(0o600)  # identity key material must not be world-readable
+        from .lock import save as save_lock
+
+        save_lock(lock, str(node_dir / "cluster-lock.json"))
+        keystore.store_keys(node_share_secrets[i], node_dir / "validator_keys",
+                            insecure=insecure_keys)
+    deposits = [{
+        "pubkey": v.public_key.hex(),
+        "withdrawal_credentials": deposit_mod.withdrawal_credentials_from_address(
+            withdrawal_addr20).hex(),
+        "amount": str(deposit_mod.DEFAULT_AMOUNT_GWEI),
+        "signature": v.deposit_signature.hex(),
+        "deposit_data_root": v.deposit_data_root.hex(),
+        "fork_version": fork_version.hex(),
+    } for v in validators]
+    (out_dir / "deposit-data.json").write_text(json.dumps(deposits, indent=2))
+    return lock
+
+
+def load_node(node_dir: str | Path) -> tuple[bytes, Lock, KeyShares]:
+    """Restart a node from its data dir: identity key + verified lock +
+    share topology with decrypted share secrets."""
+    node_dir = Path(node_dir)
+    key_path = node_dir / "charon-enr-private-key"
+    if not key_path.exists():
+        raise errors.new("missing identity key", dir=str(node_dir))
+    identity = bytes.fromhex(key_path.read_text().strip())
+    cluster = manifest.load_cluster(node_dir)
+    lock = cluster.lock
+    # which operator are we? match identity pubkey against operator ENRs
+    my_pub = k1util.public_key(identity)
+    node_index = None
+    for i, op in enumerate(lock.definition.operators):
+        if enr_mod.parse(op.enr).pubkey == my_pub:
+            node_index = i
+            break
+    if node_index is None:
+        raise errors.new("identity key not in cluster operators")
+    secrets = keystore.load_keys(node_dir / "validator_keys")
+    # all validators: lock genesis set + manifest-added ones; keystores are
+    # stored in the same order (lock validators first, then additions)
+    validators = cluster.validators
+    if len(secrets) != len(validators):
+        raise errors.new("keystore count != cluster validator count",
+                         keystores=len(secrets), validators=len(validators))
+    keys = keyshares_from_validators(validators, lock.definition.threshold,
+                                     node_index, secrets)
+    return identity, lock, keys

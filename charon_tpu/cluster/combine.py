@@ -1,0 +1,73 @@
+"""combine — recover DV root private keys from a threshold of share
+keystores (reference cmd/combine/combine.go:29).
+
+Reads each node's validator_keys directory (EIP-2335 keystores, one per DV,
+in lock validator order), recombines >= threshold shares per DV with
+Lagrange interpolation, validates the recovered key against the lock's DV
+public key, and writes root keystores."""
+
+from __future__ import annotations
+
+from pathlib import Path
+
+from .. import tbls
+from ..eth2 import keystore
+from ..utils import errors
+from .lock import Lock
+
+
+def combine(lock: Lock, node_key_dirs: list[str | Path], out_dir: str | Path,
+            *, insecure: bool = False) -> list[tbls.PrivateKey]:
+    """node_key_dirs[i] holds operator (i+1)'s keystores. Returns the root
+    secrets (also written to out_dir as keystores)."""
+    n_ops = len(lock.definition.operators)
+    threshold = lock.definition.threshold
+    if len(node_key_dirs) < threshold:
+        raise errors.new("insufficient share directories",
+                         got=len(node_key_dirs), want=threshold)
+    # share_idx -> per-DV secrets (keystore files are in lock validator order).
+    # The operator index is identified by matching the first DV's share pubkey
+    # against the lock — callers may pass any subset of node dirs in any order.
+    shares_by_op: dict[int, list[tbls.PrivateKey]] = {}
+    for key_dir in node_key_dirs:
+        if key_dir is None:
+            continue
+        key_dir = Path(key_dir)
+        if (key_dir / "validator_keys").is_dir():
+            key_dir = key_dir / "validator_keys"  # a node data dir was given
+        secrets = keystore.load_keys(key_dir)
+        if len(secrets) != len(lock.validators):
+            raise errors.new("keystore count != validator count",
+                             dir=str(key_dir), got=len(secrets),
+                             want=len(lock.validators))
+        first_share_pub = bytes(tbls.secret_to_public_key(secrets[0]))
+        op_idx = None
+        for idx, share_pub in enumerate(lock.validators[0].public_shares):
+            if bytes(share_pub) == first_share_pub:
+                op_idx = idx + 1
+                break
+        if op_idx is None:
+            raise errors.new("share keys do not belong to this cluster",
+                             dir=str(key_dir))
+        shares_by_op[op_idx] = secrets
+    if len(shares_by_op) < threshold:
+        raise errors.new("insufficient distinct share directories",
+                         got=len(shares_by_op), want=threshold)
+    recovered: list[tbls.PrivateKey] = []
+    for v_idx, dv in enumerate(lock.validators):
+        shares = {op_idx: secrets[v_idx]
+                  for op_idx, secrets in shares_by_op.items()}
+        # sanity: each share secret must match the lock's share pubkey
+        for op_idx, secret in shares.items():
+            expect = dv.public_shares[op_idx - 1]
+            got = bytes(tbls.secret_to_public_key(secret))
+            if got != bytes(expect):
+                raise errors.new("share key does not match lock",
+                                 validator=v_idx, operator=op_idx)
+        root = tbls.recover_secret(shares, n_ops, threshold)
+        if bytes(tbls.secret_to_public_key(root)) != bytes(dv.public_key):
+            raise errors.new("recovered key does not match DV public key",
+                             validator=v_idx)
+        recovered.append(root)
+    keystore.store_keys(recovered, out_dir, insecure=insecure)
+    return recovered

@@ -1,0 +1,274 @@
+"""Cluster definition — the signed configuration a cluster is created from
+(reference cluster/definition.go:106 Definition, docs/configuration.md).
+
+The definition is agreed before the DKG: name, operators (ENR + EIP-712
+signatures), validator count, threshold, fork version, fee recipient /
+withdrawal addresses. Hashes:
+
+  * config_hash     — SSZ root over the creation-time fields (what operators
+                      sign, reference cluster/ssz.go hashDefinition legacy/
+                      v1.3+ split collapsed to one canonical shape here)
+  * definition_hash — SSZ root over config fields + operator ENRs/signatures
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from dataclasses import dataclass, field, replace
+
+from ..eth2 import enr as enr_mod
+from ..eth2.ssz import Bytes4, Bytes32, ByteList, Container, List, uint64
+from ..utils import errors, k1util
+from . import eip712
+
+SUPPORTED_VERSIONS = ("v1.7.0",)
+DEFAULT_VERSION = "v1.7.0"
+
+
+@dataclass
+class Operator:
+    """One node operator (reference cluster/definition.go Operator)."""
+
+    address: str = ""       # EIP-55 Ethereum address of the operator
+    enr: str = ""           # the node's ENR (set by the operator)
+    config_signature: bytes = b""  # EIP-712 over config_hash
+    enr_signature: bytes = b""     # EIP-712 over (enr, config_hash)
+
+    def to_json(self) -> dict:
+        return {
+            "address": self.address,
+            "enr": self.enr,
+            "config_signature": "0x" + self.config_signature.hex(),
+            "enr_signature": "0x" + self.enr_signature.hex(),
+        }
+
+    @staticmethod
+    def from_json(o: dict) -> "Operator":
+        return Operator(
+            address=o.get("address", ""),
+            enr=o.get("enr", ""),
+            config_signature=bytes.fromhex(o.get("config_signature", "0x")[2:]),
+            enr_signature=bytes.fromhex(o.get("enr_signature", "0x")[2:]),
+        )
+
+
+# SSZ shapes for hashing (string fields hash as UTF-8 byte lists, the
+# reference's cluster/ssz.go convention)
+_STR = ByteList(256)
+_SIG = ByteList(65)
+_ADDR = ByteList(42)
+
+
+@dataclass
+class _OperatorSSZ:
+    address: bytes
+    enr: bytes
+    config_signature: bytes
+    enr_signature: bytes
+    ssz_fields = [("address", _ADDR), ("enr", _STR),
+                  ("config_signature", _SIG), ("enr_signature", _SIG)]
+
+
+@dataclass
+class _ConfigSSZ:
+    name: bytes
+    version: bytes
+    timestamp: bytes
+    num_validators: int
+    threshold: int
+    fork_version: bytes
+    dkg_algorithm: bytes
+    fee_recipient: bytes
+    withdrawal_address: bytes
+    operator_count: int
+    ssz_fields = [
+        ("name", _STR), ("version", _STR), ("timestamp", _STR),
+        ("num_validators", uint64), ("threshold", uint64),
+        ("fork_version", Bytes4), ("dkg_algorithm", _STR),
+        ("fee_recipient", _ADDR), ("withdrawal_address", _ADDR),
+        ("operator_count", uint64),
+    ]
+
+
+@dataclass
+class _DefinitionSSZ:
+    config: "_ConfigSSZ"
+    operators: list
+    ssz_fields = None  # filled below
+
+
+_DefinitionSSZ.ssz_fields = [
+    ("config", Container(_ConfigSSZ)),
+    ("operators", List(Container(_OperatorSSZ), 256)),
+]
+
+
+@dataclass
+class Definition:
+    """reference cluster/definition.go:106."""
+
+    name: str
+    num_validators: int
+    threshold: int
+    operators: list[Operator] = field(default_factory=list)
+    fork_version: bytes = b"\x00\x00\x00\x00"
+    dkg_algorithm: str = "frost"
+    fee_recipient_address: str = ""
+    withdrawal_address: str = ""
+    timestamp: str = ""
+    version: str = DEFAULT_VERSION
+    uuid: str = ""
+    creator_address: str = ""
+    creator_config_signature: bytes = b""
+
+    def __post_init__(self) -> None:
+        if not self.uuid:
+            self.uuid = os.urandom(16).hex()
+
+    # -- hashes ----------------------------------------------------------------
+
+    def _config_ssz(self) -> _ConfigSSZ:
+        return _ConfigSSZ(
+            name=self.name.encode(),
+            version=self.version.encode(),
+            timestamp=self.timestamp.encode(),
+            num_validators=self.num_validators,
+            threshold=self.threshold,
+            fork_version=self.fork_version,
+            dkg_algorithm=self.dkg_algorithm.encode(),
+            fee_recipient=self.fee_recipient_address.encode(),
+            withdrawal_address=self.withdrawal_address.encode(),
+            operator_count=len(self.operators),
+        )
+
+    def config_hash(self) -> bytes:
+        """What operators/creator sign (reference cluster/ssz.go config hash)."""
+        return Container(_ConfigSSZ).hash_tree_root(self._config_ssz())
+
+    def definition_hash(self) -> bytes:
+        """Root over config + operator records (reference definition hash)."""
+        ops = [_OperatorSSZ(address=o.address.encode(), enr=o.enr.encode(),
+                            config_signature=o.config_signature,
+                            enr_signature=o.enr_signature)
+               for o in self.operators]
+        return Container(_DefinitionSSZ).hash_tree_root(
+            _DefinitionSSZ(self._config_ssz(), ops))
+
+    @property
+    def chain_id(self) -> int:
+        """EIP-712 chain id derived from the fork version (the reference maps
+        fork version -> network chain id; unknown forks use the raw value)."""
+        known = {b"\x00\x00\x00\x00": 1, b"\x00\x00\x10\x20": 5,
+                 b"\x90\x00\x00\x69": 17000, b"\x00\x00\x00\x64": 100}
+        return known.get(self.fork_version, int.from_bytes(self.fork_version, "big"))
+
+    # -- signatures --------------------------------------------------------------
+
+    def sign_operator(self, operator_index: int, privkey: bytes) -> "Definition":
+        """Operator signs its ENR + the config hash (reference
+        definition.go signOperator)."""
+        op = self.operators[operator_index]
+        ch = self.config_hash()
+        new_op = replace(
+            op,
+            address=_address_of(privkey),
+            config_signature=eip712.sign_creator(privkey, self.chain_id, ch),
+            enr_signature=eip712.sign_operator(privkey, self.chain_id, op.enr, ch),
+        )
+        ops = list(self.operators)
+        ops[operator_index] = new_op
+        return replace(self, operators=ops)
+
+    def verify_signatures(self) -> None:
+        """Verify every operator's EIP-712 signatures and that each ENR's
+        identity key matches (reference definition.go VerifySignatures)."""
+        ch = self.config_hash()
+        for i, op in enumerate(self.operators):
+            if not op.enr:
+                raise errors.new("operator missing ENR", index=i)
+            record = enr_mod.parse(op.enr)  # verifies the ENR signature
+            if not op.config_signature and not op.enr_signature:
+                if self.dkg_algorithm == "no-verify":
+                    continue
+                raise errors.new("operator unsigned", index=i)
+            try:
+                pub_cfg = k1util.recover(
+                    eip712.creator_digest(self.chain_id, ch), op.config_signature)
+                pub_enr = k1util.recover(
+                    eip712.operator_digest(self.chain_id, op.enr, ch), op.enr_signature)
+            except ValueError as exc:
+                raise errors.new("operator signature malformed", index=i,
+                                 detail=str(exc)) from exc
+            if pub_cfg != pub_enr:
+                raise errors.new("operator signature keys differ", index=i)
+            if pub_cfg != record.pubkey:
+                raise errors.new("operator signature does not match ENR identity",
+                                 index=i)
+
+    # -- JSON ---------------------------------------------------------------------
+
+    def to_json(self) -> dict:
+        return {
+            "name": self.name,
+            "creator": {"address": self.creator_address,
+                        "config_signature": "0x" + self.creator_config_signature.hex()},
+            "operators": [o.to_json() for o in self.operators],
+            "uuid": self.uuid,
+            "version": self.version,
+            "timestamp": self.timestamp,
+            "num_validators": self.num_validators,
+            "threshold": self.threshold,
+            "fork_version": "0x" + self.fork_version.hex(),
+            "dkg_algorithm": self.dkg_algorithm,
+            "validators": [{
+                "fee_recipient_address": self.fee_recipient_address,
+                "withdrawal_address": self.withdrawal_address,
+            }] * self.num_validators,
+            "config_hash": "0x" + self.config_hash().hex(),
+            "definition_hash": "0x" + self.definition_hash().hex(),
+        }
+
+    @staticmethod
+    def from_json(o: dict) -> "Definition":
+        if o.get("version") not in SUPPORTED_VERSIONS:
+            raise errors.new("unsupported definition version", version=o.get("version"))
+        vals = o.get("validators") or [{}]
+        d = Definition(
+            name=o["name"],
+            num_validators=int(o["num_validators"]),
+            threshold=int(o["threshold"]),
+            operators=[Operator.from_json(x) for x in o.get("operators", [])],
+            fork_version=bytes.fromhex(o.get("fork_version", "0x00000000")[2:]),
+            dkg_algorithm=o.get("dkg_algorithm", "frost"),
+            fee_recipient_address=vals[0].get("fee_recipient_address", ""),
+            withdrawal_address=vals[0].get("withdrawal_address", ""),
+            timestamp=o.get("timestamp", ""),
+            version=o["version"],
+            uuid=o.get("uuid", ""),
+            creator_address=o.get("creator", {}).get("address", ""),
+            creator_config_signature=bytes.fromhex(
+                o.get("creator", {}).get("config_signature", "0x")[2:]),
+        )
+        # integrity: stored hashes must match recomputed ones
+        if "config_hash" in o and o["config_hash"] != "0x" + d.config_hash().hex():
+            raise errors.new("config_hash mismatch")
+        if "definition_hash" in o and o["definition_hash"] != "0x" + d.definition_hash().hex():
+            raise errors.new("definition_hash mismatch")
+        return d
+
+
+def _address_of(privkey: bytes) -> str:
+    from ..utils.keccak import checksum_address, eth_address
+
+    return checksum_address(eth_address(k1util.uncompressed(k1util.public_key(privkey))))
+
+
+def save(d: Definition, path: str) -> None:
+    with open(path, "w") as f:
+        json.dump(d.to_json(), f, indent=2)
+
+
+def load(path: str) -> Definition:
+    with open(path) as f:
+        return Definition.from_json(json.load(f))

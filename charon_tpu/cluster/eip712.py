@@ -1,0 +1,82 @@
+"""EIP-712 typed-data hashing + signing for cluster configuration
+(reference cluster/eip712sigs.go).
+
+The cluster definition carries two signature kinds per operator:
+  * operator ENR signature — EIP-712 over {enr, config_hash} ("Operator")
+  * creator config signature — EIP-712 over {config_hash} ("Creator")
+Domain: name "Obol"-analogue "CharonTPU", version "1", and the fork-version-
+derived chain id, matching the reference's eip712 domain construction.
+"""
+
+from __future__ import annotations
+
+from ..utils import k1util
+from ..utils.keccak import keccak256
+
+DOMAIN_NAME = "CharonTPU"
+DOMAIN_VERSION = "1"
+
+
+def _type_hash(primary: str, fields: list[tuple[str, str]]) -> bytes:
+    sig = primary + "(" + ",".join(f"{t} {n}" for n, t in fields) + ")"
+    return keccak256(sig.encode())
+
+
+def _encode_value(typ: str, value) -> bytes:
+    if typ == "string":
+        return keccak256(value.encode() if isinstance(value, str) else bytes(value))
+    if typ == "uint256":
+        return int(value).to_bytes(32, "big")
+    if typ == "bytes32":
+        v = bytes(value)
+        if len(v) != 32:
+            raise ValueError("bytes32 value must be 32 bytes")
+        return v
+    raise ValueError(f"unsupported EIP-712 type {typ}")
+
+
+def hash_typed_data(chain_id: int, primary: str,
+                    fields: list[tuple[str, str]], values: dict) -> bytes:
+    """keccak256(0x1901 || domainSeparator || structHash)."""
+    domain_fields = [("name", "string"), ("version", "string"), ("chainId", "uint256")]
+    domain_sep = keccak256(
+        _type_hash("EIP712Domain", domain_fields)
+        + _encode_value("string", DOMAIN_NAME)
+        + _encode_value("string", DOMAIN_VERSION)
+        + _encode_value("uint256", chain_id))
+    struct = _type_hash(primary, fields) + b"".join(
+        _encode_value(t, values[n]) for n, t in fields)
+    return keccak256(b"\x19\x01" + domain_sep + keccak256(struct))
+
+
+# -- the two cluster signature kinds (reference eip712sigs.go) ----------------
+
+_OPERATOR_FIELDS = [("enr", "string"), ("config_hash", "bytes32")]
+_CREATOR_FIELDS = [("config_hash", "bytes32")]
+
+
+def operator_digest(chain_id: int, enr: str, config_hash: bytes) -> bytes:
+    return hash_typed_data(chain_id, "OperatorENR", _OPERATOR_FIELDS,
+                           {"enr": enr, "config_hash": config_hash})
+
+
+def creator_digest(chain_id: int, config_hash: bytes) -> bytes:
+    return hash_typed_data(chain_id, "CreatorConfigHash", _CREATOR_FIELDS,
+                           {"config_hash": config_hash})
+
+
+def sign_operator(privkey: bytes, chain_id: int, enr: str, config_hash: bytes) -> bytes:
+    return k1util.sign(privkey, operator_digest(chain_id, enr, config_hash))
+
+
+def verify_operator(pubkey: bytes, chain_id: int, enr: str, config_hash: bytes,
+                    sig: bytes) -> bool:
+    return k1util.verify(pubkey, operator_digest(chain_id, enr, config_hash), sig)
+
+
+def sign_creator(privkey: bytes, chain_id: int, config_hash: bytes) -> bytes:
+    return k1util.sign(privkey, creator_digest(chain_id, config_hash))
+
+
+def verify_creator(pubkey: bytes, chain_id: int, config_hash: bytes, sig: bytes) -> bool:
+    return k1util.verify(pubkey, creator_digest(chain_id, config_hash), sig)

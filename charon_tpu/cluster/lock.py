@@ -1,0 +1,160 @@
+"""Cluster lock — the post-DKG artifact every node runs from
+(reference cluster/lock.go:21 Lock, cluster/distvalidator.go:18).
+
+lock = definition + the distributed validators (DV root pubkey + per-operator
+share pubkeys + deposit data) + lock_hash + aggregate signatures:
+  * signature_aggregate — BLS aggregate of all share-key signatures over the
+    lock hash (proves every share key participated in the ceremony)
+  * node_signatures     — each operator's k1 signature over the lock hash
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field
+
+from .. import tbls
+from ..eth2.ssz import Bytes32, Bytes48, Bytes96, Container, List, uint64
+from ..utils import errors, k1util
+from .definition import Definition, _DefinitionSSZ, _OperatorSSZ  # noqa: F401
+
+
+@dataclass
+class DistValidator:
+    """One distributed validator (reference cluster/distvalidator.go:18)."""
+
+    public_key: bytes                       # 48-byte DV root pubkey
+    public_shares: list[bytes] = field(default_factory=list)  # per-operator, 1..n order
+    deposit_data_root: bytes = b"\x00" * 32
+    deposit_signature: bytes = b"\x00" * 96
+
+    def to_json(self) -> dict:
+        return {
+            "distributed_public_key": "0x" + self.public_key.hex(),
+            "public_shares": ["0x" + s.hex() for s in self.public_shares],
+            "deposit_data": {
+                "root": "0x" + self.deposit_data_root.hex(),
+                "signature": "0x" + self.deposit_signature.hex(),
+            },
+        }
+
+    @staticmethod
+    def from_json(o: dict) -> "DistValidator":
+        dd = o.get("deposit_data", {})
+        return DistValidator(
+            public_key=bytes.fromhex(o["distributed_public_key"][2:]),
+            public_shares=[bytes.fromhex(s[2:]) for s in o.get("public_shares", [])],
+            deposit_data_root=bytes.fromhex(dd.get("root", "0x" + "00" * 32)[2:]),
+            deposit_signature=bytes.fromhex(dd.get("signature", "0x" + "00" * 96)[2:]),
+        )
+
+
+@dataclass
+class _DVSSZ:
+    public_key: bytes
+    public_shares: list
+    deposit_data_root: bytes
+    deposit_signature: bytes
+    ssz_fields = [
+        ("public_key", Bytes48),
+        ("public_shares", List(Bytes48, 256)),
+        ("deposit_data_root", Bytes32),
+        ("deposit_signature", Bytes96),
+    ]
+
+
+@dataclass
+class _LockSSZ:
+    definition_hash: bytes
+    validators: list
+    ssz_fields = [("definition_hash", Bytes32),
+                  ("validators", List(Container(_DVSSZ), 65536))]
+
+
+@dataclass
+class Lock:
+    """reference cluster/lock.go:21."""
+
+    definition: Definition
+    validators: list[DistValidator] = field(default_factory=list)
+    signature_aggregate: bytes = b""
+    node_signatures: list[bytes] = field(default_factory=list)
+
+    def lock_hash(self) -> bytes:
+        dvs = [_DVSSZ(v.public_key, v.public_shares, v.deposit_data_root,
+                      v.deposit_signature) for v in self.validators]
+        return Container(_LockSSZ).hash_tree_root(
+            _LockSSZ(self.definition.definition_hash(), dvs))
+
+    # -- signatures -------------------------------------------------------------
+
+    def aggregate_share_signatures(self, share_sigs: list[tbls.Signature]) -> None:
+        """BLS-aggregate every share key's signature over the lock hash
+        (reference lock.go SignatureAggregate via dkg aggLockHashSig)."""
+        self.signature_aggregate = bytes(tbls.aggregate(share_sigs))
+
+    def verify(self) -> None:
+        """Verify hashes + the share-signature aggregate + node signatures
+        (reference lock.go VerifySignatures). Missing signatures are a
+        verification FAILURE (a stripped lock must not pass) unless the
+        definition explicitly opted out with dkg_algorithm "no-verify"."""
+        self.definition.verify_signatures()
+        h = self.lock_hash()
+        no_verify = self.definition.dkg_algorithm == "no-verify"
+        if not self.signature_aggregate:
+            if not no_verify:
+                raise errors.new("lock missing signature aggregate")
+        else:
+            all_shares = [tbls.PublicKey(s) for v in self.validators
+                          for s in v.public_shares]
+            if not tbls.verify_aggregate(all_shares, h,
+                                         tbls.Signature(self.signature_aggregate)):
+                raise errors.new("lock signature aggregate invalid")
+        ops = self.definition.operators
+        if not self.node_signatures:
+            if not no_verify:
+                raise errors.new("lock missing node signatures")
+        else:
+            if len(self.node_signatures) != len(ops):
+                raise errors.new("node signature count mismatch")
+            from ..eth2 import enr as enr_mod
+
+            for i, (op, sig) in enumerate(zip(ops, self.node_signatures)):
+                record = enr_mod.parse(op.enr)
+                if not k1util.verify(record.pubkey, h, sig):
+                    raise errors.new("node signature invalid", index=i)
+
+    # -- JSON -------------------------------------------------------------------
+
+    def to_json(self) -> dict:
+        return {
+            "cluster_definition": self.definition.to_json(),
+            "distributed_validators": [v.to_json() for v in self.validators],
+            "signature_aggregate": "0x" + self.signature_aggregate.hex(),
+            "lock_hash": "0x" + self.lock_hash().hex(),
+            "node_signatures": ["0x" + s.hex() for s in self.node_signatures],
+        }
+
+    @staticmethod
+    def from_json(o: dict) -> "Lock":
+        lock = Lock(
+            definition=Definition.from_json(o["cluster_definition"]),
+            validators=[DistValidator.from_json(v)
+                        for v in o.get("distributed_validators", [])],
+            signature_aggregate=bytes.fromhex(o.get("signature_aggregate", "0x")[2:]),
+            node_signatures=[bytes.fromhex(s[2:])
+                             for s in o.get("node_signatures", [])],
+        )
+        if "lock_hash" in o and o["lock_hash"] != "0x" + lock.lock_hash().hex():
+            raise errors.new("lock_hash mismatch")
+        return lock
+
+
+def save(lock: Lock, path: str) -> None:
+    with open(path, "w") as f:
+        json.dump(lock.to_json(), f, indent=2)
+
+
+def load(path: str) -> Lock:
+    with open(path) as f:
+        return Lock.from_json(json.load(f))

@@ -1,0 +1,191 @@
+"""Cluster manifest — mutable cluster state as an append-only log of signed
+mutations (reference cluster/manifest/{mutation,materialise,load}.go).
+
+The reference stores a protobuf SignedMutationList; we store a JSON list.
+Mutation kinds (matching the reference's set):
+
+  * legacy_lock    — genesis: wraps the initial cluster lock
+  * add_validators — appends distributed validators (gen_validators/
+                     node_approvals composite collapsed to one parent
+                     mutation carrying per-node approval signatures)
+
+Each mutation is hashed (sha256 over its canonical JSON with the parent
+hash) and signed; `materialise` folds the log into the current Cluster
+state and `verify` checks the hash chain + signatures.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+from dataclasses import dataclass, field
+from pathlib import Path
+
+from ..eth2 import enr as enr_mod
+from ..utils import errors, k1util
+from .lock import DistValidator, Lock
+
+KIND_LEGACY_LOCK = "cluster/legacy_lock/v0.0.1"
+KIND_ADD_VALIDATORS = "cluster/add_validators/v0.0.1"
+
+
+def _canon(obj) -> bytes:
+    return json.dumps(obj, sort_keys=True, separators=(",", ":")).encode()
+
+
+@dataclass
+class SignedMutation:
+    """One log entry (reference manifestpb SignedMutation)."""
+
+    kind: str
+    parent_hash: bytes            # hash of the previous mutation (zero at genesis)
+    payload: dict                 # kind-specific body
+    signer: bytes = b""           # k1 pubkey (empty for legacy_lock: lock self-verifies)
+    signature: bytes = b""
+
+    def hash(self) -> bytes:
+        return hashlib.sha256(_canon({
+            "kind": self.kind,
+            "parent": self.parent_hash.hex(),
+            "payload": self.payload,
+        })).digest()
+
+    def sign(self, privkey: bytes) -> "SignedMutation":
+        self.signer = k1util.public_key(privkey)
+        self.signature = k1util.sign(privkey, self.hash())
+        return self
+
+    def verify_signature(self) -> bool:
+        if not self.signer:
+            return self.kind == KIND_LEGACY_LOCK
+        return k1util.verify(self.signer, self.hash(), self.signature)
+
+    def to_json(self) -> dict:
+        return {
+            "kind": self.kind,
+            "parent_hash": "0x" + self.parent_hash.hex(),
+            "payload": self.payload,
+            "signer": "0x" + self.signer.hex(),
+            "signature": "0x" + self.signature.hex(),
+        }
+
+    @staticmethod
+    def from_json(o: dict) -> "SignedMutation":
+        return SignedMutation(
+            kind=o["kind"],
+            parent_hash=bytes.fromhex(o["parent_hash"][2:]),
+            payload=o["payload"],
+            signer=bytes.fromhex(o.get("signer", "0x")[2:]),
+            signature=bytes.fromhex(o.get("signature", "0x")[2:]),
+        )
+
+
+@dataclass
+class Cluster:
+    """Materialised cluster state (reference manifestpb.Cluster)."""
+
+    lock: Lock
+    extra_validators: list[DistValidator] = field(default_factory=list)
+
+    @property
+    def validators(self) -> list[DistValidator]:
+        return list(self.lock.validators) + list(self.extra_validators)
+
+
+def new_log_from_lock(lock: Lock) -> list[SignedMutation]:
+    """Genesis log: a single legacy_lock mutation (reference
+    manifest/legacylock.go NewLegacyLock)."""
+    return [SignedMutation(KIND_LEGACY_LOCK, b"\x00" * 32,
+                           {"lock": lock.to_json()})]
+
+
+def add_validators(log: list[SignedMutation], validators: list[DistValidator],
+                   operator_privkeys: list[bytes]) -> list[SignedMutation]:
+    """Append an add_validators mutation approved (signed) by every operator.
+    The composite parent carries the per-node approvals
+    (reference manifest/mutationadd.go + nodeapprovals)."""
+    parent = log[-1].hash()
+    payload = {"validators": [v.to_json() for v in validators]}
+    base = SignedMutation(KIND_ADD_VALIDATORS, parent, dict(payload))
+    approvals = []
+    for key in operator_privkeys:
+        approval = SignedMutation(KIND_ADD_VALIDATORS, parent, dict(payload)).sign(key)
+        approvals.append({"signer": "0x" + approval.signer.hex(),
+                          "signature": "0x" + approval.signature.hex()})
+    base.payload["approvals"] = approvals
+    return log + [base]
+
+
+def materialise(log: list[SignedMutation]) -> Cluster:
+    """Fold the mutation log into current state, verifying the hash chain and
+    signatures (reference manifest/materialise.go Materialise)."""
+    if not log:
+        raise errors.new("empty manifest log")
+    if log[0].kind != KIND_LEGACY_LOCK:
+        raise errors.new("manifest must start with legacy_lock")
+    lock = Lock.from_json(log[0].payload["lock"])
+    lock.verify()
+    cluster = Cluster(lock)
+    operator_pubkeys = {enr_mod.parse(op.enr).pubkey
+                        for op in lock.definition.operators}
+    prev_hash = log[0].hash()
+    for mut in log[1:]:
+        if mut.parent_hash != prev_hash:
+            raise errors.new("broken manifest hash chain", kind=mut.kind)
+        if mut.kind == KIND_ADD_VALIDATORS:
+            _verify_add_validators(mut, operator_pubkeys)
+            cluster.extra_validators.extend(
+                DistValidator.from_json(v) for v in mut.payload["validators"])
+        else:
+            raise errors.new("unknown mutation kind", kind=mut.kind)
+        prev_hash = mut.hash()
+    return cluster
+
+
+def _verify_add_validators(mut: SignedMutation, operator_pubkeys: set[bytes]) -> None:
+    approvals = mut.payload.get("approvals", [])
+    if len(approvals) < len(operator_pubkeys):
+        raise errors.new("add_validators missing approvals",
+                         got=len(approvals), want=len(operator_pubkeys))
+    # approvals sign the mutation body WITHOUT the approvals field
+    body = SignedMutation(mut.kind, mut.parent_hash,
+                          {"validators": mut.payload["validators"]})
+    seen = set()
+    for appr in approvals:
+        signer = bytes.fromhex(appr["signer"][2:])
+        sig = bytes.fromhex(appr["signature"][2:])
+        if signer not in operator_pubkeys:
+            raise errors.new("approval from non-operator")
+        if signer in seen:
+            raise errors.new("duplicate approval")
+        if not k1util.verify(signer, body.hash(), sig):
+            raise errors.new("invalid approval signature")
+        seen.add(signer)
+    if seen != operator_pubkeys:
+        raise errors.new("approvals do not cover all operators")
+
+
+def save(log: list[SignedMutation], path: str | Path) -> None:
+    Path(path).write_text(json.dumps([m.to_json() for m in log], indent=2))
+
+
+def load(path: str | Path) -> list[SignedMutation]:
+    return [SignedMutation.from_json(o) for o in json.loads(Path(path).read_text())]
+
+
+def load_cluster(data_dir: str | Path) -> Cluster:
+    """Load cluster state: cluster-manifest.json preferred, falling back to
+    cluster-lock.json (reference app/disk.go loadClusterManifest order)."""
+    data_dir = Path(data_dir)
+    manifest_path = data_dir / "cluster-manifest.json"
+    if manifest_path.exists():
+        return materialise(load(manifest_path))
+    lock_path = data_dir / "cluster-lock.json"
+    if lock_path.exists():
+        from . import lock as lock_mod
+
+        lk = lock_mod.load(str(lock_path))
+        lk.verify()
+        return Cluster(lk)
+    raise errors.new("no cluster-manifest.json or cluster-lock.json",
+                     dir=str(data_dir))

@@ -1,0 +1,87 @@
+"""Deposit data (reference eth2util/deposit/deposit.go): the signed message
+that activates a validator on the beacon chain. The DKG ceremony threshold-
+signs one per DV (reference dkg/dkg.go signAndAggDepositData)."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from .. import tbls
+from .spec import ChainSpec
+from .ssz import Bytes4, Bytes32, Bytes48, Bytes96, Container, uint64
+
+DOMAIN_DEPOSIT = b"\x03\x00\x00\x00"
+DEFAULT_AMOUNT_GWEI = 32 * 10 ** 9
+
+
+@dataclass
+class DepositMessage:
+    pubkey: bytes
+    withdrawal_credentials: bytes
+    amount: int
+    ssz_fields = [("pubkey", Bytes48), ("withdrawal_credentials", Bytes32),
+                  ("amount", uint64)]
+
+
+@dataclass
+class DepositData:
+    pubkey: bytes
+    withdrawal_credentials: bytes
+    amount: int
+    signature: bytes
+    ssz_fields = [("pubkey", Bytes48), ("withdrawal_credentials", Bytes32),
+                  ("amount", uint64), ("signature", Bytes96)]
+
+
+@dataclass
+class _ForkDataSSZ:
+    current_version: bytes
+    genesis_validators_root: bytes
+    ssz_fields = [("current_version", Bytes4),
+                  ("genesis_validators_root", Bytes32)]
+
+
+@dataclass
+class _SigningDataSSZ:
+    object_root: bytes
+    domain: bytes
+    ssz_fields = [("object_root", Bytes32), ("domain", Bytes32)]
+
+
+def withdrawal_credentials_from_address(addr20: bytes) -> bytes:
+    """0x01 (execution-address) withdrawal credentials."""
+    if len(addr20) != 20:
+        raise ValueError("need a 20-byte execution address")
+    return b"\x01" + b"\x00" * 11 + addr20
+
+
+def deposit_domain(fork_version: bytes) -> bytes:
+    """Deposit domain uses a zero genesis_validators_root (it is signed before
+    genesis; consensus-spec compute_domain for DOMAIN_DEPOSIT)."""
+    fork_data = _ForkDataSSZ(fork_version, b"\x00" * 32)
+    root = Container(_ForkDataSSZ).hash_tree_root(fork_data)
+    return DOMAIN_DEPOSIT + root[:28]
+
+
+def signing_root(msg: DepositMessage, fork_version: bytes) -> bytes:
+    msg_root = Container(DepositMessage).hash_tree_root(msg)
+    sd = _SigningDataSSZ(msg_root, deposit_domain(fork_version))
+    return Container(_SigningDataSSZ).hash_tree_root(sd)
+
+
+def data_root(data: DepositData) -> bytes:
+    return Container(DepositData).hash_tree_root(data)
+
+
+def new_message(pubkey: tbls.PublicKey, withdrawal_addr20: bytes,
+                amount: int = DEFAULT_AMOUNT_GWEI) -> DepositMessage:
+    return DepositMessage(bytes(pubkey),
+                          withdrawal_credentials_from_address(withdrawal_addr20),
+                          amount)
+
+
+def verify_deposit(data: DepositData, fork_version: bytes) -> bool:
+    msg = DepositMessage(data.pubkey, data.withdrawal_credentials, data.amount)
+    return tbls.verify(tbls.PublicKey(data.pubkey),
+                       signing_root(msg, fork_version),
+                       tbls.Signature(data.signature))

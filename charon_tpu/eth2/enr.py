@@ -1,0 +1,91 @@
+"""Ethereum Node Records (EIP-778) — create/parse/sign with secp256k1 keys
+(reference eth2util/enr/enr.go:38,127).
+
+Charon uses ENRs as durable node identity: `charon create enr` writes the
+identity key and prints the ENR; cluster definitions carry each operator's
+ENR. Only the v4 identity scheme is supported (like the reference).
+"""
+
+from __future__ import annotations
+
+import base64
+from dataclasses import dataclass, field
+
+from ..utils import k1util
+from ..utils.keccak import keccak256
+from . import rlp
+
+
+class ENRError(ValueError):
+    pass
+
+
+@dataclass
+class ENR:
+    """A signed node record: sorted key/value pairs + sequence number."""
+
+    signature: bytes
+    seq: int
+    kvs: dict[bytes, bytes] = field(default_factory=dict)
+
+    @property
+    def pubkey(self) -> bytes:
+        pk = self.kvs.get(b"secp256k1")
+        if pk is None:
+            raise ENRError("record has no secp256k1 key")
+        return pk
+
+    def _content(self) -> list:
+        items: list = [self.seq]
+        for k in sorted(self.kvs):
+            items += [k, self.kvs[k]]
+        return items
+
+    def signing_digest(self) -> bytes:
+        return k1_digest(self._content())
+
+    def verify(self) -> bool:
+        return k1util.verify(self.pubkey, self.signing_digest(), self.signature)
+
+    def encode(self) -> str:
+        """enr:<base64url of rlp([sig, seq, k, v, ...])>"""
+        payload = rlp.encode([self.signature] + self._content())
+        return "enr:" + base64.urlsafe_b64encode(payload).rstrip(b"=").decode()
+
+
+def k1_digest(content: list) -> bytes:
+    """EIP-778 v4 identity scheme: sign keccak256(rlp(content))."""
+    return keccak256(rlp.encode(content))
+
+
+def new(privkey: bytes, seq: int = 1, **extra: bytes) -> ENR:
+    """Create and sign a record for an identity key
+    (reference enr.go:127 New). Extra kvs: e.g. ip=..., tcp=...."""
+    kvs: dict[bytes, bytes] = {b"id": b"v4", b"secp256k1": k1util.public_key(privkey)}
+    for k, v in extra.items():
+        kvs[k.encode()] = v
+    record = ENR(b"", seq, kvs)
+    sig65 = k1util.sign(privkey, record.signing_digest())
+    record.signature = sig65[:64]  # ENR carries r||s without recovery id
+    return record
+
+
+def parse(text: str) -> ENR:
+    """Parse and verify an enr:... string (reference enr.go:38 Parse)."""
+    if not text.startswith("enr:"):
+        raise ENRError("missing enr: prefix")
+    b64 = text[4:]
+    payload = base64.urlsafe_b64decode(b64 + "=" * (-len(b64) % 4))
+    items = rlp.decode(payload)
+    if not isinstance(items, list) or len(items) < 2 or len(items) % 2 != 0:
+        raise ENRError("malformed record structure")
+    sig, seq_b = items[0], items[1]
+    kvs: dict[bytes, bytes] = {}
+    for i in range(2, len(items), 2):
+        kvs[bytes(items[i])] = bytes(items[i + 1])
+    record = ENR(bytes(sig), int.from_bytes(seq_b, "big") if seq_b else 0, kvs)
+    if kvs.get(b"id") != b"v4":
+        raise ENRError("unsupported identity scheme")
+    if not record.verify():
+        raise ENRError("invalid record signature")
+    return record

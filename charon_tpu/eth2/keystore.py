@@ -1,0 +1,112 @@
+"""EIP-2335 BLS keystores (version 4) — durable share-key storage
+(reference eth2util/keystore/keystore.go:48-123 StoreKeys/LoadKeys).
+
+KDF: scrypt (n=262144, r=8, p=1 — the EIP-2335 defaults the reference uses);
+cipher: AES-128-CTR; checksum: sha256. `insecure=True` lowers scrypt cost for
+tests exactly like the reference's testutil keystores (keystore.go:48 notes
+insecure test parameters).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import uuid
+from pathlib import Path
+
+from cryptography.hazmat.primitives.ciphers import Cipher, algorithms, modes
+
+from .. import tbls
+from ..utils import errors
+
+
+def _scrypt_params(insecure: bool) -> dict:
+    if insecure:
+        return {"dklen": 32, "n": 1 << 4, "r": 8, "p": 1}
+    return {"dklen": 32, "n": 1 << 18, "r": 8, "p": 1}
+
+
+def _aes128ctr(key16: bytes, iv16: bytes, data: bytes) -> bytes:
+    cipher = Cipher(algorithms.AES(key16), modes.CTR(iv16))
+    enc = cipher.encryptor()
+    return enc.update(data) + enc.finalize()
+
+
+def encrypt(secret: tbls.PrivateKey, password: str, *, insecure: bool = False,
+            pubkey: tbls.PublicKey | None = None, path: str = "m/12381/3600/0/0/0") -> dict:
+    """Encrypt a BLS secret into an EIP-2335 keystore dict."""
+    params = _scrypt_params(insecure)
+    salt = os.urandom(32)
+    dk = hashlib.scrypt(password.encode(), salt=salt, n=params["n"], r=params["r"],
+                        p=params["p"], dklen=params["dklen"], maxmem=2 ** 31 - 1)
+    iv = os.urandom(16)
+    ciphertext = _aes128ctr(dk[:16], iv, bytes(secret))
+    checksum = hashlib.sha256(dk[16:32] + ciphertext).digest()
+    if pubkey is None:
+        pubkey = tbls.secret_to_public_key(secret)
+    return {
+        "crypto": {
+            "kdf": {"function": "scrypt", "params": {**params, "salt": salt.hex()}, "message": ""},
+            "checksum": {"function": "sha256", "params": {}, "message": checksum.hex()},
+            "cipher": {"function": "aes-128-ctr", "params": {"iv": iv.hex()},
+                       "message": ciphertext.hex()},
+        },
+        "description": "charon-tpu distributed validator key share",
+        "pubkey": bytes(pubkey).hex(),
+        "path": path,
+        "uuid": str(uuid.uuid4()),
+        "version": 4,
+    }
+
+
+def decrypt(store: dict, password: str) -> tbls.PrivateKey:
+    crypto = store.get("crypto", {})
+    kdf = crypto.get("kdf", {})
+    if kdf.get("function") != "scrypt":
+        raise errors.new("unsupported keystore kdf", kdf=kdf.get("function"))
+    params = kdf["params"]
+    dk = hashlib.scrypt(password.encode(), salt=bytes.fromhex(params["salt"]),
+                        n=int(params["n"]), r=int(params["r"]), p=int(params["p"]),
+                        dklen=int(params["dklen"]), maxmem=2 ** 31 - 1)
+    ciphertext = bytes.fromhex(crypto["cipher"]["message"])
+    checksum = hashlib.sha256(dk[16:32] + ciphertext).digest()
+    if checksum.hex() != crypto["checksum"]["message"]:
+        raise errors.new("keystore password incorrect (checksum mismatch)")
+    if crypto["cipher"]["function"] != "aes-128-ctr":
+        raise errors.new("unsupported keystore cipher")
+    iv = bytes.fromhex(crypto["cipher"]["params"]["iv"])
+    secret = _aes128ctr(dk[:16], iv, ciphertext)
+    return tbls.PrivateKey(secret)
+
+
+def store_keys(secrets: list[tbls.PrivateKey], directory: str | Path, *,
+               password: str | None = None, insecure: bool = False) -> None:
+    """Write keystore-%d.json + keystore-%d.txt password files
+    (reference keystore.go:57 StoreKeys layout)."""
+    directory = Path(directory)
+    directory.mkdir(parents=True, exist_ok=True)
+    for i, secret in enumerate(secrets):
+        pw = password if password is not None else os.urandom(16).hex()
+        store = encrypt(secret, pw, insecure=insecure)
+        (directory / f"keystore-{i}.json").write_text(json.dumps(store, indent=2))
+        pw_path = directory / f"keystore-{i}.txt"
+        pw_path.write_text(pw)
+        pw_path.chmod(0o600)  # the password IS the key material
+
+
+def load_keys(directory: str | Path) -> list[tbls.PrivateKey]:
+    """Load all keystore-*.json files with their sibling .txt passwords
+    (reference keystore.go:48 LoadKeys)."""
+    directory = Path(directory)
+    stores = sorted(directory.glob("keystore-*.json"),
+                    key=lambda p: int(p.stem.split("-")[1]))
+    if not stores:
+        raise errors.new("no keystores found", dir=str(directory))
+    out = []
+    for path in stores:
+        pw_path = path.with_suffix(".txt")
+        if not pw_path.exists():
+            raise errors.new("missing keystore password file", file=str(pw_path))
+        out.append(decrypt(json.loads(path.read_text()), pw_path.read_text().strip()))
+    return out

@@ -1,0 +1,76 @@
+"""Minimal RLP encode/decode (reference eth2util/rlp): needed for ENR
+serialization. Items are bytes or (nested) lists of items."""
+
+from __future__ import annotations
+
+from typing import Any
+
+
+def encode(item: Any) -> bytes:
+    if isinstance(item, (bytes, bytearray)):
+        data = bytes(item)
+        if len(data) == 1 and data[0] < 0x80:
+            return data
+        return _length_prefix(len(data), 0x80) + data
+    if isinstance(item, int):
+        if item < 0:
+            raise ValueError("RLP cannot encode negative integers")
+        data = b"" if item == 0 else item.to_bytes((item.bit_length() + 7) // 8, "big")
+        return encode(data)
+    if isinstance(item, (list, tuple)):
+        payload = b"".join(encode(x) for x in item)
+        return _length_prefix(len(payload), 0xC0) + payload
+    raise TypeError(f"cannot RLP-encode {type(item).__name__}")
+
+
+def _length_prefix(length: int, offset: int) -> bytes:
+    if length < 56:
+        return bytes([offset + length])
+    ll = length.to_bytes((length.bit_length() + 7) // 8, "big")
+    return bytes([offset + 55 + len(ll)]) + ll
+
+
+def decode(data: bytes) -> Any:
+    item, rest = _decode_one(bytes(data))
+    if rest:
+        raise ValueError("trailing RLP bytes")
+    return item
+
+
+def _decode_one(data: bytes) -> tuple[Any, bytes]:
+    if not data:
+        raise ValueError("empty RLP input")
+    b0 = data[0]
+    if b0 < 0x80:
+        return bytes([b0]), data[1:]
+    if b0 < 0xB8:  # short string
+        n = b0 - 0x80
+        if len(data) < 1 + n:
+            raise ValueError("short RLP string")
+        return data[1:1 + n], data[1 + n:]
+    if b0 < 0xC0:  # long string
+        ll = b0 - 0xB7
+        n = int.from_bytes(data[1:1 + ll], "big")
+        end = 1 + ll + n
+        if len(data) < end:
+            raise ValueError("short RLP string")
+        return data[1 + ll:end], data[end:]
+    if b0 < 0xF8:  # short list
+        n = b0 - 0xC0
+        if len(data) < 1 + n:
+            raise ValueError("short RLP list")
+        return _decode_list(data[1:1 + n]), data[1 + n:]
+    ll = b0 - 0xF7
+    n = int.from_bytes(data[1:1 + ll], "big")
+    end = 1 + ll + n
+    if len(data) < end:
+        raise ValueError("short RLP list")
+    return _decode_list(data[1 + ll:end]), data[end:]
+
+
+def _decode_list(payload: bytes) -> list:
+    out = []
+    while payload:
+        item, payload = _decode_one(payload)
+        out.append(item)
+    return out

@@ -1,0 +1,89 @@
+"""Keccak-256 (the pre-NIST-padding SHA-3 variant used by Ethereum).
+
+Needed for EIP-712 typed-data hashing of cluster-definition signatures and
+Ethereum addresses (reference uses go-ethereum's crypto.Keccak256 via
+cluster/eip712sigs.go). hashlib's sha3_256 uses the NIST 0x06 padding and is
+NOT compatible, hence this from-scratch keccak-f[1600] sponge (validated
+against the standard test vectors in tests/test_cluster.py)."""
+
+from __future__ import annotations
+
+_ROUND_CONSTANTS = [
+    0x0000000000000001, 0x0000000000008082, 0x800000000000808A, 0x8000000080008000,
+    0x000000000000808B, 0x0000000080000001, 0x8000000080008081, 0x8000000000008009,
+    0x000000000000008A, 0x0000000000000088, 0x0000000080008009, 0x000000008000000A,
+    0x000000008000808B, 0x800000000000008B, 0x8000000000008089, 0x8000000000008003,
+    0x8000000000008002, 0x8000000000000080, 0x000000000000800A, 0x800000008000000A,
+    0x8000000080008081, 0x8000000000008080, 0x0000000080000001, 0x8000000080008008,
+]
+
+_ROTATIONS = [
+    [0, 36, 3, 41, 18],
+    [1, 44, 10, 45, 2],
+    [62, 6, 43, 15, 61],
+    [28, 55, 25, 21, 56],
+    [27, 20, 39, 8, 14],
+]
+
+_MASK = (1 << 64) - 1
+
+
+def _rol(x: int, n: int) -> int:
+    n %= 64
+    return ((x << n) | (x >> (64 - n))) & _MASK
+
+
+def _keccak_f(state: list[int]) -> None:
+    """keccak-f[1600] permutation over a 5x5 lane state (column-major index
+    x + 5*y)."""
+    for rc in _ROUND_CONSTANTS:
+        # theta
+        c = [state[x] ^ state[x + 5] ^ state[x + 10] ^ state[x + 15] ^ state[x + 20]
+             for x in range(5)]
+        d = [c[(x - 1) % 5] ^ _rol(c[(x + 1) % 5], 1) for x in range(5)]
+        for x in range(5):
+            for y in range(5):
+                state[x + 5 * y] ^= d[x]
+        # rho + pi
+        b = [0] * 25
+        for x in range(5):
+            for y in range(5):
+                b[y + 5 * ((2 * x + 3 * y) % 5)] = _rol(state[x + 5 * y], _ROTATIONS[x][y])
+        # chi
+        for x in range(5):
+            for y in range(5):
+                state[x + 5 * y] = b[x + 5 * y] ^ ((~b[(x + 1) % 5 + 5 * y]) & b[(x + 2) % 5 + 5 * y])
+        # iota
+        state[0] ^= rc
+
+
+def keccak256(data: bytes) -> bytes:
+    rate = 136  # 1088-bit rate for 256-bit capacity
+    state = [0] * 25
+    # pad10*1 with Keccak domain bit 0x01 (vs SHA-3's 0x06)
+    padded = bytearray(data)
+    pad_len = rate - (len(padded) % rate)
+    padded += b"\x01" + b"\x00" * (pad_len - 2) + b"\x80" if pad_len >= 2 else b"\x81"
+    for block_off in range(0, len(padded), rate):
+        block = padded[block_off:block_off + rate]
+        for i in range(rate // 8):
+            state[i] ^= int.from_bytes(block[8 * i:8 * i + 8], "little")
+        _keccak_f(state)
+    out = b"".join(state[i].to_bytes(8, "little") for i in range(4))
+    return out
+
+
+def eth_address(uncompressed_pubkey: bytes) -> bytes:
+    """Ethereum address from a 65-byte uncompressed secp256k1 pubkey."""
+    if len(uncompressed_pubkey) != 65 or uncompressed_pubkey[0] != 4:
+        raise ValueError("need 65-byte uncompressed pubkey")
+    return keccak256(uncompressed_pubkey[1:])[12:]
+
+
+def checksum_address(addr: bytes) -> str:
+    """EIP-55 checksummed hex address."""
+    hexaddr = addr.hex()
+    digest = keccak256(hexaddr.encode()).hex()
+    return "0x" + "".join(
+        ch.upper() if int(digest[i], 16) >= 8 else ch
+        for i, ch in enumerate(hexaddr))

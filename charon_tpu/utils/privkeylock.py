@@ -1,0 +1,62 @@
+"""Private-key lock file: prevents two charon processes from running with the
+same identity key (reference app/privkeylock/privkeylock.go): a staleness-
+bounded lock file next to the key, refreshed while the process runs."""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from pathlib import Path
+
+from . import errors
+
+STALE_AFTER = 5.0  # seconds without refresh -> lock considered stale
+
+
+class PrivKeyLock:
+    def __init__(self, path: str | Path, command: str = "run"):
+        self._path = Path(path)
+        self._command = command
+        self._held = False
+
+    def acquire(self) -> "PrivKeyLock":
+        if self._path.exists():
+            try:
+                meta = json.loads(self._path.read_text())
+                age = time.time() - float(meta.get("timestamp", 0))
+            except (ValueError, OSError):
+                age = STALE_AFTER + 1
+            if age < STALE_AFTER:
+                raise errors.new(
+                    "private key locked by another process",
+                    command=meta.get("command"), pid=meta.get("pid"),
+                    file=str(self._path))
+        self._write()
+        self._held = True
+        return self
+
+    def refresh(self) -> None:
+        if self._held:
+            self._write()
+
+    def release(self) -> None:
+        if self._held:
+            self._held = False
+            try:
+                self._path.unlink()
+            except OSError:
+                pass
+
+    def _write(self) -> None:
+        self._path.write_text(json.dumps({
+            "command": self._command,
+            "pid": os.getpid(),
+            "timestamp": time.time(),
+        }))
+
+    def __enter__(self) -> "PrivKeyLock":
+        return self.acquire()
+
+    def __exit__(self, *exc) -> None:
+        self.release()

@@ -1,0 +1,279 @@
+"""Cluster config & durable identity tests: keccak/EIP-712 vectors, ENR
+round-trips, EIP-2335 keystores, definition/lock hashing + signatures,
+manifest mutations, create-cluster -> restart -> combine end-to-end."""
+
+import json
+
+import pytest
+
+from charon_tpu import tbls
+from charon_tpu.cluster import (
+    Definition,
+    Operator,
+    combine,
+    create_cluster,
+    keyshares_from_lock,
+    load_node,
+    manifest,
+)
+from charon_tpu.cluster import eip712, lock as lock_mod
+from charon_tpu.eth2 import deposit, enr, keystore, rlp
+from charon_tpu.utils import k1util
+from charon_tpu.utils.keccak import checksum_address, eth_address, keccak256
+
+
+class TestKeccak:
+    def test_standard_vectors(self):
+        assert keccak256(b"").hex() == (
+            "c5d2460186f7233c927e7db2dcc703c0e500b653ca82273b7bfad8045d85a470")
+        assert keccak256(b"abc").hex() == (
+            "4e03657aea45a94fc7d47ba826c8d667c0d1e6e33a64a036ec44f58fa12d6c45")
+        # multi-block sponge path (> 136-byte rate): the published Keccak-256
+        # long-message vector for one million 'a' bytes
+        assert keccak256(b"a" * 1_000_000).hex() == (
+            "fadae6b49f129bbb812be8407b7b2894f34aecf6dbd1f9b0f0c7e9853098fc96")
+
+    def test_eth_address_vector(self):
+        pub = k1util.uncompressed(k1util.public_key((1).to_bytes(32, "big")))
+        assert checksum_address(eth_address(pub)) == (
+            "0x7E5F4552091A69125d5DfCb7b8C2659029395Bdf")
+
+
+class TestRLPAndENR:
+    def test_rlp_roundtrip(self):
+        cases = [b"", b"\x01", b"dog", b"a" * 100, [b"cat", [b"dog", b""]], []]
+        for c in cases:
+            assert rlp.decode(rlp.encode(c)) == c
+
+    def test_enr_roundtrip_and_verify(self):
+        key = k1util.generate_private_key()
+        record = enr.new(key, seq=3, tcp=(3610).to_bytes(2, "big"))
+        text = record.encode()
+        assert text.startswith("enr:")
+        parsed = enr.parse(text)
+        assert parsed.pubkey == k1util.public_key(key)
+        assert parsed.seq == 3
+        assert parsed.kvs[b"tcp"] == (3610).to_bytes(2, "big")
+
+    def test_enr_tamper_detected(self):
+        key = k1util.generate_private_key()
+        record = enr.new(key)
+        record.kvs[b"tcp"] = b"\xde\xad"  # mutate after signing
+        with pytest.raises(enr.ENRError):
+            enr.parse(record.encode())
+
+
+class TestKeystore:
+    def test_encrypt_decrypt_roundtrip(self):
+        secret = tbls.generate_secret_key()
+        store = keystore.encrypt(secret, "hunter2", insecure=True)
+        assert store["version"] == 4
+        assert keystore.decrypt(store, "hunter2") == secret
+        from charon_tpu.utils.errors import CharonError
+
+        with pytest.raises(CharonError):
+            keystore.decrypt(store, "wrong-password")
+
+    def test_store_load_dir(self, tmp_path):
+        secrets = [tbls.generate_secret_key() for _ in range(3)]
+        keystore.store_keys(secrets, tmp_path, insecure=True)
+        assert keystore.load_keys(tmp_path) == secrets
+
+
+class TestEIP712:
+    def test_sign_verify_roundtrip(self):
+        key = k1util.generate_private_key()
+        pub = k1util.public_key(key)
+        ch = keccak256(b"config")
+        sig = eip712.sign_operator(key, 1, "enr:xyz", ch)
+        assert eip712.verify_operator(pub, 1, "enr:xyz", ch, sig)
+        assert not eip712.verify_operator(pub, 1, "enr:other", ch, sig)
+        assert not eip712.verify_operator(pub, 5, "enr:xyz", ch, sig)  # chain id bound
+
+
+class TestDefinitionLock:
+    def _definition(self, n=4):
+        keys = [k1util.generate_private_key() for _ in range(n)]
+        d = Definition(name="test", num_validators=2, threshold=3,
+                       operators=[Operator(enr=enr.new(k).encode()) for k in keys])
+        for i, k in enumerate(keys):
+            d = d.sign_operator(i, k)
+        return d, keys
+
+    def test_definition_hashes_stable_and_signed(self):
+        d, _ = self._definition()
+        d.verify_signatures()
+        blob = d.to_json()
+        d2 = Definition.from_json(json.loads(json.dumps(blob)))
+        assert d2.config_hash() == d.config_hash()
+        assert d2.definition_hash() == d.definition_hash()
+        d2.verify_signatures()
+
+    def test_signature_tamper_detected(self):
+        d, _ = self._definition()
+        d.operators[0].enr_signature = bytes(65)
+        from charon_tpu.utils.errors import CharonError
+
+        with pytest.raises(CharonError):
+            d.verify_signatures()
+
+    def test_config_hash_changes_with_config(self):
+        d, _ = self._definition()
+        import dataclasses
+
+        d2 = dataclasses.replace(d, threshold=2)
+        assert d.config_hash() != d2.config_hash()
+
+
+class TestClusterLifecycle:
+    def test_create_reload_restart_combine(self, tmp_path):
+        lock = create_cluster("lifecycle", num_validators=2, num_nodes=4,
+                              threshold=3, out_dir=tmp_path)
+        # full verification incl. BLS aggregate + node signatures
+        lock.verify()
+
+        # reload from disk and restart node 2 into the cluster
+        identity, lock2, keys = load_node(tmp_path / "node2")
+        assert lock2.lock_hash() == lock.lock_hash()
+        assert keys.my_share_idx == 3
+        assert keys.threshold == 3
+        # the decrypted share secrets match the lock share pubkeys
+        for root, secret in keys.my_share_secrets.items():
+            assert bytes(tbls.secret_to_public_key(secret)) == bytes(
+                keys.share_pubkey(root, keys.my_share_idx))
+
+        # deposit data verifies
+        for dv in lock.validators:
+            dd = deposit.DepositData(
+                dv.public_key,
+                deposit.withdrawal_credentials_from_address(b"\x11" * 20),
+                deposit.DEFAULT_AMOUNT_GWEI, dv.deposit_signature)
+            assert deposit.verify_deposit(dd, lock.definition.fork_version)
+
+        # combine any threshold of share dirs back into the root keys
+        recovered = combine(
+            lock, [tmp_path / "node0", tmp_path / "node1", tmp_path / "node3"],
+            tmp_path / "recovered", insecure=True)
+        for secret, dv in zip(recovered, lock.validators):
+            assert bytes(tbls.secret_to_public_key(secret)) == dv.public_key
+
+    def test_lock_tamper_detected(self, tmp_path):
+        create_cluster("tamper", num_validators=1, num_nodes=3, threshold=2,
+                       out_dir=tmp_path)
+        blob = json.loads((tmp_path / "node0" / "cluster-lock.json").read_text())
+        blob["distributed_validators"][0]["public_shares"][0] = "0x" + "11" * 48
+        from charon_tpu.utils.errors import CharonError
+
+        with pytest.raises(CharonError):
+            lock_mod.Lock.from_json(blob)
+
+    def test_combine_refuses_below_threshold(self, tmp_path):
+        lock = create_cluster("thin", num_validators=1, num_nodes=4,
+                              threshold=3, out_dir=tmp_path)
+        from charon_tpu.utils.errors import CharonError
+
+        with pytest.raises(CharonError):
+            combine(lock, [tmp_path / "node0", tmp_path / "node1"],
+                    tmp_path / "out", insecure=True)
+
+
+class TestManifest:
+    def test_mutation_log_materialise(self, tmp_path):
+        lock = create_cluster("manifest", num_validators=1, num_nodes=3,
+                              threshold=2, out_dir=tmp_path)
+        identity_keys = [bytes.fromhex((tmp_path / f"node{i}" /
+                                        "charon-enr-private-key").read_text())
+                         for i in range(3)]
+        log = manifest.new_log_from_lock(lock)
+        # add a validator approved by all operators
+        secret = tbls.generate_secret_key()
+        shares = tbls.threshold_split(secret, 3, 2)
+        new_dv = lock_mod.DistValidator(
+            public_key=bytes(tbls.secret_to_public_key(secret)),
+            public_shares=[bytes(tbls.secret_to_public_key(shares[i + 1]))
+                           for i in range(3)])
+        log = manifest.add_validators(log, [new_dv], identity_keys)
+        manifest.save(log, tmp_path / "cluster-manifest.json")
+
+        loaded = manifest.load(tmp_path / "cluster-manifest.json")
+        cluster = manifest.materialise(loaded)
+        assert len(cluster.validators) == 2
+        assert cluster.validators[-1].public_key == new_dv.public_key
+
+    def test_stripped_lock_signatures_rejected(self, tmp_path):
+        """Deleting the aggregate/node signatures must FAIL verification —
+        a forged lock cannot bypass checks by omitting them."""
+        lock = create_cluster("strip", num_validators=1, num_nodes=3,
+                              threshold=2, out_dir=tmp_path)
+        blob = lock.to_json()
+        blob["signature_aggregate"] = "0x"
+        blob["node_signatures"] = []
+        stripped = lock_mod.Lock.from_json(blob)
+        from charon_tpu.utils.errors import CharonError
+
+        with pytest.raises(CharonError):
+            stripped.verify()
+
+    def test_manifest_added_validator_survives_restart(self, tmp_path):
+        """A validator added via the manifest must be served after load_node."""
+        lock = create_cluster("grow", num_validators=1, num_nodes=3,
+                              threshold=2, out_dir=tmp_path)
+        identity_keys = [bytes.fromhex((tmp_path / f"node{i}" /
+                                        "charon-enr-private-key").read_text())
+                         for i in range(3)]
+        secret = tbls.generate_secret_key()
+        shares = tbls.threshold_split(secret, 3, 2)
+        new_dv = lock_mod.DistValidator(
+            public_key=bytes(tbls.secret_to_public_key(secret)),
+            public_shares=[bytes(tbls.secret_to_public_key(shares[i + 1]))
+                           for i in range(3)])
+        log = manifest.add_validators(manifest.new_log_from_lock(lock),
+                                      [new_dv], identity_keys)
+        import json as json_mod
+
+        node_dir = tmp_path / "node1"
+        manifest.save(log, node_dir / "cluster-manifest.json")
+        # append the new share keystore after the existing ones
+        store = keystore.encrypt(shares[2], "pw", insecure=True)
+        (node_dir / "validator_keys" / "keystore-1.json").write_text(
+            json_mod.dumps(store))
+        (node_dir / "validator_keys" / "keystore-1.txt").write_text("pw")
+
+        _, _, keys = load_node(node_dir)
+        assert len(keys.root_pubkeys) == 2
+        from charon_tpu.core.types import pubkey_from_bytes
+
+        root = pubkey_from_bytes(new_dv.public_key)
+        assert keys.my_share_secrets[root] == shares[2]
+
+    def test_manifest_rejects_missing_approvals(self, tmp_path):
+        lock = create_cluster("approvals", num_validators=1, num_nodes=3,
+                              threshold=2, out_dir=tmp_path)
+        identity_keys = [bytes.fromhex((tmp_path / f"node{i}" /
+                                        "charon-enr-private-key").read_text())
+                         for i in range(3)]
+        log = manifest.new_log_from_lock(lock)
+        secret = tbls.generate_secret_key()
+        shares = tbls.threshold_split(secret, 3, 2)
+        new_dv = lock_mod.DistValidator(
+            public_key=bytes(tbls.secret_to_public_key(secret)),
+            public_shares=[bytes(tbls.secret_to_public_key(shares[i + 1]))
+                           for i in range(3)])
+        log = manifest.add_validators(log, [new_dv], identity_keys[:2])  # one short
+        from charon_tpu.utils.errors import CharonError
+
+        with pytest.raises(CharonError):
+            manifest.materialise(log)
+
+
+class TestPrivKeyLock:
+    def test_exclusive_and_stale(self, tmp_path):
+        from charon_tpu.utils.privkeylock import PrivKeyLock
+        from charon_tpu.utils.errors import CharonError
+
+        path = tmp_path / "charon-enr-private-key.lock"
+        lk = PrivKeyLock(path).acquire()
+        with pytest.raises(CharonError):
+            PrivKeyLock(path).acquire()
+        lk.release()
+        PrivKeyLock(path).acquire().release()  # released -> acquirable
