@@ -1,0 +1,11 @@
+"""dkg — distributed key generation ceremony (reference dkg/).
+
+FROST (Pedersen VSS) or keycast (trusted dealer) keygen over the real p2p
+fabric, step-fenced by the sync protocol, producing the cluster lock,
+EIP-2335 keystores, and deposit data."""
+
+from .bcast import SignedBroadcast
+from .dkg import Config, run_dkg
+from .sync import SyncProtocol
+
+__all__ = ["Config", "SignedBroadcast", "SyncProtocol", "run_dkg"]

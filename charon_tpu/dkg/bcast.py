@@ -1,0 +1,80 @@
+"""Signed reliable broadcast for DKG messages (reference dkg/bcast/
+{client,server,impl}.go, protocol /charon/dkg/bcast/1.0.0): the sender
+k1-signs every message; receivers verify against the cluster identity before
+accepting. Messages are collected per topic for the ceremony phases."""
+
+from __future__ import annotations
+
+import asyncio
+import hashlib
+import json
+from collections import defaultdict
+
+from ..p2p.node import TCPNode
+from ..utils import errors, k1util, log
+
+_log = log.with_topic("dkg-bcast")
+
+PROTOCOL = "/charon/dkg/bcast/1.0.0"
+
+
+def _digest(topic: str, payload: bytes) -> bytes:
+    return hashlib.sha256(b"charon-tpu/dkg-bcast" + topic.encode() + b"\x00" + payload).digest()
+
+
+class SignedBroadcast:
+    def __init__(self, node: TCPNode, privkey: bytes, peer_pubkeys: dict[int, bytes],
+                 own_idx: int):
+        self._node = node
+        self._privkey = privkey
+        self._peer_pubkeys = peer_pubkeys
+        self._own_idx = own_idx
+        # topic -> sender idx -> payload
+        self._received: dict[str, dict[int, bytes]] = defaultdict(dict)
+        self._events: dict[str, asyncio.Event] = defaultdict(asyncio.Event)
+        node.register_handler(PROTOCOL, self._handle)
+
+    async def _handle(self, sender_idx: int, raw: bytes) -> None:
+        msg = json.loads(raw.decode())
+        topic, payload = msg["topic"], bytes.fromhex(msg["payload"])
+        claimed = int(msg["sender"])
+        sig = bytes.fromhex(msg["sig"])
+        pub = self._peer_pubkeys.get(claimed)
+        if pub is None or not k1util.verify(pub, _digest(topic, payload), sig):
+            raise errors.new("invalid dkg broadcast signature", sender=claimed)
+        if claimed != sender_idx and sender_idx >= 0:
+            raise errors.new("dkg broadcast sender mismatch",
+                             claimed=claimed, transport=sender_idx)
+        existing = self._received[topic].get(claimed)
+        if existing is not None:
+            if existing != payload:
+                raise errors.new("dkg broadcast equivocation detected",
+                                 topic=topic, sender=claimed)
+            return None  # idempotent re-delivery
+        self._received[topic][claimed] = payload
+        self._events[topic].set()
+        self._events[topic] = asyncio.Event()
+        return None
+
+    def broadcast(self, topic: str, payload: bytes) -> None:
+        """Sign + send to all peers, and record our own contribution."""
+        sig = k1util.sign(self._privkey, _digest(topic, payload))
+        msg = json.dumps({"topic": topic, "payload": payload.hex(),
+                          "sender": self._own_idx, "sig": sig.hex()}).encode()
+        self._received[topic][self._own_idx] = payload
+        self._node.broadcast(PROTOCOL, msg)
+
+    async def gather(self, topic: str, count: int, timeout: float = 120.0) -> dict[int, bytes]:
+        """Await `count` distinct senders' messages on a topic."""
+        deadline = asyncio.get_running_loop().time() + timeout
+        while len(self._received[topic]) < count:
+            remaining = deadline - asyncio.get_running_loop().time()
+            if remaining <= 0:
+                raise errors.new("dkg broadcast gather timeout", topic=topic,
+                                 got=len(self._received[topic]), want=count)
+            event = self._events[topic]
+            try:
+                await asyncio.wait_for(event.wait(), min(remaining, 1.0))
+            except asyncio.TimeoutError:
+                continue
+        return dict(self._received[topic])

@@ -1,0 +1,327 @@
+"""DKG ceremony orchestrator (reference dkg/dkg.go:79-332 Run).
+
+Step-fenced by the sync protocol (each numbered step is a barrier):
+
+  1. connect-all + definition-hash agreement          (dkg/sync)
+  2. keygen: FROST (default) or keycast               (frost.py / keycast.py)
+  3. threshold-sign deposit data per DV               (signAndAggDepositData)
+  4. threshold-sign the lock hash (share keys)        (aggLockHashSig)
+  5. exchange k1 node signatures over the lock hash   (nodeSigCaster)
+  6. write artifacts: cluster-lock.json, EIP-2335 keystores, deposit-data
+
+The ceremony rides the real p2p fabric (authenticated-encrypted TCP
+channels); FROST round-1 commitments/PoKs go over the signed broadcast,
+secret shares over direct channels (protocol /charon/dkg/frost/2.0.0)."""
+
+from __future__ import annotations
+
+import asyncio
+import json
+from dataclasses import dataclass, field as dc_field
+from pathlib import Path
+
+from .. import tbls
+from ..cluster import Lock
+from ..cluster.definition import Definition
+from ..cluster.lock import DistValidator
+from ..eth2 import deposit as deposit_mod
+from ..eth2 import enr as enr_mod
+from ..eth2 import keystore
+from ..p2p.node import PeerSpec, TCPNode
+from ..utils import errors, k1util, log
+from . import frost as frost_mod
+from . import keycast as keycast_mod
+from .bcast import SignedBroadcast
+from .sync import SyncProtocol
+
+_log = log.with_topic("dkg")
+
+PROTO_FROST = "/charon/dkg/frost/2.0.0"
+
+STEP_CONNECTED = 1
+STEP_KEYGEN = 2
+STEP_DEPOSIT = 3
+STEP_LOCK_SIG = 4
+STEP_NODE_SIG = 5
+
+
+@dataclass
+class Config:
+    definition: Definition
+    identity_key: bytes
+    node_index: int                   # 0-based operator index
+    peers: list[PeerSpec]             # all operators incl. self (shared specs)
+    data_dir: str | Path
+    insecure_keystores: bool = False
+    timeout: float = 180.0
+
+
+@dataclass
+class _FrostShares:
+    """Inbound direct shares: validator -> sender participant -> share."""
+
+    shares: dict[int, dict[int, int]] = dc_field(default_factory=dict)
+    event: asyncio.Event = dc_field(default_factory=asyncio.Event)
+
+    def add(self, validator: int, sender: int, share: int) -> None:
+        self.shares.setdefault(validator, {})[sender] = share
+        self.event.set()
+        self.event = asyncio.Event()
+
+    async def await_count(self, num_validators: int, count: int, timeout: float) -> None:
+        deadline = asyncio.get_running_loop().time() + timeout
+        while True:
+            if all(len(self.shares.get(v, {})) >= count for v in range(num_validators)):
+                return
+            remaining = deadline - asyncio.get_running_loop().time()
+            if remaining <= 0:
+                raise errors.new("timeout awaiting frost shares")
+            try:
+                await asyncio.wait_for(self.event.wait(), min(remaining, 1.0))
+            except asyncio.TimeoutError:
+                continue
+
+
+async def run_dkg(config: Config) -> Lock:
+    """Run the ceremony; returns the lock (also written to data_dir)."""
+    definition = config.definition
+    definition.verify_signatures()
+    num_nodes = len(definition.operators)
+    num_validators = definition.num_validators
+    threshold = definition.threshold
+    my_idx = config.node_index  # 0-based; share indices are 1-based
+    def_hash = definition.definition_hash()
+
+    peer_pubkeys = {i: enr_mod.parse(op.enr).pubkey
+                    for i, op in enumerate(definition.operators)}
+    if peer_pubkeys[my_idx] != k1util.public_key(config.identity_key):
+        raise errors.new("identity key does not match operator ENR", index=my_idx)
+
+    node = TCPNode(config.identity_key, my_idx, config.peers,
+                   own_spec=config.peers[my_idx])
+    sync = SyncProtocol(node, def_hash, config.identity_key, peer_pubkeys)
+    bcast = SignedBroadcast(node, config.identity_key, peer_pubkeys, my_idx)
+    frost_inbox = _FrostShares()
+
+    async def on_frost(sender_idx: int, payload: bytes) -> None:
+        msg = json.loads(payload.decode())
+        for v_str, share in msg["shares"].items():
+            frost_inbox.add(int(v_str), sender_idx + 1, int(share))
+        return None
+
+    node.register_handler(PROTO_FROST, on_frost)
+    # keycast receivers must be registered before the connect barrier: the
+    # dealer starts dealing the moment the barrier releases
+    keycast_receiver = None
+    if definition.dkg_algorithm == "keycast" and my_idx != 0:
+        keycast_receiver = keycast_mod.Receiver(node)
+    await node.start()
+
+    try:
+        # step 1: everyone connected, same definition
+        await sync.await_all_connected(timeout=config.timeout)
+        await sync.await_all_at_step(STEP_CONNECTED, timeout=config.timeout)
+
+        # step 2: keygen
+        if definition.dkg_algorithm == "keycast":
+            records, share_secrets = await _run_keycast(
+                node, keycast_receiver, my_idx, num_nodes, num_validators,
+                threshold, config)
+            share_pubkeys_all = [
+                [bytes.fromhex(pk) for pk in rec["share_pubkeys"]]
+                for rec in records]
+            group_pubkeys = [bytes.fromhex(rec["pubkey"]) for rec in records]
+        else:  # frost (default)
+            group_pubkeys, share_pubkeys_all, share_secrets = await _run_frost(
+                node, bcast, frost_inbox, my_idx, num_nodes, num_validators,
+                threshold, def_hash, config.timeout)
+        await sync.await_all_at_step(STEP_KEYGEN, timeout=config.timeout)
+
+        # step 3: deposit data (threshold-signed per DV)
+        withdrawal = _withdrawal_address20(definition)
+        deposit_sigs = await _threshold_sign_all(
+            bcast, "deposit", my_idx, threshold, share_secrets,
+            [deposit_mod.signing_root(
+                deposit_mod.new_message(tbls.PublicKey(gpk), withdrawal),
+                definition.fork_version)
+             for gpk in group_pubkeys],
+            [tbls.PublicKey(g) for g in group_pubkeys], config.timeout)
+        await sync.await_all_at_step(STEP_DEPOSIT, timeout=config.timeout)
+
+        # build the validators + lock
+        validators = []
+        for v in range(num_validators):
+            msg = deposit_mod.new_message(tbls.PublicKey(group_pubkeys[v]), withdrawal)
+            dep = deposit_mod.DepositData(group_pubkeys[v], msg.withdrawal_credentials,
+                                          msg.amount, bytes(deposit_sigs[v]))
+            validators.append(DistValidator(
+                public_key=group_pubkeys[v],
+                public_shares=[bytes(pk) for pk in share_pubkeys_all[v]],
+                deposit_data_root=deposit_mod.data_root(dep),
+                deposit_signature=bytes(deposit_sigs[v]),
+            ))
+        lock = Lock(definition=definition, validators=validators)
+        lock_hash = lock.lock_hash()
+
+        # step 4: every share key signs the lock hash; aggregate all
+        my_lock_sigs = [bytes(tbls.sign(s, lock_hash)) for s in share_secrets]
+        bcast.broadcast("lock-sigs", json.dumps(
+            [s.hex() for s in my_lock_sigs]).encode())
+        all_lock = await bcast.gather("lock-sigs", num_nodes, config.timeout)
+        share_sigs = []
+        for sender in sorted(all_lock):
+            sigs = [bytes.fromhex(s) for s in json.loads(all_lock[sender].decode())]
+            if len(sigs) != num_validators:
+                raise errors.new("lock sig count mismatch", sender=sender)
+            for v, sig in enumerate(sigs):
+                share_pk = tbls.PublicKey(share_pubkeys_all[v][sender])
+                if not tbls.verify(share_pk, lock_hash, tbls.Signature(sig)):
+                    raise errors.new("invalid lock-hash share signature",
+                                     sender=sender, validator=v)
+            share_sigs.extend(sigs)
+        lock.aggregate_share_signatures([tbls.Signature(s) for s in share_sigs])
+        await sync.await_all_at_step(STEP_LOCK_SIG, timeout=config.timeout)
+
+        # step 5: k1 node signatures over the lock hash
+        bcast.broadcast("node-sig", k1util.sign(config.identity_key, lock_hash))
+        node_sigs = await bcast.gather("node-sig", num_nodes, config.timeout)
+        lock.node_signatures = [node_sigs[i] for i in range(num_nodes)]
+        for i, sig in enumerate(lock.node_signatures):
+            if not k1util.verify(peer_pubkeys[i], lock_hash, sig):
+                raise errors.new("invalid node signature", index=i)
+        await sync.await_all_at_step(STEP_NODE_SIG, timeout=config.timeout)
+
+        lock.verify()
+
+        # step 6: write artifacts
+        data_dir = Path(config.data_dir)
+        data_dir.mkdir(parents=True, exist_ok=True)
+        from ..cluster.lock import save as save_lock
+
+        save_lock(lock, str(data_dir / "cluster-lock.json"))
+        keystore.store_keys(share_secrets, data_dir / "validator_keys",
+                            insecure=config.insecure_keystores)
+        key_path = data_dir / "charon-enr-private-key"
+        key_path.write_text(config.identity_key.hex())
+        key_path.chmod(0o600)
+        deposits = [{
+            "pubkey": v.public_key.hex(),
+            "withdrawal_credentials": deposit_mod.withdrawal_credentials_from_address(
+                withdrawal).hex(),
+            "amount": str(deposit_mod.DEFAULT_AMOUNT_GWEI),
+            "signature": v.deposit_signature.hex(),
+            "deposit_data_root": v.deposit_data_root.hex(),
+            "fork_version": definition.fork_version.hex(),
+        } for v in validators]
+        (data_dir / "deposit-data.json").write_text(json.dumps(deposits, indent=2))
+        _log.info("dkg ceremony complete", validators=num_validators,
+                  lock_hash=lock_hash.hex()[:16])
+        return lock
+    finally:
+        await node.stop()
+
+
+async def _run_frost(node: TCPNode, bcast: SignedBroadcast, inbox: _FrostShares,
+                     my_idx: int, num_nodes: int, num_validators: int,
+                     threshold: int, def_hash: bytes, timeout: float):
+    """All validators' keygens in parallel (reference runFrostParallel
+    dkg/frost.go:50)."""
+    my_part = my_idx + 1  # 1-based participant index
+    participants = []
+    round1_bcasts = []
+    outgoing: dict[int, dict[int, int]] = {j: {} for j in range(1, num_nodes + 1)}
+    for v in range(num_validators):
+        ctx = def_hash + v.to_bytes(4, "big")
+        p = frost_mod.Participant(my_part, threshold, num_nodes, ctx)
+        b, shares = p.round1()
+        participants.append(p)
+        round1_bcasts.append(b)
+        for j, share in shares.items():
+            outgoing[j][v] = share
+    # broadcast commitments+PoK for all validators at once
+    bcast.broadcast("frost-r1", json.dumps(
+        [b.to_json() for b in round1_bcasts]).encode())
+    # direct shares to each peer (own shares straight into the inbox)
+    for v, share in outgoing[my_part].items():
+        inbox.add(v, my_part, share)
+    for j in range(1, num_nodes + 1):
+        if j == my_part:
+            continue
+        node.send_async(j - 1, PROTO_FROST, json.dumps(
+            {"shares": {str(v): str(s) for v, s in outgoing[j].items()}}).encode())
+
+    r1_all = await bcast.gather("frost-r1", num_nodes, timeout)
+    await inbox.await_count(num_validators, num_nodes, timeout)
+
+    # verify + finalize per validator
+    group_pubkeys, share_pubkeys_all, share_secrets = [], [], []
+    bcasts_by_sender = {
+        sender + 1: [frost_mod.Round1Broadcast.from_json(o)
+                     for o in json.loads(payload.decode())]
+        for sender, payload in r1_all.items()}
+    for v in range(num_validators):
+        ctx = def_hash + v.to_bytes(4, "big")
+        broadcasts = {}
+        for part, blist in bcasts_by_sender.items():
+            b = blist[v]
+            if b.participant != part:
+                raise errors.new("frost broadcast index mismatch", sender=part)
+            frost_mod.verify_round1(b, threshold, ctx)
+            broadcasts[part] = b
+        my_shares = inbox.shares[v]
+        for sender, share in my_shares.items():
+            frost_mod.verify_share(my_part, share, broadcasts[sender].commitments)
+        result = frost_mod.finalize(my_part, num_nodes, broadcasts, my_shares)
+        group_pubkeys.append(bytes(result.group_pubkey))
+        share_pubkeys_all.append([bytes(result.share_pubkeys[j])
+                                  for j in range(1, num_nodes + 1)])
+        share_secrets.append(result.share_secret)
+    return group_pubkeys, share_pubkeys_all, share_secrets
+
+
+async def _run_keycast(node: TCPNode, receiver, my_idx: int, num_nodes: int,
+                       num_validators: int, threshold: int, config: Config):
+    if my_idx == 0:
+        records, share_secrets = await keycast_mod.deal(
+            node, num_validators, num_nodes, threshold)
+        return records, share_secrets
+    return await receiver.receive(timeout=config.timeout)
+
+
+async def _threshold_sign_all(bcast: SignedBroadcast, topic: str, my_idx: int,
+                              threshold: int, share_secrets: list[tbls.PrivateKey],
+                              roots: list[bytes], group_pubkeys: list[tbls.PublicKey],
+                              timeout: float) -> list[tbls.Signature]:
+    """Each node partial-signs every root with its share key, broadcasts, and
+    Lagrange-combines a threshold per DV (reference signAndAggDepositData
+    dkg.go:602-806 via the in-memory exchanger)."""
+    my_sigs = [bytes(tbls.sign(s, root))
+               for s, root in zip(share_secrets, roots)]
+    bcast.broadcast(topic, json.dumps([s.hex() for s in my_sigs]).encode())
+    num_nodes = len(bcast._peer_pubkeys)
+    all_sigs = await bcast.gather(topic, num_nodes, timeout)
+    parsed: dict[int, list[str]] = {}
+    for sender in sorted(all_sigs):
+        sigs = json.loads(all_sigs[sender].decode())
+        if len(sigs) != len(roots):
+            raise errors.new("partial sig count mismatch", sender=sender)
+        parsed[sender] = sigs
+    out: list[tbls.Signature] = []
+    for v, (root, gpk) in enumerate(zip(roots, group_pubkeys)):
+        partials: dict[int, tbls.Signature] = {
+            sender + 1: tbls.Signature(bytes.fromhex(sigs[v]))
+            for sender, sigs in parsed.items()}
+        chosen = {i: partials[i] for i in sorted(partials)[:threshold]}
+        agg = tbls.threshold_aggregate(chosen)
+        if not tbls.verify(gpk, root, agg):
+            raise errors.new("aggregated ceremony signature invalid", index=v,
+                             topic=topic)
+        out.append(agg)
+    return out
+
+
+def _withdrawal_address20(definition: Definition) -> bytes:
+    addr = definition.withdrawal_address
+    if addr.startswith("0x") and len(addr) == 42:
+        return bytes.fromhex(addr[2:])
+    return b"\x11" * 20  # test default (matches create_cluster)

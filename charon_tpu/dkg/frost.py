@@ -1,0 +1,205 @@
+"""FROST distributed key generation — Pedersen-style 2-round VSS keygen
+(reference dkg/frost.go:50-210 via coinbase/kryptology's DkgParticipant,
+itself the keygen of the FROST paper).
+
+Run for all validators in parallel (reference runFrostParallel). Math over
+BLS12-381: secret shares in Fr, commitments in G1 (so the group public key
+is a standard BLS pubkey). Round structure:
+
+  Round 1 (broadcast): each participant i samples a degree-(t-1) secret
+    polynomial f_i; broadcasts commitments C_i = [a_i0*G .. a_i(t-1)*G] and a
+    Schnorr proof of knowledge of a_i0 bound to a session context string.
+  Round 1 (direct): sends the evaluation f_i(j) to each participant j over
+    the authenticated-encrypted p2p channel.
+  Round 2: each j verifies every proof and checks its share against the
+    commitments  f_i(j)*G == sum_k C_ik * j^k,  then aggregates
+    x_j = sum_i f_i(j). Group pubkey = sum_i C_i0; share pubkeys are
+    evaluated from the summed commitment polynomial.
+
+The heavy commitment checks run through the native G1 lincomb
+(native/bls12381.cpp ct_g1_lincomb) — the BASELINE.json dkg config's batched
+verification hot spot.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import secrets as _secrets
+from dataclasses import dataclass, field
+
+from .. import tbls
+from ..crypto import fields as F
+from ..tbls.native_impl import NativeUnavailable, load_library
+from ..utils import errors
+
+try:
+    _LIB = load_library()
+except NativeUnavailable:  # pragma: no cover - toolchain missing
+    _LIB = None
+
+
+def _g1_mul_gen(scalar: int) -> bytes:
+    """scalar*G1 compressed (scalar 1..r-1)."""
+    return bytes(tbls.secret_to_public_key(
+        tbls.PrivateKey((scalar % F.R).to_bytes(32, "big"))))
+
+
+def _g1_lincomb(points: list[bytes], scalars: list[int]) -> bytes:
+    if len(points) != len(scalars):
+        raise errors.new("lincomb length mismatch",
+                         points=len(points), scalars=len(scalars))
+    if _LIB is not None:
+        out = (ctypes.c_uint8 * 48)()
+        rc = _LIB.ct_g1_lincomb(b"".join(points),
+                                b"".join((s % F.R).to_bytes(32, "big") for s in scalars),
+                                len(points), out)
+        if rc != 0:
+            raise errors.new("invalid commitment point encoding")
+        return bytes(out)
+    # pure-Python fallback
+    from ..crypto.curve import FqOps, jac_add, jac_infinity, jac_mul
+    from ..crypto.serialize import g1_from_bytes, g1_to_bytes
+
+    acc = jac_infinity(FqOps)
+    for p, s in zip(points, scalars):
+        acc = jac_add(FqOps, acc, jac_mul(FqOps, g1_from_bytes(p, subgroup_check=False), s % F.R))
+    return g1_to_bytes(acc)
+
+
+def _g1_add(a: bytes, b: bytes) -> bytes:
+    return _g1_lincomb([a, b], [1, 1])
+
+
+# -- Schnorr proof of knowledge of the polynomial constant term ----------------
+
+def _pok_challenge(participant: int, context: bytes, a0_commit: bytes, r_commit: bytes) -> int:
+    h = hashlib.sha256(b"charon-tpu/frost-pok" + participant.to_bytes(4, "big")
+                       + context + a0_commit + r_commit).digest()
+    return int.from_bytes(h, "big") % F.R
+
+
+@dataclass
+class Round1Broadcast:
+    participant: int              # 1-based index
+    commitments: list[bytes]      # t G1 points
+    pok_r: bytes                  # Schnorr commitment R = k*G
+    pok_mu: int                   # k + a0*challenge mod r
+
+    def to_json(self) -> dict:
+        return {"participant": self.participant,
+                "commitments": [c.hex() for c in self.commitments],
+                "pok_r": self.pok_r.hex(), "pok_mu": str(self.pok_mu)}
+
+    @staticmethod
+    def from_json(o: dict) -> "Round1Broadcast":
+        return Round1Broadcast(int(o["participant"]),
+                               [bytes.fromhex(c) for c in o["commitments"]],
+                               bytes.fromhex(o["pok_r"]), int(o["pok_mu"]))
+
+
+@dataclass
+class Participant:
+    """One participant's state for ONE validator's keygen
+    (reference kryptology DkgParticipant)."""
+
+    index: int                    # 1-based
+    threshold: int
+    total: int
+    context: bytes                # session binding (cluster def hash etc.)
+    _coeffs: list[int] = field(default_factory=list)
+
+    def round1(self) -> tuple[Round1Broadcast, dict[int, int]]:
+        """Returns (broadcast, {participant_j -> share f_i(j)})."""
+        self._coeffs = [self._rand_scalar() for _ in range(self.threshold)]
+        commitments = [_g1_mul_gen(a) for a in self._coeffs]
+        k = self._rand_scalar()
+        r_commit = _g1_mul_gen(k)
+        c = _pok_challenge(self.index, self.context, commitments[0], r_commit)
+        mu = (k + self._coeffs[0] * c) % F.R
+        shares = {j: self._eval(j) for j in range(1, self.total + 1)}
+        return Round1Broadcast(self.index, commitments, r_commit, mu), shares
+
+    def _eval(self, x: int) -> int:
+        acc = 0
+        for a in reversed(self._coeffs):
+            acc = (acc * x + a) % F.R
+        return acc
+
+    @staticmethod
+    def _rand_scalar() -> int:
+        while True:
+            s = _secrets.randbelow(F.R)
+            if s:
+                return s
+
+
+def verify_round1(bcast: Round1Broadcast, threshold: int, context: bytes) -> None:
+    """Verify the Schnorr PoK: mu*G == R + challenge*C0
+    (reference frost round1 verification inside kryptology)."""
+    if len(bcast.commitments) != threshold:
+        raise errors.new("wrong commitment count", participant=bcast.participant)
+    c = _pok_challenge(bcast.participant, context, bcast.commitments[0], bcast.pok_r)
+    lhs = _g1_mul_gen(bcast.pok_mu)
+    rhs = _g1_lincomb([bcast.pok_r, bcast.commitments[0]], [1, c])
+    if lhs != rhs:
+        raise errors.new("invalid proof of knowledge", participant=bcast.participant)
+
+
+def verify_share(my_index: int, share: int, commitments: list[bytes]) -> None:
+    """Check f_i(j)*G == sum_k C_ik * j^k (VSS consistency)."""
+    powers = []
+    x = 1
+    for _ in commitments:
+        powers.append(x)
+        x = (x * my_index) % F.R
+    expect = _g1_lincomb(commitments, powers)
+    got = _g1_mul_gen(share)
+    if expect != got:
+        raise errors.new("share does not match commitments", index=my_index)
+
+
+@dataclass
+class KeygenResult:
+    share_secret: tbls.PrivateKey          # x_j
+    group_pubkey: tbls.PublicKey           # sum_i C_i0
+    share_pubkeys: dict[int, tbls.PublicKey]  # all participants' share pubkeys
+
+
+def finalize(my_index: int, total: int,
+             broadcasts: dict[int, Round1Broadcast],
+             my_shares: dict[int, int]) -> KeygenResult:
+    """Round 2: aggregate shares + derive group/share public keys.
+    `my_shares[i]` is f_i(my_index) received from participant i."""
+    if set(broadcasts) != set(range(1, total + 1)) or set(my_shares) != set(broadcasts):
+        raise errors.new("missing round1 contributions")
+    x_j = sum(my_shares.values()) % F.R
+    if x_j == 0:
+        raise errors.new("degenerate zero share")
+    group = None
+    for b in broadcasts.values():
+        group = b.commitments[0] if group is None else _g1_add(group, b.commitments[0])
+    # summed commitment polynomial: D_k = sum_i C_ik (computed once), then
+    # each share pubkey is just the t-term evaluation sum_k D_k * j^k
+    threshold = len(broadcasts[my_index].commitments)
+    summed = []
+    for k in range(threshold):
+        pts = [b.commitments[k] for b in broadcasts.values()]
+        summed.append(_g1_lincomb(pts, [1] * len(pts)))
+    share_pubkeys = {}
+    for j in range(1, total + 1):
+        powers = []
+        x = 1
+        for _ in range(threshold):
+            powers.append(x)
+            x = (x * j) % F.R
+        share_pubkeys[j] = tbls.PublicKey(_g1_lincomb(summed, powers))
+    result = KeygenResult(
+        share_secret=tbls.PrivateKey(x_j.to_bytes(32, "big")),
+        group_pubkey=tbls.PublicKey(group),
+        share_pubkeys=share_pubkeys,
+    )
+    # sanity: our own share must match our share pubkey
+    if bytes(tbls.secret_to_public_key(result.share_secret)) != bytes(share_pubkeys[my_index]):
+        raise errors.new("aggregated share does not match derived pubkey")
+    return result

@@ -1,0 +1,80 @@
+"""Keycast — trusted-dealer key distribution (reference dkg/keycast.go:43,80,
+153, protocol /charon/dkg/keycast/1.0.0): the leader (node 0) generates each
+DV root key, threshold-splits it, and casts each node its shares over the
+authenticated-encrypted channel. Simpler than FROST but the dealer briefly
+holds the root secrets."""
+
+from __future__ import annotations
+
+import json
+
+from .. import tbls
+from ..p2p.node import TCPNode
+from ..utils import errors, log
+
+_log = log.with_topic("keycast")
+
+PROTOCOL = "/charon/dkg/keycast/1.0.0"
+
+
+async def deal(node: TCPNode, num_validators: int, num_nodes: int,
+               threshold: int) -> tuple[list[dict], list[tbls.PrivateKey]]:
+    """Dealer side: returns (validator records, own share secrets) and sends
+    every other node its shares. Validator record: {pubkey, share_pubkeys}."""
+    records: list[dict] = []
+    per_node_secrets: dict[int, list[tbls.PrivateKey]] = {
+        i: [] for i in range(num_nodes)}
+    for _ in range(num_validators):
+        secret = tbls.generate_secret_key()
+        shares = tbls.threshold_split(secret, num_nodes, threshold)
+        records.append({
+            "pubkey": bytes(tbls.secret_to_public_key(secret)).hex(),
+            "share_pubkeys": [
+                bytes(tbls.secret_to_public_key(shares[i + 1])).hex()
+                for i in range(num_nodes)],
+        })
+        for i in range(num_nodes):
+            per_node_secrets[i].append(shares[i + 1])
+        del secret, shares  # dealer drops the root key material
+    for idx in range(1, num_nodes):
+        payload = json.dumps({
+            "records": records,
+            "shares": [bytes(s).hex() for s in per_node_secrets[idx]],
+        }).encode()
+        await node.send_receive(idx, PROTOCOL, payload, timeout=30.0)
+    return records, per_node_secrets[0]
+
+
+class Receiver:
+    def __init__(self, node: TCPNode):
+        import asyncio
+
+        self._fut: "asyncio.Future" = asyncio.get_event_loop().create_future()
+        node.register_handler(PROTOCOL, self._handle)
+
+    async def _handle(self, sender_idx: int, payload: bytes) -> bytes:
+        if sender_idx != 0:
+            raise errors.new("keycast from non-dealer", sender=sender_idx)
+        msg = json.loads(payload.decode())
+        if not self._fut.done():
+            self._fut.set_result(msg)
+        return b"ok"
+
+    async def receive(self, timeout: float = 120.0) -> tuple[list[dict], list[tbls.PrivateKey]]:
+        import asyncio
+
+        msg = await asyncio.wait_for(self._fut, timeout)
+        records = msg["records"]
+        shares = [tbls.PrivateKey(bytes.fromhex(s)) for s in msg["shares"]]
+        # verify our shares against the dealt share pubkeys before accepting
+        my_idx = None
+        for rec, secret in zip(records, shares):
+            got = bytes(tbls.secret_to_public_key(secret)).hex()
+            if my_idx is None:
+                try:
+                    my_idx = rec["share_pubkeys"].index(got)
+                except ValueError:
+                    raise errors.new("dealt share matches no share pubkey") from None
+            elif rec["share_pubkeys"][my_idx] != got:
+                raise errors.new("dealt share inconsistent with share pubkeys")
+        return records, shares

@@ -1,0 +1,104 @@
+"""DKG sync protocol — connect-all barrier + stepped rendezvous
+(reference dkg/sync/server.go:68 AwaitAllConnected, :123 AwaitAllAtStep,
+client.go; protocol /charon/dkg/sync/1.0.0/).
+
+Every node proves it is running the same ceremony by signing the cluster
+definition hash with its identity key; steps fence ceremony phases so no
+node runs ahead before all peers finished the previous phase."""
+
+from __future__ import annotations
+
+import asyncio
+import hashlib
+import json
+
+from ..p2p.node import TCPNode
+from ..utils import errors, k1util, log
+
+_log = log.with_topic("dkg-sync")
+
+PROTOCOL = "/charon/dkg/sync/1.0.0"
+
+
+def _digest(def_hash: bytes) -> bytes:
+    return hashlib.sha256(b"charon-tpu/dkg-sync" + def_hash).digest()
+
+
+class SyncProtocol:
+    def __init__(self, node: TCPNode, def_hash: bytes, privkey: bytes,
+                 peer_pubkeys: dict[int, bytes]):
+        self._node = node
+        self._def_hash = def_hash
+        self._sig = k1util.sign(privkey, _digest(def_hash))
+        self._peer_pubkeys = peer_pubkeys
+        self.step = 0
+        # last step each peer was seen at (from their queries to us and our
+        # queries to them) — a peer that reached the final step may tear down
+        # its node before we re-query it (reference dkg/sync clean shutdown)
+        self.peer_steps: dict[int, int] = {}
+        node.register_handler(PROTOCOL, self._handle)
+
+    async def _handle(self, sender_idx: int, payload: bytes) -> bytes:
+        req = json.loads(payload.decode())
+        # verify the peer runs the same definition
+        sig = bytes.fromhex(req["def_hash_sig"])
+        peer_pub = self._peer_pubkeys.get(sender_idx)
+        if peer_pub is None or not k1util.verify(peer_pub, _digest(self._def_hash), sig):
+            raise errors.new("peer definition hash mismatch", peer=sender_idx)
+        if sender_idx >= 0:
+            self.peer_steps[sender_idx] = max(self.peer_steps.get(sender_idx, 0),
+                                              int(req.get("step", 0)))
+        return json.dumps({"step": self.step,
+                           "def_hash_sig": self._sig.hex()}).encode()
+
+    async def _query_peer(self, idx: int) -> int:
+        payload = json.dumps({"step": self.step,
+                              "def_hash_sig": self._sig.hex()}).encode()
+        resp = json.loads((await self._node.send_receive(
+            idx, PROTOCOL, payload, timeout=5.0)).decode())
+        sig = bytes.fromhex(resp["def_hash_sig"])
+        if not k1util.verify(self._peer_pubkeys[idx], _digest(self._def_hash), sig):
+            raise errors.new("peer definition hash mismatch", peer=idx)
+        step = int(resp["step"])
+        self.peer_steps[idx] = max(self.peer_steps.get(idx, 0), step)
+        return step
+
+    async def await_all_connected(self, timeout: float = 60.0) -> None:
+        """Block until every peer answers a sync query (reference
+        AwaitAllConnected)."""
+        deadline = asyncio.get_running_loop().time() + timeout
+        pending = set(self._node.peers)
+        while pending:
+            for idx in list(pending):
+                try:
+                    await self._query_peer(idx)
+                    pending.discard(idx)
+                except Exception:  # noqa: BLE001 — peer not up yet
+                    if asyncio.get_running_loop().time() > deadline:
+                        raise errors.new("dkg sync connect timeout",
+                                         missing=sorted(pending))
+            if pending:
+                await asyncio.sleep(0.1)
+        _log.info("all dkg peers connected", peers=len(self._node.peers))
+
+    async def await_all_at_step(self, step: int, timeout: float = 120.0) -> None:
+        """Advance to `step` and block until every peer reports >= step
+        (reference AwaitAllAtStep)."""
+        self.step = step
+        deadline = asyncio.get_running_loop().time() + timeout
+        pending = set(self._node.peers)
+        while pending:
+            for idx in list(pending):
+                try:
+                    if await self._query_peer(idx) >= step:
+                        pending.discard(idx)
+                except Exception:  # noqa: BLE001 — retry until deadline
+                    # a peer that already reported this step may have finished
+                    # and torn down its node — count it as done
+                    if self.peer_steps.get(idx, 0) >= step:
+                        pending.discard(idx)
+            if pending:
+                if asyncio.get_running_loop().time() > deadline:
+                    raise errors.new("dkg step timeout", step=step,
+                                     lagging=sorted(pending))
+                await asyncio.sleep(0.1)

@@ -53,6 +53,8 @@ _SIG = {
     "ct_g1_check": ([ctypes.c_char_p], ctypes.c_int),
     "ct_g2_check": ([ctypes.c_char_p], ctypes.c_int),
     "ct_g2_mul": ([ctypes.c_char_p, ctypes.c_char_p, ctypes.c_void_p], ctypes.c_int),
+    "ct_g1_mul": ([ctypes.c_char_p, ctypes.c_char_p, ctypes.c_void_p], ctypes.c_int),
+    "ct_g1_lincomb": ([ctypes.c_char_p, ctypes.c_char_p, ctypes.c_size_t, ctypes.c_void_p], ctypes.c_int),
     # secp256k1 (consumed by charon_tpu.utils.k1util)
     "k1_selftest": ([], ctypes.c_int),
     "k1_pubkey": ([ctypes.c_char_p, ctypes.c_void_p], ctypes.c_int),

@@ -1565,6 +1565,35 @@ CT_API int ct_g2_check(const uint8_t *sig96) {
     return g2_from_bytes(p, sig96, true) ? 1 : 0;
 }
 
+// [k]P for a serialized G1 point (DKG commitment arithmetic)
+CT_API int ct_g1_mul(const uint8_t *in48, const uint8_t *scalar32, uint8_t *out48) {
+    G1 p, r;
+    if (!g1_from_bytes(p, in48, false)) return -1;
+    uint64_t k[4];
+    scalar_from_be(k, scalar32);
+    jac_mul_limbs(r, p, k, 4);
+    g1_to_bytes(out48, r);
+    return 0;
+}
+
+// sum_i scalars[i] * points[i] over G1 (DKG: evaluate commitment polynomials,
+// batched per share check). No subgroup checks: inputs are commitments whose
+// consistency is what the caller is verifying.
+CT_API int ct_g1_lincomb(const uint8_t *pts48, const uint8_t *scalars32, size_t n,
+                         uint8_t *out48) {
+    G1 acc = jac_infinity<Fp>();
+    for (size_t i = 0; i < n; i++) {
+        G1 p, t;
+        if (!g1_from_bytes(p, pts48 + 48 * i, false)) return -1;
+        uint64_t k[4];
+        scalar_from_be(k, scalars32 + 32 * i);
+        jac_mul_limbs(t, p, k, 4);
+        jac_add(acc, acc, t);
+    }
+    g1_to_bytes(out48, acc);
+    return 0;
+}
+
 // [k]P for a serialized G2 point (tests)
 CT_API int ct_g2_mul(const uint8_t *in96, const uint8_t *scalar32, uint8_t *out96) {
     G2 p, r;

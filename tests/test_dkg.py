@@ -1,0 +1,174 @@
+"""DKG tests: FROST math units, and the full n-node ceremony over real TCP —
+the acceptance shape is `combine` of the produced keystores recovering a
+root key equal to the ceremony's group public key (VERDICT: 'n-process DKG
+produces keystores whose recombined key equals the root')."""
+
+import asyncio
+import json
+
+import pytest
+
+from charon_tpu import tbls
+from charon_tpu.cluster import combine
+from charon_tpu.cluster.definition import Definition, Operator
+from charon_tpu.cluster.lock import Lock
+from charon_tpu.dkg import Config, run_dkg
+from charon_tpu.dkg import frost
+from charon_tpu.eth2 import enr
+from charon_tpu.p2p import PeerSpec
+from charon_tpu.utils import k1util
+from charon_tpu.utils.errors import CharonError
+
+
+def _run(coro, timeout=120):
+    async def wrapped():
+        return await asyncio.wait_for(coro, timeout)
+
+    return asyncio.run(wrapped())
+
+
+class TestFrostMath:
+    def test_keygen_roundtrip_equals_direct_key(self):
+        """4-node 3-threshold keygen: recombined share secrets must equal the
+        group secret (signatures by threshold shares == direct signature)."""
+        n, t = 4, 3
+        ctx = b"test-context"
+        parts = [frost.Participant(i, t, n, ctx) for i in range(1, n + 1)]
+        bcasts, shares = {}, {}
+        for p in parts:
+            b, s = p.round1()
+            bcasts[p.index] = b
+            shares[p.index] = s
+        results = {}
+        for p in parts:
+            for b in bcasts.values():
+                frost.verify_round1(b, t, ctx)
+            my_shares = {i: shares[i][p.index] for i in shares}
+            for i, share in my_shares.items():
+                frost.verify_share(p.index, share, bcasts[i].commitments)
+            results[p.index] = frost.finalize(p.index, n, bcasts, my_shares)
+        group = results[1].group_pubkey
+        assert all(bytes(r.group_pubkey) == bytes(group) for r in results.values())
+        # threshold aggregate == direct sign by the recovered group secret
+        msg = b"\x07" * 32
+        psigs = {i: tbls.sign(results[i].share_secret, msg) for i in (1, 2, 4)}
+        agg = tbls.threshold_aggregate(psigs)
+        assert tbls.verify(group, msg, agg)
+        recovered = tbls.recover_secret(
+            {i: results[i].share_secret for i in (1, 3, 4)}, n, t)
+        assert bytes(tbls.secret_to_public_key(recovered)) == bytes(group)
+        assert bytes(tbls.sign(recovered, msg)) == bytes(agg)
+
+    def test_native_g1_mul_matches_lincomb(self):
+        """ct_g1_mul (single-point scalar mul) agrees with ct_g1_lincomb and
+        with generator multiplication."""
+        import ctypes
+
+        lib = pytest.importorskip("charon_tpu.tbls.native_impl").load_library()
+        base = frost._g1_mul_gen(7)
+        out = (ctypes.c_uint8 * 48)()
+        assert lib.ct_g1_mul(base, (11).to_bytes(32, "big"), out) == 0
+        assert bytes(out) == frost._g1_mul_gen(77)
+        assert bytes(out) == frost._g1_lincomb([base], [11])
+
+    def test_bad_pok_rejected(self):
+        p = frost.Participant(1, 2, 3, b"ctx")
+        b, _ = p.round1()
+        b.pok_mu = (b.pok_mu + 1) % (2 ** 250)
+        with pytest.raises(CharonError):
+            frost.verify_round1(b, 2, b"ctx")
+
+    def test_bad_share_rejected(self):
+        p = frost.Participant(1, 2, 3, b"ctx")
+        b, shares = p.round1()
+        with pytest.raises(CharonError):
+            frost.verify_share(2, (shares[2] + 1), b.commitments)
+
+
+def _ceremony_setup(num_nodes, num_validators, threshold, algorithm, tmp_path):
+    identity_keys = [k1util.generate_private_key() for _ in range(num_nodes)]
+    definition = Definition(
+        name="dkg-test", num_validators=num_validators, threshold=threshold,
+        operators=[Operator(enr=enr.new(k).encode()) for k in identity_keys],
+        dkg_algorithm=algorithm)
+    for i, k in enumerate(identity_keys):
+        definition = definition.sign_operator(i, k)
+    specs = [PeerSpec(i, k1util.public_key(k)) for i, k in enumerate(identity_keys)]
+    configs = [Config(definition=definition, identity_key=identity_keys[i],
+                      node_index=i, peers=specs, data_dir=tmp_path / f"node{i}",
+                      insecure_keystores=True, timeout=90.0)
+               for i in range(num_nodes)]
+    return configs
+
+
+class TestCeremony:
+    @pytest.mark.parametrize("algorithm", ["frost", "keycast"])
+    def test_full_ceremony_and_combine(self, tmp_path, algorithm):
+        configs = _ceremony_setup(4, 2, 3, algorithm, tmp_path)
+
+        async def run():
+            locks = await asyncio.gather(*(run_dkg(c) for c in configs))
+            return locks
+
+        locks = _run(run())
+        # all nodes produced the identical, fully-verified lock
+        h0 = locks[0].lock_hash()
+        assert all(lk.lock_hash() == h0 for lk in locks)
+        for lk in locks:
+            lk.verify()
+        # on-disk artifacts agree
+        disk = json.loads((tmp_path / "node1" / "cluster-lock.json").read_text())
+        assert disk["lock_hash"] == "0x" + h0.hex()
+
+        # the north-star property: combine any threshold of keystores ->
+        # recovered secret's pubkey equals the DV group pubkey
+        recovered = combine(locks[0],
+                            [tmp_path / "node0", tmp_path / "node2", tmp_path / "node3"],
+                            tmp_path / "recovered", insecure=True)
+        for secret, dv in zip(recovered, locks[0].validators):
+            assert bytes(tbls.secret_to_public_key(secret)) == dv.public_key
+        # deposit data verifies against the group key
+        from charon_tpu.eth2 import deposit as deposit_mod
+
+        for dv in locks[0].validators:
+            dd = deposit_mod.DepositData(
+                dv.public_key,
+                deposit_mod.withdrawal_credentials_from_address(b"\x11" * 20),
+                deposit_mod.DEFAULT_AMOUNT_GWEI, dv.deposit_signature)
+            assert deposit_mod.verify_deposit(dd, locks[0].definition.fork_version)
+
+    def test_ceremony_definition_mismatch_fails_at_sync(self, tmp_path):
+        """A node running an internally-valid but DIFFERENT definition must be
+        rejected by the sync protocol's definition-hash check — not merely by
+        local signature validation."""
+        import dataclasses
+
+        identity_keys = [k1util.generate_private_key() for _ in range(3)]
+        ops = [Operator(enr=enr.new(k).encode()) for k in identity_keys]
+
+        def make_def(name):
+            d = Definition(name=name, num_validators=1, threshold=2,
+                           operators=list(ops), dkg_algorithm="frost",
+                           uuid="fixed-uuid")
+            for i, k in enumerate(identity_keys):
+                d = d.sign_operator(i, k)
+            return d
+
+        good, rogue = make_def("cluster-a"), make_def("cluster-b")
+        rogue.verify_signatures()  # internally valid — only the hash differs
+        assert good.definition_hash() != rogue.definition_hash()
+
+        specs = [PeerSpec(i, k1util.public_key(k))
+                 for i, k in enumerate(identity_keys)]
+        configs = [Config(definition=good if i < 2 else rogue,
+                          identity_key=identity_keys[i], node_index=i,
+                          peers=specs, data_dir=tmp_path / f"node{i}",
+                          insecure_keystores=True, timeout=8.0)
+                   for i in range(3)]
+
+        async def run():
+            return await asyncio.gather(*(run_dkg(c) for c in configs),
+                                        return_exceptions=True)
+
+        results = _run(run(), timeout=60)
+        assert all(isinstance(r, Exception) for r in results), results
