@@ -1,0 +1,7 @@
+"""python -m charon_tpu — CLI entry point."""
+
+import sys
+
+from .cmd import main
+
+sys.exit(main())

@@ -1,0 +1,10 @@
+"""app — application shell wiring a full charon node (reference app/):
+monitoring API (/metrics /livez /readyz /debug/qbft), health self-checks,
+and the assembly of p2p + beacon + core pipeline + validatorapi router."""
+
+from .app import App, Config, TestConfig, assemble, run
+from .health import Check, Checker, MetricWindow, default_checks
+from .monitoring import MonitoringAPI
+
+__all__ = ["App", "Check", "Checker", "Config", "MetricWindow",
+           "MonitoringAPI", "TestConfig", "assemble", "default_checks", "run"]

@@ -1,0 +1,263 @@
+"""App shell — wires a full charon node (reference app/app.go:127 Run).
+
+Assembly order mirrors the reference's wireCoreWorkflow (app.go:333-527):
+load cluster + identity from disk → p2p fabric (TCP node, ping, peerinfo,
+optional relays) → beacon client → core duty pipeline (scheduler → fetcher →
+QBFT consensus → dutydb → validatorapi → parsigdb ⇄ parsigex → sigagg →
+aggsigdb → bcast) with tracing/tracking/async-retry wire options → tracker +
+inclusion checker → validatorapi HTTP router → monitoring API + health
+checker. The returned App exposes start/stop for the CLI and tests.
+
+A TestConfig (reference app/app.go:103 TestConfig) injects a beacon mock,
+in-memory cluster, and/or an in-process validator mock for simnet runs."""
+
+from __future__ import annotations
+
+import asyncio
+from dataclasses import dataclass, field
+from pathlib import Path
+
+from .. import cluster as cluster_mod
+from ..core import aggsigdb, bcast as bcast_mod, consensus as consensus_mod
+from ..core import dutydb, fetcher as fetcher_mod, parsigdb, parsigex as parsigex_mod
+from ..core import scheduler as scheduler_mod, sigagg as sigagg_mod, tracker as tracker_mod
+from ..core import validatorapi as vapi_mod
+from ..core.deadline import Deadliner, new_duty_deadline_func
+from ..core.gater import new_duty_gater
+from ..core.interfaces import WithAsyncRetry, WithTracing, WithTracking, wire
+from ..core.vapi_router import VapiRouter
+from ..eth2.beacon import ValidatorCache
+from ..p2p import (ConsensusTCPEndpoint, ParSigExTCPTransport, PeerInfo,
+                   PeerSpec, PingService, RelayClient, TCPNode)
+from ..utils import errors, expbackoff, k1util, log, metrics
+from ..utils import retry as retry_util
+from ..utils.privkeylock import PrivKeyLock
+from .health import Checker
+from .monitoring import MonitoringAPI
+
+_log = log.with_topic("app")
+
+
+@dataclass
+class TestConfig:
+    """Test injection points (reference app/app.go:103-106)."""
+
+    beacon: object = None                 # beacon mock instead of HTTP BN
+    identity: bytes | None = None
+    lock: object = None
+    keys: object = None
+    use_vmock: bool = False
+
+
+@dataclass
+class Config:
+    data_dir: str | Path = "."
+    p2p_host: str = "127.0.0.1"
+    p2p_port: int = 0
+    peer_addrs: dict[int, tuple[str, int]] = field(default_factory=dict)
+    relays: list[tuple[str, int, bytes]] = field(default_factory=list)
+    vapi_host: str = "127.0.0.1"
+    vapi_port: int = 0
+    monitoring_host: str = "127.0.0.1"
+    monitoring_port: int = 0
+    beacon_urls: list[str] = field(default_factory=list)
+    consensus_type: str = "qbft"
+    test: TestConfig = field(default_factory=TestConfig)
+
+
+@dataclass
+class App:
+    config: Config
+    node: TCPNode
+    sched: scheduler_mod.Scheduler
+    vapi: vapi_mod.Component
+    vapi_router: VapiRouter
+    monitoring: MonitoringAPI
+    tracker: tracker_mod.Tracker
+    inclusion: tracker_mod.InclusionChecker
+    health: Checker
+    ping: PingService
+    peerinfo: PeerInfo
+    relay_client: RelayClient | None
+    keys: object
+    lock: object
+    privkey_lock: PrivKeyLock | None
+    tasks: list[asyncio.Task] = field(default_factory=list)
+    _dbs: list = field(default_factory=list)
+
+    async def start(self) -> None:
+        await self.node.start()
+        if self.relay_client is not None:
+            await self.relay_client.start()
+        await self.vapi_router.start()
+        await self.monitoring.start()
+        self.ping.start()
+        self.peerinfo.start()
+        self.inclusion.start()
+        self.health.start()
+        self.tasks = [
+            asyncio.create_task(self.sched.run(), name="scheduler"),
+            asyncio.create_task(self.tracker.run(), name="tracker"),
+        ]
+        for db in self._dbs:
+            self.tasks.append(asyncio.create_task(db(), name="db-gc"))
+        _log.info("charon node started",
+                  vapi=self.vapi_router.base_url,
+                  monitoring=f"http://{self.monitoring.host}:{self.monitoring.port}",
+                  p2p=f"{self.node.listen_host}:{self.node.listen_port}")
+
+    async def stop(self) -> None:
+        self.sched.stop()
+        for t in self.tasks:
+            t.cancel()
+        await asyncio.gather(*self.tasks, return_exceptions=True)
+        self.health.stop()
+        self.inclusion.stop()
+        self.ping.stop()
+        self.peerinfo.stop()
+        if self.relay_client is not None:
+            await self.relay_client.stop()
+        await self.vapi_router.stop()
+        await self.monitoring.stop()
+        await self.node.stop()
+        if self.privkey_lock is not None:
+            self.privkey_lock.release()
+
+
+def assemble(config: Config) -> App:
+    """Build (but do not start) a node from config + disk state."""
+    test = config.test
+    privkey_lock = None
+    if test.identity is not None:
+        identity, lock, keys = test.identity, test.lock, test.keys
+    else:
+        identity, lock, keys = cluster_mod.load_node(config.data_dir)
+        privkey_lock = PrivKeyLock(
+            Path(config.data_dir) / "charon-enr-private-key.lock").acquire()
+
+    # cluster-identity const labels (reference app/app.go:202-213)
+    metrics.default_registry.set_const_labels(
+        cluster_hash=lock.lock_hash().hex()[:10] if lock is not None else "test",
+        cluster_peer=str(keys.my_share_idx))
+
+    num_nodes = (len(lock.definition.operators) if lock is not None
+                 else keys.num_shares)
+    my_idx = keys.my_share_idx - 1
+
+    # p2p fabric
+    peer_pubkeys = {}
+    if lock is not None:
+        from ..eth2 import enr as enr_mod
+
+        for i, op in enumerate(lock.definition.operators):
+            peer_pubkeys[i] = enr_mod.parse(op.enr).pubkey
+    else:
+        peer_pubkeys = {my_idx: k1util.public_key(identity)}
+    specs = []
+    for i in range(num_nodes):
+        host, port = config.peer_addrs.get(i, ("", 0))
+        specs.append(PeerSpec(i, peer_pubkeys.get(i, b"\x02" + bytes(32)), host, port))
+    node = TCPNode(identity, my_idx, specs, listen_host=config.p2p_host,
+                   listen_port=config.p2p_port, own_spec=specs[my_idx])
+    relay_client = RelayClient(node, config.relays) if config.relays else None
+    ping = PingService(node)
+    peerinfo = PeerInfo(node)
+
+    # beacon client
+    beacon = test.beacon
+    if beacon is None:
+        raise errors.new(
+            "no beacon source: provide TestConfig.beacon (simnet) — "
+            "HTTP beacon-node client wiring requires beacon_urls support")
+    chain = beacon._spec if hasattr(beacon, "_spec") else beacon.chain
+
+    # core pipeline (reference wireCoreWorkflow)
+    deadline_fn = new_duty_deadline_func(chain)
+    from ..core.types import pubkey_to_bytes
+
+    valcache = ValidatorCache(beacon,
+                              [bytes(pubkey_to_bytes(pk)) for pk in keys.root_pubkeys])
+    sched = scheduler_mod.Scheduler(beacon, valcache)
+    fetch = fetcher_mod.Fetcher(beacon)
+    duty_db = dutydb.MemDB(Deadliner(deadline_fn))
+    aggsig_db = aggsigdb.MemDB(Deadliner(deadline_fn))
+    parsig_db = parsigdb.MemDB(keys.threshold, Deadliner(deadline_fn))
+    consensus = consensus_mod.Component(
+        ConsensusTCPEndpoint(node), peer_idx=my_idx, nodes=num_nodes,
+        privkey=identity, peer_pubkeys=peer_pubkeys,
+        deadliner=Deadliner(deadline_fn), gater=new_duty_gater(chain))
+    vapi = vapi_mod.Component(beacon, duty_db, aggsig_db, keys, chain)
+    psigex = parsigex_mod.ParSigEx(
+        ParSigExTCPTransport(node), my_idx, new_duty_gater(chain),
+        parsigex_mod.new_batch_eth2_verifier(chain, keys))
+    agg = sigagg_mod.SigAgg(keys, chain)
+    caster = bcast_mod.Broadcaster(beacon, chain)
+    fetch.register_agg_sig_db(aggsig_db.await_)
+    fetch.register_await_attestation_data(duty_db.await_attestation)
+
+    # The tracker must analyse EVERY duty, including types whose pipeline
+    # deadline is None (exits, builder registrations) — give those a
+    # slot-based analysis deadline so their event records are always GC'd.
+    from ..core.deadline import LATE_FACTOR
+
+    def tracker_deadline(duty):
+        d = deadline_fn(duty)
+        return d if d is not None else chain.slot_start_time(duty.slot + LATE_FACTOR)
+
+    track = tracker_mod.Tracker(Deadliner(tracker_deadline), keys.num_shares)
+    inclusion = tracker_mod.InclusionChecker(beacon, chain)
+    retryer = retry_util.Retryer(
+        lambda duty: deadline_fn(duty) if duty is not None else None,
+        expbackoff.Config(base=0.05, jitter=0.1, max_delay=0.5))
+    wire(sched, fetch, consensus, duty_db, vapi, parsig_db, psigex, agg,
+         aggsig_db, caster,
+         options=[WithAsyncRetry(retryer), WithTracing(), WithTracking(track)])
+
+    # feed broadcast attestations to the inclusion checker (reference wires
+    # the tracker's InclusionChecker off sigagg output, inclusion.go:52)
+    from ..core.signeddata import SignedAttestation
+    from ..core.types import DutyType
+
+    async def feed_inclusion(duty, signed_set):
+        if duty.type == DutyType.ATTESTER:
+            for sd in signed_set.values():
+                if isinstance(sd, SignedAttestation):
+                    inclusion.submitted(duty, sd.att.data.hash_tree_root())
+
+    agg.subscribe(feed_inclusion)
+
+    vapi_router = VapiRouter(vapi, bn_base_url=config.beacon_urls[0] if config.beacon_urls else None,
+                             host=config.vapi_host, port=config.vapi_port)
+    quorum = keys.threshold
+    monitoring = MonitoringAPI(config.monitoring_host, config.monitoring_port,
+                               ping_service=ping, beacon=beacon, quorum=quorum,
+                               sniffer=consensus.sniffer)
+    health = Checker(quorum_peers=quorum)
+
+    app = App(config=config, node=node, sched=sched, vapi=vapi,
+              vapi_router=vapi_router, monitoring=monitoring, tracker=track,
+              inclusion=inclusion, health=health, ping=ping, peerinfo=peerinfo,
+              relay_client=relay_client, keys=keys, lock=lock,
+              privkey_lock=privkey_lock,
+              _dbs=[duty_db.run_gc, parsig_db.run_trim, aggsig_db.run_gc,
+                    consensus.run_trim])
+
+    if test.use_vmock:
+        from ..testutil.validatormock import ValidatorMock
+
+        vmock = ValidatorMock(vapi, keys, chain)
+        sched.subscribe_slots(vmock.on_slot)
+    return app
+
+
+async def run(config: Config) -> None:
+    """Assemble, start, and serve until cancelled (the CLI `run` command)."""
+    app = assemble(config)
+    await app.start()
+    try:
+        while True:
+            await asyncio.sleep(3600)
+    except asyncio.CancelledError:
+        pass
+    finally:
+        await app.stop()

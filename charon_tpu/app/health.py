@@ -1,0 +1,127 @@
+"""Health self-checks (reference app/health/{checker,checks}.go): a rule
+engine evaluating the in-process metrics registry over a sliding window,
+exported as the app_health_checks gauge — the node diagnoses itself the way
+an operator dashboard would."""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from dataclasses import dataclass
+from typing import Callable
+
+from ..utils import aio, log, metrics
+
+_log = log.with_topic("health")
+
+_health_gauge = metrics.gauge("app_health_checks", "1 = check failing", ("check",))
+
+
+@dataclass
+class Check:
+    """One health rule (reference checks.go:41-126)."""
+
+    name: str
+    description: str
+    func: Callable[["MetricWindow"], bool]  # True = FAILING
+
+
+class MetricWindow:
+    """Counter deltas + latest gauge values over the check window
+    (reference checker.go's 10-minute in-process scrape buffer)."""
+
+    def __init__(self) -> None:
+        self._prev: dict[tuple, float] = {}
+        self.deltas: dict[tuple, float] = {}
+        self.gauges: dict[tuple, float] = {}
+
+    def scrape(self) -> None:
+        cur: dict[tuple, float] = {}
+        gauges: dict[tuple, float] = {}
+        for m in metrics.default_registry.gather().values():
+            if isinstance(m, metrics.Counter):
+                with m._lock:
+                    for key, val in m._children.items():
+                        cur[(m.name, key)] = val
+            elif isinstance(m, metrics.Gauge):
+                with m._lock:
+                    for key, val in m._children.items():
+                        gauges[(m.name, key)] = val
+        self.deltas = {k: v - self._prev.get(k, 0.0) for k, v in cur.items()}
+        self._prev = cur
+        self.gauges = gauges
+
+    def counter_delta(self, name: str, *label_filter: str) -> float:
+        total = 0.0
+        for (mname, key), delta in self.deltas.items():
+            if mname == name and all(lbl in key for lbl in label_filter):
+                total += delta
+        return total
+
+    def gauge_sum(self, name: str) -> float:
+        return sum(v for (mname, _k), v in self.gauges.items() if mname == name)
+
+    def gauge_values(self, name: str) -> list[float]:
+        return [v for (mname, _k), v in self.gauges.items() if mname == name]
+
+
+def default_checks(quorum_peers: int) -> list[Check]:
+    """The reference's check set (checks.go): error rate, insufficient peers,
+    BN syncing, failed duties."""
+    return [
+        Check("high_error_log_rate", "more than 5 error logs in the window",
+              lambda w: w.counter_delta("log_messages_total", "error") > 5),
+        Check("high_warning_log_rate", "more than 10 warning logs in the window",
+              lambda w: w.counter_delta("log_messages_total", "warn") > 10),
+        Check("insufficient_connected_peers",
+              f"fewer than {quorum_peers} peers reachable",
+              lambda w: (w.gauge_sum("p2p_ping_success") < quorum_peers
+                         if w.gauge_values("p2p_ping_success") else False)),
+        Check("beacon_node_syncing", "beacon node reports syncing",
+              lambda w: w.gauge_sum("app_beacon_node_syncing") > 0),
+        Check("failed_duties", "duties failed in the window",
+              lambda w: w.counter_delta("core_tracker_failed_duties_total") > 0),
+    ]
+
+
+class Checker:
+    def __init__(self, checks: list[Check] | None = None, quorum_peers: int = 0,
+                 interval: float = 10.0):
+        self._checks = checks if checks is not None else default_checks(quorum_peers)
+        self._interval = interval
+        self._window = MetricWindow()
+        self._task: asyncio.Task | None = None
+        self.failing: set[str] = set()
+
+    def evaluate_once(self) -> set[str]:
+        self._window.scrape()
+        failing = set()
+        for check in self._checks:
+            try:
+                bad = check.func(self._window)
+            except Exception as exc:  # noqa: BLE001 — a broken rule is a failing rule
+                _log.warn("health check errored", check=check.name, err=exc)
+                bad = True
+            _health_gauge.set(1.0 if bad else 0.0, check.name)
+            if bad:
+                failing.add(check.name)
+        newly = failing - self.failing
+        recovered = self.failing - failing
+        for name in newly:
+            _log.warn("health check failing", check=name)
+        for name in recovered:
+            _log.info("health check recovered", check=name)
+        self.failing = failing
+        return failing
+
+    def start(self) -> None:
+        async def loop():
+            while True:
+                await asyncio.sleep(self._interval)
+                self.evaluate_once()
+
+        self._task = aio.spawn(loop(), name="health-checker")
+
+    def stop(self) -> None:
+        if self._task is not None:
+            self._task.cancel()

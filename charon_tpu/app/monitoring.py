@@ -1,0 +1,100 @@
+"""Monitoring HTTP API (reference app/monitoringapi.go:46-205):
+
+  /metrics      prometheus text exposition of the process registry
+  /livez        process liveness (always 200 while serving)
+  /readyz       aggregated readiness: BN synced + quorum peers reachable +
+                recent validatorapi traffic (reference monitoringapi.go:107)
+  /debug/qbft   sniffed consensus instances as JSON (reference
+                app/qbftdebug.go:22 serves them gzipped)
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+from aiohttp import web
+
+from ..utils import log, metrics
+
+_log = log.with_topic("monitoring")
+
+READY_OK = "ok"
+
+
+class MonitoringAPI:
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 ping_service=None, beacon=None, quorum: int = 0,
+                 sniffer=None, vapi_activity_window: float = 0.0):
+        self._ping = ping_service
+        self._beacon = beacon
+        self._quorum = quorum
+        self._sniffer = sniffer
+        self._vapi_window = vapi_activity_window
+        self._vapi_last_seen = 0.0
+        self.host = host
+        self.port = port
+        self._runner: web.AppRunner | None = None
+        app = web.Application()
+        app.router.add_get("/metrics", self._metrics)
+        app.router.add_get("/livez", self._livez)
+        app.router.add_get("/readyz", self._readyz)
+        app.router.add_get("/debug/qbft", self._qbft)
+        self._app = app
+
+    def note_vapi_activity(self) -> None:
+        """Hook for the vapi router to mark VC traffic (readyz input)."""
+        self._vapi_last_seen = time.time()
+
+    async def start(self) -> None:
+        self._runner = web.AppRunner(self._app, access_log=None)
+        await self._runner.setup()
+        site = web.TCPSite(self._runner, self.host, self.port)
+        await site.start()
+        self.port = site._server.sockets[0].getsockname()[1]
+        _log.info("monitoring listening", addr=f"{self.host}:{self.port}")
+
+    async def stop(self) -> None:
+        if self._runner is not None:
+            await self._runner.cleanup()
+
+    async def _metrics(self, request: web.Request) -> web.Response:
+        return web.Response(text=metrics.default_registry.expose_text(),
+                            content_type="text/plain")
+
+    async def _livez(self, request: web.Request) -> web.Response:
+        return web.Response(text=READY_OK)
+
+    async def _readyz(self, request: web.Request) -> web.Response:
+        """Aggregate readiness (reference monitoringapi.go:107-205 statuses)."""
+        problems = []
+        if self._beacon is not None:
+            try:
+                if await self._beacon.node_syncing():
+                    problems.append("beacon node syncing")
+            except Exception:  # noqa: BLE001 — unreachable BN = not ready
+                problems.append("beacon node unreachable")
+        if self._ping is not None and self._quorum > 0:
+            up = self._ping.connected_count()
+            if up + 1 < self._quorum:  # self counts toward quorum
+                problems.append(f"insufficient peers: {up + 1}/{self._quorum}")
+        if self._vapi_window > 0:
+            if time.time() - self._vapi_last_seen > self._vapi_window:
+                problems.append("no validator client traffic")
+        if problems:
+            return web.Response(status=503, text="; ".join(problems))
+        return web.Response(text=READY_OK)
+
+    async def _qbft(self, request: web.Request) -> web.Response:
+        if self._sniffer is None:
+            return web.json_response([])
+        instances = getattr(self._sniffer, "instances", [])
+        out = []
+        for inst in instances:
+            out.append({
+                "duty": str(getattr(inst, "duty", "")),
+                "nodes": getattr(inst, "nodes", 0),
+                "peer_idx": getattr(inst, "peer_idx", -1),
+                "msgs": list(getattr(inst, "msgs", [])),
+            })
+        return web.json_response(out, dumps=lambda o: json.dumps(o, default=str))

@@ -1,0 +1,5 @@
+"""cmd — the command-line interface (reference cmd/ cobra commands)."""
+
+from .cli import main
+
+__all__ = ["main"]

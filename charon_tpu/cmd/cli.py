@@ -1,0 +1,320 @@
+"""CLI (reference cmd/cmd.go cobra + viper).
+
+Commands (matching the reference's command set, cmd/cmd.go:55-72):
+  run             run a charon node from a data directory
+  dkg             participate in a DKG ceremony
+  create cluster  trusted-dealer cluster creation (test/dev)
+  create enr      generate a node identity key + print its ENR
+  enr             print the ENR for an existing identity key
+  relay           run a standalone circuit relay server
+  combine         recombine share keystores into root validator keys
+  version         print version information
+
+Config precedence mirrors viper (cmd/cmd.go:89-140):
+  command-line flags > CHARON_* environment variables > charon.yaml
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import os
+import sys
+from pathlib import Path
+
+from ..utils import k1util, log, version
+
+ENV_PREFIX = "CHARON_"
+
+
+_yaml_cache: dict[str, tuple[float, dict]] = {}
+
+
+def _load_yaml_config(data_dir: str) -> dict:
+    path = Path(data_dir) / "charon.yaml"
+    if not path.exists():
+        path = Path("charon.yaml")
+    if not path.exists():
+        return {}
+    key = str(path.resolve())
+    mtime = path.stat().st_mtime
+    cached = _yaml_cache.get(key)
+    if cached is not None and cached[0] == mtime:
+        return cached[1]
+    import yaml
+
+    with open(path) as f:
+        out = yaml.safe_load(f) or {}
+    cfg = {str(k).replace("-", "_"): v for k, v in out.items()}
+    _yaml_cache[key] = (mtime, cfg)
+    return cfg
+
+
+def resolve(args: argparse.Namespace, name: str, default=None):
+    """flag > CHARON_<NAME> env > charon.yaml > default."""
+    val = getattr(args, name, None)
+    if val is not None:
+        return val
+    env = os.environ.get(ENV_PREFIX + name.upper())
+    if env is not None:
+        return env
+    file_cfg = _load_yaml_config(getattr(args, "data_dir", None) or ".")
+    if name in file_cfg:
+        return file_cfg[name]
+    return default
+
+
+_FALSY = {"", "0", "false", "no", "off"}
+
+
+def resolve_bool(args: argparse.Namespace, name: str, default: bool = False) -> bool:
+    """resolve() for booleans: env/yaml strings like 'false'/'0' mean False."""
+    val = resolve(args, name, default)
+    if isinstance(val, str):
+        return val.strip().lower() not in _FALSY
+    return bool(val)
+
+
+def _parse_peers(spec: str | None) -> dict[int, tuple[str, int]]:
+    """"0=host:port,1=host:port" -> {index: (host, port)}"""
+    out: dict[int, tuple[str, int]] = {}
+    if not spec:
+        return out
+    for part in spec.split(","):
+        idx, addr = part.split("=", 1)
+        host, port = addr.rsplit(":", 1)
+        out[int(idx)] = (host, int(port))
+    return out
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="charon-tpu",
+                                description="TPU-native distributed validator middleware")
+    sub = p.add_subparsers(dest="command", required=True)
+
+    run_p = sub.add_parser("run", help="run a charon node")
+    run_p.add_argument("--data-dir", dest="data_dir", default=None,
+                       help="node data directory (default .charon)")
+    run_p.add_argument("--p2p-tcp-address", dest="p2p_tcp_address", default=None,
+                       help="host:port to listen on (default 127.0.0.1:3610)")
+    run_p.add_argument("--p2p-peers", dest="p2p_peers", default=None,
+                       help="peer addresses: 0=host:port,1=host:port,...")
+    run_p.add_argument("--validator-api-address", dest="validator_api_address", default=None)
+    run_p.add_argument("--monitoring-address", dest="monitoring_address", default=None)
+    run_p.add_argument("--beacon-node-endpoints", dest="beacon_node_endpoints", default=None)
+    run_p.add_argument("--simnet-beacon-mock", dest="simnet_beacon_mock",
+                       action="store_true", default=None,
+                       help="use the in-process beacon mock (dev/simnet)")
+    run_p.add_argument("--simnet-validator-mock", dest="simnet_validator_mock",
+                       action="store_true", default=None)
+
+    dkg_p = sub.add_parser("dkg", help="participate in a DKG ceremony")
+    dkg_p.add_argument("--data-dir", dest="data_dir", default=None,
+                       help="node data directory (default .charon)")
+    dkg_p.add_argument("--definition-file", dest="definition_file",
+                       default=None, help="cluster-definition.json path")
+    dkg_p.add_argument("--node-index", dest="node_index", type=int, required=True)
+    dkg_p.add_argument("--p2p-peers", dest="p2p_peers", required=True,
+                       help="ALL operators' addresses: 0=host:port,...")
+    dkg_p.add_argument("--identity-file", dest="identity_file", default=None)
+
+    create_p = sub.add_parser("create", help="create cluster artifacts")
+    create_sub = create_p.add_subparsers(dest="create_command", required=True)
+    cc = create_sub.add_parser("cluster", help="trusted-dealer cluster creation")
+    cc.add_argument("--name", default="charon-tpu-cluster")
+    cc.add_argument("--nodes", type=int, default=4)
+    cc.add_argument("--threshold", type=int, default=3)
+    cc.add_argument("--num-validators", dest="num_validators", type=int, default=1)
+    cc.add_argument("--cluster-dir", dest="cluster_dir", default="cluster")
+    ce = create_sub.add_parser("enr", help="generate identity key + ENR")
+    ce.add_argument("--data-dir", dest="data_dir", default=None,
+                       help="node data directory (default .charon)")
+
+    enr_p = sub.add_parser("enr", help="print this node's ENR")
+    enr_p.add_argument("--data-dir", dest="data_dir", default=None,
+                       help="node data directory (default .charon)")
+
+    relay_p = sub.add_parser("relay", help="run a standalone relay server")
+    relay_p.add_argument("--relay-address", dest="relay_address", default="127.0.0.1:3640")
+    relay_p.add_argument("--identity-file", dest="identity_file", default="relay-private-key")
+
+    comb_p = sub.add_parser("combine", help="recombine share keystores into root keys")
+    comb_p.add_argument("--lock-file", dest="lock_file", required=True)
+    comb_p.add_argument("--node-dirs", dest="node_dirs", required=True,
+                        help="comma-separated node data/keystore directories")
+    comb_p.add_argument("--output-dir", dest="output_dir", default="recovered-keys")
+
+    sub.add_parser("version", help="print version")
+    return p
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = build_parser().parse_args(argv)
+    log.init()
+    try:
+        return _dispatch(args)
+    except KeyboardInterrupt:
+        return 130
+
+
+def _dispatch(args: argparse.Namespace) -> int:
+    if args.command == "version":
+        print(f"charon-tpu {version.VERSION} (git {version.git_commit()})")
+        return 0
+    if args.command == "run":
+        return _cmd_run(args)
+    if args.command == "dkg":
+        return _cmd_dkg(args)
+    if args.command == "create":
+        return _cmd_create(args)
+    if args.command == "enr":
+        return _cmd_enr(args)
+    if args.command == "relay":
+        return _cmd_relay(args)
+    if args.command == "combine":
+        return _cmd_combine(args)
+    raise AssertionError(f"unhandled command {args.command}")
+
+
+def _split_addr(addr: str, default_port: int) -> tuple[str, int]:
+    if ":" in addr:
+        host, port = addr.rsplit(":", 1)
+        return host, int(port)
+    return addr, default_port
+
+
+def _cmd_run(args: argparse.Namespace) -> int:
+    from ..app import Config, TestConfig, run as app_run
+
+    p2p_host, p2p_port = _split_addr(
+        resolve(args, "p2p_tcp_address", "127.0.0.1:3610"), 3610)
+    vapi_host, vapi_port = _split_addr(
+        resolve(args, "validator_api_address", "127.0.0.1:3600"), 3600)
+    mon_host, mon_port = _split_addr(
+        resolve(args, "monitoring_address", "127.0.0.1:3620"), 3620)
+    test = TestConfig()
+    if resolve_bool(args, "simnet_beacon_mock"):
+        # dev-mode beacon mock fed from the node's own lock
+        from .. import cluster as cluster_mod
+        from ..testutil.beaconmock import BeaconMock
+
+        _, lock, _ = cluster_mod.load_node(resolve(args, "data_dir", ".charon"))
+        test.beacon = BeaconMock([v.public_key for v in lock.validators])
+        test.use_vmock = resolve_bool(args, "simnet_validator_mock")
+    bn = resolve(args, "beacon_node_endpoints", "")
+    config = Config(
+        data_dir=resolve(args, "data_dir", ".charon"),
+        p2p_host=p2p_host, p2p_port=p2p_port,
+        peer_addrs=_parse_peers(resolve(args, "p2p_peers")),
+        vapi_host=vapi_host, vapi_port=vapi_port,
+        monitoring_host=mon_host, monitoring_port=mon_port,
+        beacon_urls=[u for u in (bn or "").split(",") if u],
+        test=test,
+    )
+    asyncio.run(app_run(config))
+    return 0
+
+
+def _cmd_dkg(args: argparse.Namespace) -> int:
+    import json
+
+    from ..cluster.definition import Definition
+    from ..dkg import Config as DKGConfig, run_dkg
+    from ..p2p import PeerSpec
+    from ..eth2 import enr as enr_mod
+
+    data_dir = Path(resolve(args, "data_dir", ".charon"))
+    def_file = resolve(args, "definition_file") or str(data_dir / "cluster-definition.json")
+    with open(def_file) as f:
+        definition = Definition.from_json(json.load(f))
+    identity_file = resolve(args, "identity_file") or str(data_dir / "charon-enr-private-key")
+    identity = bytes.fromhex(Path(identity_file).read_text().strip())
+    peer_addrs = _parse_peers(args.p2p_peers)
+    specs = []
+    for i, op in enumerate(definition.operators):
+        host, port = peer_addrs.get(i, ("", 0))
+        specs.append(PeerSpec(i, enr_mod.parse(op.enr).pubkey, host, port))
+    config = DKGConfig(definition=definition, identity_key=identity,
+                       node_index=args.node_index, peers=specs,
+                       data_dir=data_dir)
+    asyncio.run(run_dkg(config))
+    print(f"DKG complete; artifacts written to {data_dir}")
+    return 0
+
+
+def _cmd_create(args: argparse.Namespace) -> int:
+    if args.create_command == "cluster":
+        from ..cluster import create_cluster
+
+        lock = create_cluster(args.name, args.num_validators, args.nodes,
+                              args.threshold, args.cluster_dir)
+        print(f"created cluster {args.name}: {args.nodes} nodes, "
+              f"{args.num_validators} validators, lock hash "
+              f"0x{lock.lock_hash().hex()}")
+        return 0
+    if args.create_command == "enr":
+        from ..eth2 import enr as enr_mod
+
+        data_dir = Path(resolve(args, "data_dir", ".charon"))
+        data_dir.mkdir(parents=True, exist_ok=True)
+        key_path = data_dir / "charon-enr-private-key"
+        if key_path.exists():
+            print(f"identity key already exists at {key_path}", file=sys.stderr)
+            return 1
+        key = k1util.generate_private_key()
+        key_path.write_text(key.hex())
+        key_path.chmod(0o600)
+        print(enr_mod.new(key).encode())
+        return 0
+    raise AssertionError
+
+
+def _cmd_enr(args: argparse.Namespace) -> int:
+    from ..eth2 import enr as enr_mod
+
+    key_path = Path(resolve(args, "data_dir", ".charon")) / "charon-enr-private-key"
+    key = bytes.fromhex(key_path.read_text().strip())
+    print(enr_mod.new(key).encode())
+    return 0
+
+
+def _cmd_relay(args: argparse.Namespace) -> int:
+    from ..p2p import RelayServer
+
+    host, port = _split_addr(args.relay_address, 3640)
+    key_path = Path(args.identity_file)
+    if key_path.exists():
+        key = bytes.fromhex(key_path.read_text().strip())
+    else:
+        key = k1util.generate_private_key()
+        key_path.write_text(key.hex())
+        key_path.chmod(0o600)
+
+    async def serve():
+        relay = RelayServer(key, host, port)
+        await relay.start()
+        print(f"relay listening on {host}:{relay.listen_port}, "
+              f"pubkey {relay.pubkey.hex()}")
+        try:
+            while True:
+                await asyncio.sleep(3600)
+        finally:
+            await relay.stop()
+
+    asyncio.run(serve())
+    return 0
+
+
+def _cmd_combine(args: argparse.Namespace) -> int:
+    from ..cluster import combine
+    from ..cluster.lock import load as load_lock
+
+    lock = load_lock(args.lock_file)
+    dirs = [d for d in args.node_dirs.split(",") if d]
+    recovered = combine(lock, dirs, args.output_dir)
+    print(f"recovered {len(recovered)} root validator keys into {args.output_dir}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

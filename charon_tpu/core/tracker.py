@@ -1,0 +1,220 @@
+"""Tracker — the observability brain (reference core/tracker/tracker.go).
+
+Every pipeline boundary reports events through the WithTracking wire option
+(core/interfaces.py); after a duty's deadline the tracker determines how far
+the duty progressed, the failed step and root-cause reason
+(analyseDutyFailed tracker.go:223), and per-peer participation from the
+share indices seen in partial-signature events (analyseParticipation
+tracker.go:538). The InclusionChecker (inclusion.go:52) scans beacon blocks
+to confirm on-chain inclusion and compute inclusion delay."""
+
+from __future__ import annotations
+
+import asyncio
+from collections import defaultdict
+from dataclasses import dataclass, field
+
+from ..utils import aio, errors, log, metrics
+from .deadline import Deadliner
+from .types import Duty, DutyType, ParSignedDataSet
+
+_log = log.with_topic("tracker")
+
+# Pipeline steps in order (wire component names, reference tracker.go step enum)
+STEPS = ["scheduler", "fetcher", "consensus", "dutydb", "parsigdb_internal",
+         "parsigex", "parsigdb_external", "sigagg", "aggsigdb", "bcast"]
+_STEP_INDEX = {s: i for i, s in enumerate(STEPS)}
+
+_failed_counter = metrics.counter(
+    "core_tracker_failed_duties_total", "Duties failed by step", ("step",))
+_success_counter = metrics.counter(
+    "core_tracker_success_duties_total", "Duties completed", ("type",))
+_participation_gauge = metrics.gauge(
+    "core_tracker_participation", "Peer participated in last duty", ("peer_share_idx",))
+_participation_counter = metrics.counter(
+    "core_tracker_participation_total", "Per-peer duty participations",
+    ("peer_share_idx",))
+_unexpected_counter = metrics.counter(
+    "core_tracker_unexpected_events_total", "Events for unknown duties")
+_inclusion_delay_gauge = metrics.gauge(
+    "core_tracker_inclusion_delay", "Blocks until attestation inclusion")
+_inclusion_missed_counter = metrics.counter(
+    "core_tracker_inclusion_missed_total", "Submitted duties never included")
+
+
+@dataclass
+class _DutyEvents:
+    events: list[tuple[str, object, BaseException | None]] = field(default_factory=list)
+    share_indices: set[int] = field(default_factory=set)
+
+
+@dataclass
+class FailureReport:
+    duty: Duty
+    success: bool
+    failed_step: str | None = None
+    reason: str | None = None
+    participation: set[int] = field(default_factory=set)
+
+
+class Tracker:
+    """Consumes WithTracking events; analyses each duty after its deadline."""
+
+    def __init__(self, deadliner: Deadliner, num_shares: int):
+        self._deadliner = deadliner
+        self._num_shares = num_shares
+        self._duties: dict[Duty, _DutyEvents] = defaultdict(_DutyEvents)
+        self._subs: list = []
+        self.reports: list[FailureReport] = []  # bounded history for tests/debug
+
+    def subscribe(self, fn) -> None:
+        """fn(report: FailureReport) awaited after each duty analysis."""
+        self._subs.append(fn)
+
+    async def report_event(self, component: str, duty: Duty, data, err) -> None:
+        """The WithTracking hook (reference tracker.go:668-817 event funcs)."""
+        if component not in _STEP_INDEX:
+            _unexpected_counter.inc()
+            return
+        if not self._deadliner.add(duty):
+            # already expired (late event after analysis) — drop, else the
+            # recreated defaultdict entry would never be GC'd
+            self._duties.pop(duty, None)
+            return
+        rec = self._duties[duty]
+        rec.events.append((component, data, err))
+        if component in ("parsigdb_internal", "parsigdb_external") and isinstance(data, dict):
+            for psd in data.values():
+                idx = getattr(psd, "share_idx", None)
+                if idx is not None:
+                    rec.share_indices.add(idx)
+
+    async def run(self) -> None:
+        """Analyse each duty as its deadline expires (reference tracker.go:128
+        Run consuming the deadliner channel)."""
+        async for duty in self._deadliner.expired():
+            rec = self._duties.pop(duty, None)
+            if rec is None:
+                continue
+            report = self._analyse(duty, rec)
+            self.reports.append(report)
+            if len(self.reports) > 1024:
+                del self.reports[:512]
+            for fn in self._subs:
+                try:
+                    await fn(report)
+                except Exception as exc:  # noqa: BLE001 — subscriber isolation
+                    _log.warn("tracker subscriber failed", err=exc)
+
+    def _analyse(self, duty: Duty, rec: _DutyEvents) -> FailureReport:
+        """Failed-step/root-cause analysis (reference analyseDutyFailed
+        tracker.go:223): find the furthest step reached; the duty succeeded
+        iff a bcast event without error exists."""
+        furthest = -1
+        furthest_err: BaseException | None = None
+        errs_by_step: dict[str, BaseException] = {}
+        for component, _data, err in rec.events:
+            idx = _STEP_INDEX[component]
+            if err is not None:
+                errs_by_step.setdefault(component, err)
+            if idx > furthest and err is None:
+                furthest = idx
+        success = any(c == "bcast" and e is None for c, _d, e in rec.events)
+        self._report_participation(duty, rec, success)
+        if success:
+            _success_counter.inc(str(duty.type))
+            return FailureReport(duty, True, participation=set(rec.share_indices))
+        # root cause: the first step AFTER the furthest successful one; prefer
+        # a recorded error at or after that step (reference reason.go mapping)
+        failed_idx = min(furthest + 1, len(STEPS) - 1)
+        failed_step = STEPS[failed_idx]
+        reason = None
+        for step in STEPS[failed_idx:]:
+            if step in errs_by_step:
+                failed_step = step
+                reason = str(errs_by_step[step])
+                break
+        if reason is None:
+            reason = f"no events from step {failed_step!r} before deadline"
+        _failed_counter.inc(failed_step)
+        _log.warn("duty failed", duty=str(duty), step=failed_step, reason=reason)
+        return FailureReport(duty, False, failed_step, reason,
+                             set(rec.share_indices))
+
+    def _report_participation(self, duty: Duty, rec: _DutyEvents, success: bool) -> None:
+        """Per-peer participation (reference analyseParticipation
+        tracker.go:538): which share indices contributed partials."""
+        if not rec.share_indices and not success:
+            return  # nothing reached the partial stage; not a peer issue
+        for idx in range(1, self._num_shares + 1):
+            seen = idx in rec.share_indices
+            _participation_gauge.set(1.0 if seen else 0.0, str(idx))
+            if seen:
+                _participation_counter.inc(str(idx))
+        absent = set(range(1, self._num_shares + 1)) - rec.share_indices
+        if absent and rec.share_indices:
+            _log.debug("peers absent from duty", duty=str(duty),
+                       absent=sorted(absent))
+
+
+class InclusionChecker:
+    """Confirms broadcast duties land on-chain and measures inclusion delay
+    (reference core/tracker/inclusion.go:52): scans each new block's
+    attestations for the cluster's submissions."""
+
+    def __init__(self, beacon, chain, max_delay_slots: int = 32):
+        self._beacon = beacon
+        self._chain = chain
+        self._max_delay = max_delay_slots
+        # attestation data root -> submitted slot
+        self._pending: dict[bytes, int] = {}
+        self._task: asyncio.Task | None = None
+        self.included: list[tuple[int, int]] = []  # (submitted_slot, delay)
+        self.missed: list[int] = []
+
+    def submitted(self, duty: Duty, data_root: bytes) -> None:
+        if duty.type in (DutyType.ATTESTER, DutyType.AGGREGATOR):
+            self._pending[data_root] = duty.slot
+
+    def start(self) -> None:
+        self._task = aio.spawn(self._run(), name="inclusion-checker")
+
+    def stop(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+
+    async def _run(self) -> None:
+        seen_slot = None  # start from the head at boot; never scan history
+        while True:
+            await asyncio.sleep(self._chain.seconds_per_slot / 2)
+            try:
+                head = await self._beacon.head_slot()
+            except Exception:  # noqa: BLE001 — BN hiccup; retry next tick
+                continue
+            if seen_slot is None:
+                seen_slot = head - 1
+            for slot in range(seen_slot + 1, head + 1):
+                await self._check_block(slot)
+            seen_slot = max(seen_slot, head)
+            self._expire(head)
+
+    async def _check_block(self, slot: int) -> None:
+        try:
+            roots = await self._beacon.block_attestation_roots(slot)
+        except Exception:  # noqa: BLE001 — block may not exist
+            return
+        for root in roots:
+            sub_slot = self._pending.pop(root, None)
+            if sub_slot is not None:
+                delay = slot - sub_slot
+                self.included.append((sub_slot, delay))
+                _inclusion_delay_gauge.set(delay)
+                _log.debug("attestation included", slot=sub_slot, delay=delay)
+
+    def _expire(self, head: int) -> None:
+        for root, sub_slot in list(self._pending.items()):
+            if head - sub_slot > self._max_delay:
+                del self._pending[root]
+                self.missed.append(sub_slot)
+                _inclusion_missed_counter.inc()
+                _log.warn("attestation never included", slot=sub_slot)

@@ -164,30 +164,44 @@ class BeaconMock:
     # -- submissions ---------------------------------------------------------
 
     async def submit_attestations(self, atts: list[spec.Attestation]) -> None:
+        if "submit_attestations" in self.overrides:
+            return await self.overrides["submit_attestations"](atts)
         self.attestations.extend(atts)
         self._wake()
 
     async def submit_block(self, block: spec.SignedBeaconBlock) -> None:
+        if "submit_block" in self.overrides:
+            return await self.overrides["submit_block"](block)
         self.blocks.append(block)
         self._wake()
 
     async def submit_aggregate_and_proofs(self, aggs) -> None:
+        if "submit_aggregate_and_proofs" in self.overrides:
+            return await self.overrides["submit_aggregate_and_proofs"](aggs)
         self.aggregates.extend(aggs)
         self._wake()
 
     async def submit_sync_messages(self, msgs) -> None:
+        if "submit_sync_messages" in self.overrides:
+            return await self.overrides["submit_sync_messages"](msgs)
         self.sync_messages.extend(msgs)
         self._wake()
 
     async def submit_contribution_and_proofs(self, contribs) -> None:
+        if "submit_contribution_and_proofs" in self.overrides:
+            return await self.overrides["submit_contribution_and_proofs"](contribs)
         self.contributions.extend(contribs)
         self._wake()
 
     async def submit_validator_registrations(self, regs) -> None:
+        if "submit_validator_registrations" in self.overrides:
+            return await self.overrides["submit_validator_registrations"](regs)
         self.registrations.extend(regs)
         self._wake()
 
     async def submit_voluntary_exit(self, exit_) -> None:
+        if "submit_voluntary_exit" in self.overrides:
+            return await self.overrides["submit_voluntary_exit"](exit_)
         self.exits.append(exit_)
         self._wake()
 
@@ -207,3 +221,14 @@ class BeaconMock:
                 await asyncio.wait_for(self._submitted.wait(), timeout=remaining)
             except asyncio.TimeoutError:
                 pass
+
+    # -- inclusion-checker surface (reference beaconmock headproducer) --------
+
+    async def head_slot(self) -> int:
+        return max(self._spec.slot_at(time.time()), 0)
+
+    async def block_attestation_roots(self, slot: int) -> list[bytes]:
+        """Attestation data roots 'included' in the block at `slot`: the mock
+        chain includes every attestation submitted for the previous slot."""
+        return [att.data.hash_tree_root() for att in self.attestations
+                if att.data.slot == slot - 1]

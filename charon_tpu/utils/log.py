@@ -25,6 +25,20 @@ _counters_lock = threading.Lock()
 log_error_total: dict[str, int] = {}
 log_warn_total: dict[str, int] = {}
 
+# registry metric mirroring the dicts so /metrics and the health checker see
+# log error/warn rates (lazy import avoids a module cycle at import time)
+_log_counter = None
+
+
+def _count_metric(level_name: str, topic: str) -> None:
+    global _log_counter
+    if _log_counter is None:
+        from . import metrics as _metrics
+
+        _log_counter = _metrics.counter(
+            "log_messages_total", "Warn/error log lines", ("level", "topic"))
+    _log_counter.inc(level_name, topic)
+
 
 class _Config:
     level: int = INFO
@@ -67,11 +81,13 @@ class Logger:
     def warn(self, msg: str, err: BaseException | None = None, **fields: Any) -> None:
         with _counters_lock:
             log_warn_total[self.topic] = log_warn_total.get(self.topic, 0) + 1
+        _count_metric("warn", self.topic)
         self._emit(WARN, msg, err, fields)
 
     def error(self, msg: str, err: BaseException | None = None, **fields: Any) -> None:
         with _counters_lock:
             log_error_total[self.topic] = log_error_total.get(self.topic, 0) + 1
+        _count_metric("error", self.topic)
         self._emit(ERROR, msg, err, fields)
 
     def _emit(self, level: int, msg: str, err: BaseException | None,
