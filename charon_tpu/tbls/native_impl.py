@@ -52,6 +52,19 @@ _SIG = {
     ),
     "ct_g1_check": ([ctypes.c_char_p], ctypes.c_int),
     "ct_g2_check": ([ctypes.c_char_p], ctypes.c_int),
+    "ct_g1_uncompress_bulk": (
+        [ctypes.c_char_p, ctypes.c_size_t, ctypes.c_void_p, ctypes.c_int],
+        ctypes.c_longlong,
+    ),
+    "ct_g2_uncompress_bulk": (
+        [ctypes.c_char_p, ctypes.c_size_t, ctypes.c_void_p, ctypes.c_int],
+        ctypes.c_longlong,
+    ),
+    "ct_pairing_check": (
+        [ctypes.c_char_p, ctypes.c_char_p, ctypes.c_char_p, ctypes.c_size_t,
+         ctypes.c_int],
+        ctypes.c_int,
+    ),
     "ct_g2_mul": ([ctypes.c_char_p, ctypes.c_char_p, ctypes.c_void_p], ctypes.c_int),
     "ct_g1_mul": ([ctypes.c_char_p, ctypes.c_char_p, ctypes.c_void_p], ctypes.c_int),
     "ct_g1_lincomb": ([ctypes.c_char_p, ctypes.c_char_p, ctypes.c_size_t, ctypes.c_void_p], ctypes.c_int),

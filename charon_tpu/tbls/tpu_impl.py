@@ -1,88 +1,93 @@
 """TPU backend for the tbls facade — the north-star offload.
 
-Routes the duty pipeline's hot calls — threshold aggregation
-(ops/aggregate.py) and batched pairing verification (ops/pairing.py) — onto
-batched JAX kernels, while delegating the remaining operations to the CPU
-oracle. Feature-gated via
-charon_tpu.utils.featureset.TPU_BLS in app wiring, mirroring how the reference
-gates backends behind tbls.SetImplementation + app/featureset
-(reference tbls/tbls.go:72, featureset.go:10-75).
+Routes the duty pipeline's hot calls onto the fused Pallas kernel plane
+(ops/pallas_plane.py, ops/plane_agg.py):
 
-Outputs are bit-identical to PythonImpl: both compute Σ λᵢ·sigᵢ exactly and
-use the same ETH serialization; the cross-implementation randomized test suite
-(reference tbls/tbls_test.go:210-240) holds across the pair.
+  * threshold_aggregate_batch — per-validator Lagrange combination Σ λⱼ·sigⱼ
+    for a whole batch of validators in one device double-and-add sweep
+    (reference hot loop: core/sigagg/sigagg.go:144). Bit-identical to the
+    CPU backends: all three compute Σ λⱼ·sigⱼ exactly with the same ETH
+    serialization (the cross-implementation randomized suite, reference
+    tbls/tbls_test.go:210-240, holds across the triple).
+  * verify_batch — random-linear-combination batch verification: device
+    G1/G2 MSMs with 128-bit coefficients + one native multi-pairing
+    (reference hot loops: per-partial tbls.Verify in
+    core/parsigex/parsigex.go:61 and the aggregate verify in
+    core/sigagg/sigagg.go:159). Sound to 2⁻¹²⁸; a False means at least one
+    bad signature and callers attribute per-item.
+
+Everything else (keygen, split/recover, sign, single verify) delegates to
+the native C++ backend. Small batches stay on the CPU: the device sweep
+has a fixed ~1s latency (a 256-step kernel chain), so it only wins past
+`min_device_batch` items. Feature-gated in app wiring via
+charon_tpu.utils.featureset.TPU_BLS, mirroring how the reference gates
+backends behind tbls.SetImplementation + app/featureset
+(reference tbls/tbls.go:72, featureset.go:10-75).
 """
 
 from __future__ import annotations
 
 import numpy as np
 
-from ..crypto.curve import Fq2Ops, FqOps, jac_is_infinity, to_affine
-from ..crypto.hash_to_curve import DST_ETH, hash_to_g2
-from ..crypto.serialize import DeserializationError, g1_from_bytes, g2_from_bytes
-from ..ops.aggregate import threshold_aggregate_batch as _device_aggregate
-from ..ops.pairing import verify_batch_device as _device_verify
-from .python_impl import PythonImpl
-from .types import PrivateKey, PublicKey, Signature
+from ..crypto.hash_to_curve import hash_to_g2
+from .native_impl import NativeImpl
+from .types import PublicKey, Signature
 
 
-class TPUImpl(PythonImpl):
+def _on_device() -> bool:
+    import jax
+
+    return jax.default_backend() != "cpu"
+
+
+class TPUImpl(NativeImpl):
     """tbls Implementation running batched ops on the JAX device."""
 
     name = "jax-tpu"
 
-    def threshold_aggregate(self, partial_sigs: dict[int, Signature]) -> Signature:
-        return self.threshold_aggregate_batch([partial_sigs])[0]
+    # Below this many items the fixed device-sweep latency loses to the
+    # native per-item path; tuned on v5e (native: ~3.3ms/aggregate,
+    # ~6.7ms/verify; device sweep: ~1s).
+    min_device_batch = 192
 
     def threshold_aggregate_batch(self, batches: list[dict[int, Signature]]
                                   ) -> list[Signature]:
-        if not batches:
-            return []
+        if len(batches) < self.min_device_batch or not _on_device():
+            return NativeImpl.threshold_aggregate_batch(self, batches)
         for b in batches:
             if not b:
                 raise ValueError("no partial signatures to aggregate")
-        raw = _device_aggregate([{i: bytes(s) for i, s in b.items()}
-                                 for b in batches])
+        from ..ops import plane_agg
+
+        raw = plane_agg.threshold_aggregate_batch(
+            [{i: bytes(s) for i, s in b.items()} for b in batches])
         return [Signature(r) for r in raw]
 
     def verify_batch(self, public_keys: list[PublicKey], datas: list[bytes],
                      signatures: list[Signature]) -> bool:
-        """Batched verification on device: each (pk, H(m), sig) triple runs
-        its own pairing check with the batch axis spanning the triples — the
-        parsigex/sigagg hot path (reference core/parsigex/parsigex.go:61,
-        core/sigagg/sigagg.go:159). Host does the (cheap) deserialization and
-        hash-to-curve; the Miller loops + final exponentiation run batched on
-        device. Unlike PythonImpl's random-linear-combination batch, per-item
-        results are exact, so a False return already identifies culprits."""
-        ok = self.verify_batch_each(public_keys, datas, signatures)
-        return bool(np.all(ok)) if len(ok) else True
+        if not (len(public_keys) == len(datas) == len(signatures)):
+            raise ValueError("length mismatch")
+        n = len(public_keys)
+        if n < self.min_device_batch or not _on_device():
+            return NativeImpl.verify_batch(self, public_keys, datas,
+                                           signatures)
+        # Curve + subgroup membership and infinity rejection (matching the
+        # native per-item verifier's semantics) are enforced inside
+        # rlc_verify_batch's bulk native decompression.
+        from ..ops import plane_agg
+
+        return plane_agg.rlc_verify_batch(
+            [bytes(pk) for pk in public_keys], [bytes(d) for d in datas],
+            [bytes(s) for s in signatures], hash_to_g2)
 
     def verify_batch_each(self, public_keys: list[PublicKey],
                           datas: list[bytes],
                           signatures: list[Signature]) -> np.ndarray:
-        """Per-item validity of each (pubkey, data, signature) triple."""
+        """Per-item validity — the attribution path after a failed batch.
+        Native per-item verification: exact culprits, no RLC ambiguity."""
         if not (len(public_keys) == len(datas) == len(signatures)):
             raise ValueError("length mismatch")
-        n = len(public_keys)
-        if n == 0:
-            return np.zeros(0, dtype=bool)
-        ok = np.zeros(n, dtype=bool)
-        idx, pk_affs, h_affs, sig_affs = [], [], [], []
-        h_cache: dict[bytes, tuple] = {}
-        for i, (pkb, data, sigb) in enumerate(zip(public_keys, datas, signatures)):
-            try:
-                pk = g1_from_bytes(bytes(pkb))
-                sig = g2_from_bytes(bytes(sigb))
-            except DeserializationError:
-                continue  # stays False
-            if jac_is_infinity(FqOps, pk) or jac_is_infinity(Fq2Ops, sig):
-                continue
-            if data not in h_cache:
-                h_cache[data] = to_affine(Fq2Ops, hash_to_g2(data, DST_ETH))
-            idx.append(i)
-            pk_affs.append(to_affine(FqOps, pk))
-            h_affs.append(h_cache[data])
-            sig_affs.append(to_affine(Fq2Ops, sig))
-        if idx:
-            ok[idx] = _device_verify(pk_affs, h_affs, sig_affs)
-        return ok
+        return np.asarray([
+            self.verify(pk, data, sig)
+            for pk, data, sig in zip(public_keys, datas, signatures)
+        ], dtype=bool)

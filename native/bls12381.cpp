@@ -1594,6 +1594,76 @@ CT_API int ct_g1_lincomb(const uint8_t *pts48, const uint8_t *scalars32, size_t 
     return 0;
 }
 
+// Bulk decompression for the TPU host pipeline: compressed points ->
+// affine coordinates as big-endian byte strings (48 bytes per Fp element),
+// so the device layout conversion never runs Python square roots.
+// out per G1 point: x||y (96 B); per G2 point: x0||x1||y0||y1 (192 B).
+// Infinity encodes as all-zero output. Returns n on success, -(i+1) on the
+// first point that fails to decode. on-curve is always enforced; subgroup
+// membership when check_subgroup != 0 (one decode serves both, so callers
+// never pay a second ct_g{1,2}_check pass).
+CT_API long long ct_g1_uncompress_bulk(const uint8_t *in48s, size_t n,
+                                       uint8_t *out96s, int check_subgroup) {
+    for (size_t i = 0; i < n; i++) {
+        G1 p;
+        if (!g1_from_bytes(p, in48s + 48 * i, check_subgroup != 0))
+            return -(long long)(i + 1);
+        uint8_t *o = out96s + 96 * i;
+        if (jac_is_inf(p)) {
+            memset(o, 0, 96);
+            continue;
+        }
+        G1Aff a = to_affine(p);
+        fp_to_bytes(o, a.x);
+        fp_to_bytes(o + 48, a.y);
+    }
+    return (long long)n;
+}
+
+CT_API long long ct_g2_uncompress_bulk(const uint8_t *in96s, size_t n,
+                                       uint8_t *out192s, int check_subgroup) {
+    for (size_t i = 0; i < n; i++) {
+        G2 p;
+        if (!g2_from_bytes(p, in96s + 96 * i, check_subgroup != 0))
+            return -(long long)(i + 1);
+        uint8_t *o = out192s + 192 * i;
+        if (jac_is_inf(p)) {
+            memset(o, 0, 192);
+            continue;
+        }
+        G2Aff a = to_affine(p);
+        fp_to_bytes(o, a.x.c0);
+        fp_to_bytes(o + 48, a.x.c1);
+        fp_to_bytes(o + 96, a.y.c0);
+        fp_to_bytes(o + 144, a.y.c1);
+    }
+    return (long long)n;
+}
+
+// Pairing-product check: prod_i e(P_i, Q_i) == 1 with optional negation of
+// each G1 input. Used by the TPU backend's random-linear-combination batch
+// verification: the device computes the G1/G2 combinations, this runs the
+// two (or k+1, one per distinct message) final pairings.
+// g1s: n*48 compressed, g2s: n*96 compressed, negs: n bytes (nonzero = use
+// -P_i). check_subgroup = 0 when the inputs are internally derived from
+// already-validated points (the RLC path) — skips k+1 subgroup scalar-muls.
+// Returns 1 if the product is one, 0 if not, -1 on decode error.
+CT_API int ct_pairing_check(const uint8_t *g1s, const uint8_t *g2s,
+                            const uint8_t *negs, size_t n,
+                            int check_subgroup) {
+    std::vector<MillerPair> pairs;
+    pairs.reserve(n);
+    for (size_t i = 0; i < n; i++) {
+        G1 p;
+        G2 q;
+        if (!g1_from_bytes(p, g1s + 48 * i, check_subgroup != 0)) return -1;
+        if (!g2_from_bytes(q, g2s + 96 * i, check_subgroup != 0)) return -1;
+        MillerPair mp;
+        if (make_pair(mp, p, q, negs[i] != 0)) pairs.push_back(mp);
+    }
+    return pairing_product_is_one(pairs) ? 1 : 0;
+}
+
 // [k]P for a serialized G2 point (tests)
 CT_API int ct_g2_mul(const uint8_t *in96, const uint8_t *scalar32, uint8_t *out96) {
     G2 p, r;

@@ -9,6 +9,10 @@ so the platform must be forced via jax.config before any backend init.
 
 import os
 
+# Persistent compile cache: the fused pallas kernels (interpret mode on CPU)
+# cost ~1 min to build the first time; cached across test runs.
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/root/repo/.jax_cache")
+
 xla_flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in xla_flags:
     os.environ["XLA_FLAGS"] = (
@@ -18,3 +22,13 @@ if "xla_force_host_platform_device_count" not in xla_flags:
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+# Persistent compile cache: the env var alone is not picked up under this
+# image's jax/axon combination — set the config explicitly. The interpreted
+# pallas kernels take minutes to build; cached they load in ms. Guarded:
+# the cache is an optimization only, never a reason to fail collection.
+try:
+    jax.config.update("jax_compilation_cache_dir", "/root/repo/.jax_cache")
+    jax.config.update("jax_persistent_cache_enable_xla_caches", "all")
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+except Exception:  # noqa: BLE001
+    pass

@@ -101,3 +101,4 @@ def test_tpu_impl_verify_batch_routes_to_device():
     garbage = Signature(b"\xff" * 96)
     each = impl.verify_batch_each(pks, [msg] * 3, [sigs[0], garbage, sigs[2]])
     assert each.tolist() == [True, False, True]
+

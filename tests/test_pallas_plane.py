@@ -1,0 +1,134 @@
+"""Fused Pallas kernel plane vs the pure-Python oracle.
+
+Runs in pallas interpret mode on the CPU CI mesh (tests/conftest.py); the
+same kernels run compiled on real TPU hardware (bench.py). Covers the
+Montgomery multiply, Fq2 arithmetic, and the fused G2/G1 point kernels
+including every unified-addition edge case (∞ operands, P+P, P+(−P)) —
+the correctness oracle the reference applies to its BLS backend
+(reference tbls/tbls_test.go suite shape).
+"""
+
+import random
+
+import numpy as np
+import pytest
+
+jnp = pytest.importorskip("jax.numpy")
+import jax  # noqa: E402
+
+from charon_tpu.crypto import curve as PC  # noqa: E402
+from charon_tpu.crypto import fields as PF  # noqa: E402
+from charon_tpu.ops import field as F  # noqa: E402
+from charon_tpu.ops import pallas_plane as PP  # noqa: E402
+
+B = 1024  # one kernel tile
+
+
+def _plane_pt_to_int(pp, i):
+    x = PP.from_plane(np.asarray(pp.X), pp.B)[i]
+    y = PP.from_plane(np.asarray(pp.Y), pp.B)[i]
+    z = PP.from_plane(np.asarray(pp.Z), pp.B)[i]
+    if pp.E == 1:
+        return (F.fq_to_int(x), F.fq_to_int(y), F.fq_to_int(z))
+    return ((F.fq_to_int(x[0]), F.fq_to_int(x[1])),
+            (F.fq_to_int(y[0]), F.fq_to_int(y[1])),
+            (F.fq_to_int(z[0]), F.fq_to_int(z[1])))
+
+
+class TestFieldKernels:
+    def test_fq_mont_mul_bit_exact(self):
+        rng = random.Random(11)
+        ints = [rng.randrange(F.P_INT) for _ in range(B)]
+        # include boundary values
+        ints[0], ints[1], ints[2] = 0, 1, F.P_INT - 1
+        a = np.stack([F.fq_from_int(x) for x in ints])
+        A = jnp.asarray(PP.to_plane(a, 1))
+        got = PP.from_plane(np.asarray(PP.fe_mul(A, A, 1)), B)
+        for i in range(0, B, 53):
+            assert F.fq_to_int(got[i]) == (ints[i] * ints[i]) % F.P_INT
+
+    def test_fq2_mul_vs_oracle(self):
+        rng = random.Random(12)
+        a2 = [(rng.randrange(F.P_INT), rng.randrange(F.P_INT))
+              for _ in range(B)]
+        b2 = [(rng.randrange(F.P_INT), rng.randrange(F.P_INT))
+              for _ in range(B)]
+        A = jnp.asarray(PP.to_plane(
+            np.stack([F.fq2_from_ints(*x) for x in a2]), 2))
+        Bb = jnp.asarray(PP.to_plane(
+            np.stack([F.fq2_from_ints(*x) for x in b2]), 2))
+        got = PP.from_plane(np.asarray(PP.fe_mul(A, Bb, 2)), B)
+        for i in range(0, B, 97):
+            want = PF.fq2_mul(a2[i], b2[i])
+            assert (F.fq_to_int(got[i][0]), F.fq_to_int(got[i][1])) == want
+
+
+class TestPointKernels:
+    @classmethod
+    def setup_class(cls):
+        rng = random.Random(13)
+        g2 = PC.g2_generator()
+        cls.pts = [PC.jac_mul(PC.Fq2Ops, g2, rng.randrange(1, PF.R))
+                   for _ in range(8)]
+        reps = B // len(cls.pts)
+        X = np.stack([np.stack([F.fq_from_int(p[0][0]),
+                                F.fq_from_int(p[0][1])])
+                      for p in cls.pts] * reps)
+        Y = np.stack([np.stack([F.fq_from_int(p[1][0]),
+                                F.fq_from_int(p[1][1])])
+                      for p in cls.pts] * reps)
+        Z = np.stack([np.stack([F.fq_from_int(p[2][0]),
+                                F.fq_from_int(p[2][1])])
+                      for p in cls.pts] * reps)
+        cls.P = PP.PlanePoint.from_jacobian_arrays(X, Y, Z, 2)
+
+    def test_double_add_and_edges_vs_oracle(self):
+        P = self.P
+        D = PP.pt_double(P)
+        S = PP.pt_add(P, D)
+        for i in range(8):
+            wd = PC.to_affine(PC.Fq2Ops, PC.jac_double(PC.Fq2Ops, self.pts[i]))
+            ws = PC.to_affine(PC.Fq2Ops, PC.jac_add(
+                PC.Fq2Ops, self.pts[i],
+                PC.jac_double(PC.Fq2Ops, self.pts[i])))
+            assert PC.to_affine(PC.Fq2Ops, _plane_pt_to_int(D, i)) == wd
+            assert PC.to_affine(PC.Fq2Ops, _plane_pt_to_int(S, i)) == ws
+
+        # P + P -> double; P + ∞ -> P; ∞ + P -> P; P + (−P) -> ∞
+        S4 = PP.pt_add(P, P)
+        INF = PP.PlanePoint(P.X * 0, P.Y * 0, P.Z * 0, 2, P.B)
+        S2 = PP.pt_add(P, INF)
+        S3 = PP.pt_add(INF, P)
+        neg = [(p[0], PF.fq2_neg(p[1]), p[2]) for p in self.pts]
+        reps = B // len(self.pts)
+        Xn = np.stack([np.stack([F.fq_from_int(p[0][0]),
+                                 F.fq_from_int(p[0][1])]) for p in neg] * reps)
+        Yn = np.stack([np.stack([F.fq_from_int(p[1][0]),
+                                 F.fq_from_int(p[1][1])]) for p in neg] * reps)
+        Zn = np.stack([np.stack([F.fq_from_int(p[2][0]),
+                                 F.fq_from_int(p[2][1])]) for p in neg] * reps)
+        N = PP.PlanePoint.from_jacobian_arrays(Xn, Yn, Zn, 2)
+        Sn = PP.pt_add(P, N)
+        for i in range(8):
+            aff = PC.to_affine(PC.Fq2Ops, self.pts[i])
+            assert PC.to_affine(PC.Fq2Ops, _plane_pt_to_int(S4, i)) == \
+                PC.to_affine(PC.Fq2Ops, PC.jac_double(PC.Fq2Ops, self.pts[i]))
+            assert PC.to_affine(PC.Fq2Ops, _plane_pt_to_int(S2, i)) == aff
+            assert PC.to_affine(PC.Fq2Ops, _plane_pt_to_int(S3, i)) == aff
+            zi = _plane_pt_to_int(Sn, i)[2]
+            assert zi == (0, 0)
+
+    def test_g1_double_vs_oracle(self):
+        rng = random.Random(14)
+        g1 = PC.g1_generator()
+        pts = [PC.jac_mul(PC.FqOps, g1, rng.randrange(1, PF.R))
+               for _ in range(4)]
+        reps = B // len(pts)
+        X = np.stack([F.fq_from_int(p[0]) for p in pts] * reps)
+        Y = np.stack([F.fq_from_int(p[1]) for p in pts] * reps)
+        Z = np.stack([F.fq_from_int(p[2]) for p in pts] * reps)
+        P = PP.PlanePoint.from_jacobian_arrays(X, Y, Z, 1)
+        D = PP.pt_double(P)
+        for i in range(4):
+            assert PC.to_affine(PC.FqOps, _plane_pt_to_int(D, i)) == \
+                PC.to_affine(PC.FqOps, PC.jac_double(PC.FqOps, pts[i]))

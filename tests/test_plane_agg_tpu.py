@@ -1,0 +1,60 @@
+"""Device aggregate + RLC verify against the native oracle — REAL TPU only.
+
+The CI mesh (tests/conftest.py) forces CPU, where the 256-step device sweep
+in pallas interpret mode would take hours, so these tests skip themselves
+unless a TPU backend is live (run manually: `python -m pytest
+tests/test_plane_agg_tpu.py` with conftest's platform pin removed, or via
+bench.py which exercises the same paths at the 1000-validator shape).
+The CPU-reachable kernel correctness coverage lives in test_pallas_plane.py;
+the cross-implementation bit-identity suite in test_crypto.py covers
+TPUImpl's native fallback paths.
+"""
+
+import random
+
+import pytest
+
+import jax
+
+pytestmark = pytest.mark.skipif(
+    jax.default_backend() == "cpu",
+    reason="device sweep needs a real TPU (interpret mode: hours)")
+
+
+def test_aggregate_and_rlc_verify_vs_native():
+    from charon_tpu.ops import plane_agg
+    from charon_tpu.tbls.native_impl import NativeImpl
+    from charon_tpu.crypto.hash_to_curve import hash_to_g2
+
+    rng = random.Random(42)
+    native = NativeImpl()
+    msg = b"\x42" * 32
+    V = 64
+    batches, pks, want = [], [], []
+    for _ in range(V):
+        sk = native.generate_secret_key()
+        pks.append(bytes(native.secret_to_public_key(sk)))
+        shares = native.threshold_split(sk, 6, 4)
+        ids = sorted(rng.sample(range(1, 7), 4))
+        partials = {i: native.sign(shares[i], msg) for i in ids}
+        batches.append({i: bytes(s) for i, s in partials.items()})
+        want.append(bytes(native.threshold_aggregate(partials)))
+
+    got = plane_agg.threshold_aggregate_batch(batches)
+    assert [bytes(g) for g in got] == want  # bit-identity
+
+    assert plane_agg.rlc_verify_batch(pks, [msg] * V, got, hash_to_g2)
+    bad = list(got)
+    bad[10] = got[11]
+    assert not plane_agg.rlc_verify_batch(pks, [msg] * V, bad, hash_to_g2)
+
+    # distinct messages form separate pairing groups
+    msgs = [msg if i % 2 == 0 else b"\x43" * 32 for i in range(V)]
+    pks2, sigs2 = [], []
+    for i in range(V):
+        sk = native.generate_secret_key()
+        pks2.append(bytes(native.secret_to_public_key(sk)))
+        sigs2.append(bytes(native.sign(sk, msgs[i])))
+    assert plane_agg.rlc_verify_batch(pks2, msgs, sigs2, hash_to_g2)
+    sigs2[0] = sigs2[1]
+    assert not plane_agg.rlc_verify_batch(pks2, msgs, sigs2, hash_to_g2)

@@ -1,0 +1,151 @@
+"""TPUImpl dispatch + input-gating coverage that runs on the CPU CI mesh.
+
+The device sweep itself is exercised on hardware (tests/test_plane_agg_tpu.py,
+bench.py); here the sweep is stubbed so the routing policy — batch-size
+threshold, byte handoff, native fallback — and the BLS input gates
+(infinity / subgroup rejection, matching native ct_verify semantics,
+reference tbls verify behavior) regress loudly on every CI run."""
+
+import random
+
+import pytest
+
+from charon_tpu.tbls.native_impl import NativeImpl, NativeUnavailable
+from charon_tpu.tbls.tpu_impl import TPUImpl
+from charon_tpu.tbls.types import PublicKey, Signature
+
+try:
+    NativeImpl()
+except NativeUnavailable:  # pragma: no cover - toolchain always present in CI
+    pytest.skip("native library unavailable", allow_module_level=True)
+
+
+def _fixtures(n, msg):
+    native = NativeImpl()
+    pks, sigs = [], []
+    for _ in range(n):
+        sk = native.generate_secret_key()
+        pks.append(native.secret_to_public_key(sk))
+        sigs.append(native.sign(sk, msg))
+    return pks, sigs
+
+
+def test_device_branch_dispatch(monkeypatch):
+    """At min_device_batch the device branch engages and hands plane_agg the
+    raw bytes; below it the native path runs."""
+    from charon_tpu.ops import plane_agg
+    from charon_tpu.tbls import tpu_impl as tpu_mod
+
+    impl = TPUImpl()
+    impl.min_device_batch = 2
+    monkeypatch.setattr(tpu_mod, "_on_device", lambda: True)
+
+    calls = {}
+
+    def fake_rlc(pks, datas, sigs, hash_fn):
+        calls["args"] = (pks, datas, sigs)
+        return True
+
+    monkeypatch.setattr(plane_agg, "rlc_verify_batch", fake_rlc)
+    msg = b"\x55" * 32
+    pks, sigs = _fixtures(3, msg)
+    assert impl.verify_batch(pks, [msg] * 3, sigs)
+    got_pks, got_datas, got_sigs = calls["args"]
+    assert got_pks == [bytes(p) for p in pks]
+    assert got_sigs == [bytes(s) for s in sigs]
+    assert got_datas == [msg] * 3
+
+    # below the threshold the native path runs instead (no stub call)
+    calls.clear()
+    impl.min_device_batch = 64
+    assert impl.verify_batch(pks, [msg] * 3, sigs)
+    assert not calls
+
+
+def test_aggregate_batch_dispatch(monkeypatch):
+    from charon_tpu.ops import plane_agg
+    from charon_tpu.tbls import tpu_impl as tpu_mod
+
+    native = NativeImpl()
+    impl = TPUImpl()
+    impl.min_device_batch = 2
+    monkeypatch.setattr(tpu_mod, "_on_device", lambda: True)
+
+    msg = b"\x66" * 32
+    rng = random.Random(7)
+    batches, want = [], []
+    for _ in range(3):
+        sk = native.generate_secret_key()
+        shares = native.threshold_split(sk, 5, 3)
+        ids = sorted(rng.sample(range(1, 6), 3))
+        b = {i: native.sign(shares[i], msg) for i in ids}
+        batches.append(b)
+        want.append(bytes(native.threshold_aggregate(b)))
+
+    seen = {}
+
+    def fake_agg(raw_batches):
+        seen["batches"] = raw_batches
+        return [native.threshold_aggregate(
+            {i: Signature(s) for i, s in rb.items()}) for rb in raw_batches]
+
+    monkeypatch.setattr(plane_agg, "threshold_aggregate_batch", fake_agg)
+    got = impl.threshold_aggregate_batch(batches)
+    assert [bytes(g) for g in got] == want
+    assert seen["batches"] == [
+        {i: bytes(s) for i, s in b.items()} for b in batches]
+
+
+def test_rlc_loader_rejects_infinity_and_bad_points():
+    """BLS verify semantics: infinity pubkey/signature is invalid (native
+    ct_verify's jac_is_inf gate); non-decodable points raise."""
+    from charon_tpu.ops import plane_agg
+
+    inf_g1 = b"\xc0" + bytes(47)
+    inf_g2 = b"\xc0" + bytes(95)
+    with pytest.raises(ValueError):
+        plane_agg.g1_plane_from_compressed([inf_g1], 1024,
+                                           reject_infinity=True)
+    with pytest.raises(ValueError):
+        plane_agg.g2_plane_from_compressed([inf_g2], 1024,
+                                           reject_infinity=True)
+    with pytest.raises(ValueError):
+        plane_agg.g1_plane_from_compressed([b"\xff" * 48], 1024)
+    with pytest.raises(ValueError):
+        plane_agg.g2_plane_from_compressed([b"\xff" * 96], 1024)
+    # and rlc_verify_batch converts the gate into a False, not an exception
+    msg = b"\x01" * 32
+    pks, sigs = _fixtures(1, msg)
+    from charon_tpu.crypto.hash_to_curve import hash_to_g2
+
+    assert plane_agg.rlc_verify_batch(
+        [bytes(pks[0]), inf_g1], [msg, msg],
+        [bytes(sigs[0]), inf_g2], hash_to_g2) is False
+
+
+def test_bulk_uncompress_roundtrip_and_subgroup_flag():
+    """Native bulk decompression agrees with the python deserializer and
+    enforces subgroup membership when asked."""
+    import numpy as np
+
+    from charon_tpu.crypto.serialize import g1_from_bytes, g2_from_bytes
+    from charon_tpu.crypto.curve import FqOps, Fq2Ops, to_affine
+    from charon_tpu.ops import plane_agg
+    from charon_tpu.ops import pallas_plane as PP
+    from charon_tpu.ops import field as F
+
+    msg = b"\x02" * 32
+    pks, sigs = _fixtures(4, msg)
+    plane = plane_agg.g2_plane_from_compressed(
+        [bytes(s) for s in sigs], 1024, check_subgroup=True)
+    flat = PP.from_plane(np.asarray(plane.X), 4)
+    for i in range(4):
+        want = to_affine(Fq2Ops, g2_from_bytes(bytes(sigs[i])))[0]
+        got = (F.fq_to_int(flat[i][0]), F.fq_to_int(flat[i][1]))
+        assert got == want
+    plane1 = plane_agg.g1_plane_from_compressed(
+        [bytes(p) for p in pks], 1024, check_subgroup=True)
+    flat1 = PP.from_plane(np.asarray(plane1.X), 4)
+    for i in range(4):
+        assert F.fq_to_int(flat1[i]) == to_affine(
+            FqOps, g1_from_bytes(bytes(pks[i])))[0]
