@@ -27,8 +27,11 @@ from ..core.gater import new_duty_gater
 from ..core.interfaces import WithAsyncRetry, WithTracing, WithTracking, wire
 from ..core.vapi_router import VapiRouter
 from ..eth2.beacon import ValidatorCache
-from ..p2p import (ConsensusTCPEndpoint, ParSigExTCPTransport, PeerInfo,
-                   PeerSpec, PingService, RelayClient, TCPNode)
+from ..core import infosync as infosync_mod, priority as priority_mod
+from ..p2p import (PROTO_CONSENSUS, PROTO_PARSIGEX, PROTO_PRIORITY,
+                   ConsensusTCPEndpoint, ParSigExTCPTransport, PeerInfo,
+                   PeerSpec, PingService, PriorityTCPTransport, RelayClient,
+                   TCPNode)
 from ..utils import errors, expbackoff, k1util, log, metrics
 from ..utils import retry as retry_util
 from ..utils.privkeylock import PrivKeyLock
@@ -61,6 +64,7 @@ class Config:
     monitoring_host: str = "127.0.0.1"
     monitoring_port: int = 0
     beacon_urls: list[str] = field(default_factory=list)
+    synthetic_proposals: bool = False
     consensus_type: str = "qbft"
     test: TestConfig = field(default_factory=TestConfig)
 
@@ -82,6 +86,9 @@ class App:
     keys: object
     lock: object
     privkey_lock: PrivKeyLock | None
+    infosync: infosync_mod.InfoSync | None = None
+    recaster: bcast_mod.Recaster | None = None
+    beacon: object = None
     tasks: list[asyncio.Task] = field(default_factory=list)
     _dbs: list = field(default_factory=list)
 
@@ -120,11 +127,24 @@ class App:
         await self.vapi_router.stop()
         await self.monitoring.stop()
         await self.node.stop()
+        # close HTTP beacon client sessions (lazy aiohttp connectors).
+        # Type-based unwrap: MultiBeaconNode.__getattr__ fans out ANY missing
+        # attribute, so duck-typed getattr probes would mis-resolve on it.
+        from ..eth2.beacon import MultiBeaconNode, SyntheticProposals
+
+        b = self.beacon
+        if isinstance(b, SyntheticProposals):
+            b = b._inner
+        nodes = b.nodes if isinstance(b, MultiBeaconNode) else [b]
+        closers = [n.close() for n in nodes
+                   if n is not None and hasattr(type(n), "close")]
+        if closers:
+            await asyncio.gather(*closers, return_exceptions=True)
         if self.privkey_lock is not None:
             self.privkey_lock.release()
 
 
-def assemble(config: Config) -> App:
+async def assemble(config: Config) -> App:
     """Build (but do not start) a node from config + disk state."""
     test = config.test
     privkey_lock = None
@@ -163,13 +183,24 @@ def assemble(config: Config) -> App:
     ping = PingService(node)
     peerinfo = PeerInfo(node)
 
-    # beacon client
+    # beacon client: injected mock (simnet) or HTTP endpoints with
+    # parallel-first-success failover (reference eth2wrap.NewMultiHTTP
+    # app/eth2wrap/eth2wrap.go:72,100)
     beacon = test.beacon
     if beacon is None:
-        raise errors.new(
-            "no beacon source: provide TestConfig.beacon (simnet) — "
-            "HTTP beacon-node client wiring requires beacon_urls support")
-    chain = beacon._spec if hasattr(beacon, "_spec") else beacon.chain
+        if not config.beacon_urls:
+            raise errors.new("no beacon source: configure beacon_urls or "
+                             "TestConfig.beacon")
+        from ..eth2.beacon import MultiBeaconNode
+        from ..eth2.http_beacon import HTTPBeaconNode
+
+        nodes = [HTTPBeaconNode(u) for u in config.beacon_urls]
+        beacon = MultiBeaconNode(nodes) if len(nodes) > 1 else nodes[0]
+    if config.synthetic_proposals:
+        from ..eth2.beacon import SyntheticProposals
+
+        beacon = SyntheticProposals(beacon)
+    chain = await beacon.spec()
 
     # core pipeline (reference wireCoreWorkflow)
     deadline_fn = new_duty_deadline_func(chain)
@@ -213,6 +244,21 @@ def assemble(config: Config) -> App:
          aggsig_db, caster,
          options=[WithAsyncRetry(retryer), WithTracing(), WithTracking(track)])
 
+    # priority/infosync: agree versions + protocols cluster-wide each epoch
+    # (reference core/priority/prioritiser.go:39, core/infosync/infosync.go:21)
+    from ..utils import version as version_mod
+
+    prioritiser = priority_mod.Prioritiser(
+        PriorityTCPTransport(node), consensus, peer_idx=my_idx,
+        nodes=num_nodes, quorum=keys.threshold,
+        exchange_timeout=max(chain.seconds_per_slot / 2, 0.2))
+    info_sync = infosync_mod.InfoSync(
+        prioritiser,
+        versions=[f"charon-tpu/{version_mod.VERSION}"],
+        protocols=[PROTO_CONSENSUS, PROTO_PARSIGEX, PROTO_PRIORITY],
+        proposal_types=["full", "builder"])
+    sched.subscribe_slots(info_sync.on_slot)
+
     # feed broadcast attestations to the inclusion checker (reference wires
     # the tracker's InclusionChecker off sigagg output, inclusion.go:52)
     from ..core.signeddata import SignedAttestation
@@ -226,6 +272,11 @@ def assemble(config: Config) -> App:
 
     agg.subscribe(feed_inclusion)
 
+    # registration re-broadcast every epoch (reference core/bcast/recast.go)
+    recaster = bcast_mod.Recaster(beacon)
+    agg.subscribe(recaster.on_broadcast)
+    sched.subscribe_slots(recaster.on_slot)
+
     vapi_router = VapiRouter(vapi, bn_base_url=config.beacon_urls[0] if config.beacon_urls else None,
                              host=config.vapi_host, port=config.vapi_port)
     quorum = keys.threshold
@@ -235,10 +286,11 @@ def assemble(config: Config) -> App:
     health = Checker(quorum_peers=quorum)
 
     app = App(config=config, node=node, sched=sched, vapi=vapi,
+              recaster=recaster, beacon=beacon,
               vapi_router=vapi_router, monitoring=monitoring, tracker=track,
               inclusion=inclusion, health=health, ping=ping, peerinfo=peerinfo,
               relay_client=relay_client, keys=keys, lock=lock,
-              privkey_lock=privkey_lock,
+              privkey_lock=privkey_lock, infosync=info_sync,
               _dbs=[duty_db.run_gc, parsig_db.run_trim, aggsig_db.run_gc,
                     consensus.run_trim])
 
@@ -252,7 +304,7 @@ def assemble(config: Config) -> App:
 
 async def run(config: Config) -> None:
     """Assemble, start, and serve until cancelled (the CLI `run` command)."""
-    app = assemble(config)
+    app = await assemble(config)
     await app.start()
     try:
         while True:

@@ -110,3 +110,41 @@ def _to_spec_exit(d: SignedExit):
     from ..eth2 import spec
 
     return spec.SignedVoluntaryExit(d.exit, d.sig)
+
+
+class Recaster:
+    """Re-broadcasts validator registrations every epoch (reference
+    core/bcast/recast.go:31,106): builder registrations only take effect
+    while the relay keeps seeing them, so the latest signed registration per
+    validator is replayed at each epoch head even though the VC only submits
+    it once."""
+
+    def __init__(self, beacon: BeaconNode):
+        self._beacon = beacon
+        self._regs: dict[str, object] = {}  # pubkey -> spec registration
+        self._last_epoch = -1
+
+    async def on_broadcast(self, duty: Duty, signed: SignedDataSet) -> None:
+        """sigagg/bcast subscriber: remember registrations as they flow."""
+        if duty.type != DutyType.BUILDER_REGISTRATION:
+            return
+        for pk, d in signed.items():
+            if isinstance(d, SignedRegistration):
+                self._regs[pk] = _to_spec_reg(d)
+
+    async def on_slot(self, slot) -> None:
+        """Scheduler slot subscriber: replay at each epoch head
+        (recast.go:106 SubscribeSlots)."""
+        if not getattr(slot, "first_in_epoch", False) or not self._regs:
+            return
+        epoch = getattr(slot, "epoch", None)
+        if epoch is not None and epoch == self._last_epoch:
+            return
+        self._last_epoch = epoch
+        try:
+            await self._beacon.submit_validator_registrations(
+                list(self._regs.values()))
+            _log.info("recast validator registrations",
+                      count=len(self._regs), epoch=epoch)
+        except Exception as exc:  # noqa: BLE001 — next epoch retries
+            _log.warn("recast failed", err=exc)

@@ -1,0 +1,77 @@
+"""Infosync — cluster-wide agreement on versions/protocols/proposal types
+(reference core/infosync/infosync.go:21-30).
+
+Every epoch, each node proposes the versions it supports, the p2p protocols
+it speaks (order of precedence), and the block-proposal types it can
+handle; the priority protocol (core/priority.py) computes and agrees the
+cluster-wide overlap, and the agreed result drives feature negotiation —
+a node never enables a protocol the cluster hasn't agreed to, so rolling
+upgrades are safe without a flag day.
+"""
+
+from __future__ import annotations
+
+from ..utils import log
+from .priority import Prioritiser, TopicProposal, TopicResult
+from .types import Duty
+
+_log = log.with_topic("infosync")
+
+TOPIC_VERSION = "version"
+TOPIC_PROTOCOL = "protocol"
+TOPIC_PROPOSAL = "proposal"
+
+
+class InfoSync:
+    """Ticks the priority protocol once per epoch and caches the agreed
+    result (reference infosync.New infosync.go:31)."""
+
+    def __init__(self, prioritiser: Prioritiser, versions: list[str],
+                 protocols: list[str], proposal_types: list[str]):
+        self._prio = prioritiser
+        self._versions = versions
+        self._protocols = protocols
+        self._proposals = proposal_types
+        self._agreed: dict[str, list[str]] = {}
+        self._last_epoch = -1
+        prioritiser.subscribe(self._on_result)
+
+    # -- agreed state ---------------------------------------------------------
+
+    def agreed(self, topic: str) -> list[str]:
+        return list(self._agreed.get(topic, []))
+
+    def agreed_version(self) -> str | None:
+        v = self._agreed.get(TOPIC_VERSION)
+        return v[0] if v else None
+
+    def agreed_protocols(self) -> list[str]:
+        return self.agreed(TOPIC_PROTOCOL)
+
+    # -- scheduler hook -------------------------------------------------------
+
+    async def on_slot(self, slot) -> None:
+        """Scheduler slot subscriber: run one instance at each epoch head
+        (reference infosync triggers on epoch boundaries)."""
+        if not getattr(slot, "first_in_epoch", False):
+            return
+        epoch = getattr(slot, "epoch", None)
+        if epoch is not None and epoch == self._last_epoch:
+            return
+        self._last_epoch = epoch
+        try:
+            await self._prio.prioritise(int(slot.slot), [
+                TopicProposal(TOPIC_VERSION, list(self._versions)),
+                TopicProposal(TOPIC_PROTOCOL, list(self._protocols)),
+                TopicProposal(TOPIC_PROPOSAL, list(self._proposals)),
+            ])
+        except Exception as exc:  # noqa: BLE001 — next epoch retries
+            _log.warn("infosync instance failed", err=exc,
+                      slot=int(slot.slot))
+
+    async def _on_result(self, duty: Duty, results: list[TopicResult]) -> None:
+        for r in results:
+            self._agreed[r.topic] = r.priorities
+        _log.info("infosync agreed", slot=duty.slot,
+                  version=self.agreed_version(),
+                  protocols=len(self.agreed(TOPIC_PROTOCOL)))

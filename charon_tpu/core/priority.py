@@ -1,0 +1,221 @@
+"""Cluster-priority protocol (reference core/priority/prioritiser.go).
+
+Flow per instance (reference prioritiser.go:3-16 doc): on a trigger, each
+node broadcasts its own ordered priority proposal for a set of topics to
+every peer (all-to-all, protocol charon/priority/2.0.0), collects the
+peers' proposals within a timeout, deterministically computes the
+cluster-wide overlap (priority/calculate.go), and then proposes the result
+to QBFT consensus so every honest node commits to the SAME result even if
+exchanges were partially observed. Subscribers receive the agreed result.
+
+Determinism: every node that saw the same proposal set computes an
+identical result, and consensus resolves the (benign) cases where timeouts
+cut the exchange differently on different nodes.
+
+Scoring (the reference's overlap function, re-derived not copied): a
+priority proposed by fewer than `quorum` peers is dropped (a minority
+cannot force a cluster-wide setting); the rest are ordered by the summed
+position weight Σ_peers (len(peer_list) − index), ties broken by the
+priority string, capped at MAX_RESULT priorities per topic.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import dataclasses
+from typing import Awaitable, Callable
+
+from ..utils import aio, errors, log, metrics
+from .types import Duty, DutyType
+
+_log = log.with_topic("priority")
+
+MAX_PRIORITIES = 8   # per topic per proposal (anti-DoS, matches wire cap)
+MAX_TOPICS = 8
+MAX_RESULT = 8
+
+_exchanged = metrics.counter(
+    "core_priority_exchanged_total", "Priority proposals exchanged")
+_agreed = metrics.counter(
+    "core_priority_agreed_total", "Priority instances agreed")
+
+
+@dataclasses.dataclass
+class TopicProposal:
+    topic: str
+    priorities: list[str]
+
+    def to_json(self) -> dict:
+        return {"topic": self.topic, "priorities": list(self.priorities)}
+
+    @classmethod
+    def from_json(cls, obj: dict) -> "TopicProposal":
+        return cls(str(obj["topic"]), [str(p) for p in obj["priorities"]])
+
+
+@dataclasses.dataclass
+class TopicResult:
+    topic: str
+    priorities: list[str]  # agreed order, highest first
+
+
+ResultSub = Callable[[Duty, list[TopicResult]], Awaitable[None]]
+
+
+def calculate(proposals: dict[int, list[TopicProposal]],
+              quorum: int) -> list[TopicResult]:
+    """Deterministic cluster-wide overlap of per-peer proposals
+    (reference priority/calculate.go)."""
+    by_topic: dict[str, dict[int, list[str]]] = {}
+    for peer, topics in proposals.items():
+        for tp in topics[:MAX_TOPICS]:
+            by_topic.setdefault(tp.topic, {})[peer] = \
+                tp.priorities[:MAX_PRIORITIES]
+    results = []
+    for topic in sorted(by_topic):
+        peer_lists = by_topic[topic]
+        counts: dict[str, int] = {}
+        scores: dict[str, int] = {}
+        for plist in peer_lists.values():
+            # dedupe within one peer's list: a single peer repeating a
+            # priority must count once toward quorum (Byzantine resistance)
+            plist = list(dict.fromkeys(plist))
+            n = len(plist)
+            for i, prio in enumerate(plist):
+                counts[prio] = counts.get(prio, 0) + 1
+                scores[prio] = scores.get(prio, 0) + (n - i)
+        kept = [p for p, c in counts.items() if c >= quorum]
+        kept.sort(key=lambda p: (-scores[p], p))
+        results.append(TopicResult(topic, kept[:MAX_RESULT]))
+    return results
+
+
+class Prioritiser:
+    """Exchange + consensus driver for priority instances
+    (reference priority.Component prioritiser.go:39).
+
+    transport: register(handler) + async broadcast(slot, topics_json) to all
+    other peers (sender identity is authenticated by the p2p channel).
+    consensus: the QBFT component's propose_priority/subscribe_priority pair.
+    """
+
+    def __init__(self, transport, consensus, peer_idx: int, nodes: int,
+                 quorum: int, exchange_timeout: float = 2.0):
+        self._transport = transport
+        self._consensus = consensus
+        self._peer_idx = peer_idx
+        self._nodes = nodes
+        self._quorum = quorum
+        self._timeout = exchange_timeout
+        self._subs: list[ResultSub] = []
+        # slot -> peer -> proposals; plus a wakeup event per slot
+        self._received: dict[int, dict[int, list[TopicProposal]]] = {}
+        self._events: dict[int, asyncio.Event] = {}
+        transport.register(self._on_message)
+        consensus.subscribe_priority(self._on_decided)
+
+    def subscribe(self, fn: ResultSub) -> None:
+        self._subs.append(fn)
+
+    async def prioritise(self, slot: int,
+                         topics: list[TopicProposal]) -> None:
+        """Run one instance: broadcast ours, collect, calculate, consense
+        (reference Prioritiser.Prioritise)."""
+        duty = Duty(slot, DutyType.INFO_SYNC)
+        rec = self._received.setdefault(slot, {})
+        rec[self._peer_idx] = topics
+        ev = self._events.setdefault(slot, asyncio.Event())
+        await self._transport.broadcast(
+            slot, [t.to_json() for t in topics])
+        _exchanged.inc()
+
+        deadline = asyncio.get_running_loop().time() + self._timeout
+        while len(rec) < self._nodes:
+            remaining = deadline - asyncio.get_running_loop().time()
+            if remaining <= 0:
+                break
+            ev.clear()
+            try:
+                await asyncio.wait_for(ev.wait(), timeout=remaining)
+            except asyncio.TimeoutError:
+                break
+        try:
+            if len(rec) < self._quorum:
+                raise errors.new("insufficient priority exchanges",
+                                 got=len(rec), quorum=self._quorum)
+
+            result = calculate(dict(rec), self._quorum)
+            payload = {"topics": [
+                {"topic": r.topic, "priorities": r.priorities}
+                for r in result]}
+            await self._consensus.propose_priority(duty, payload)
+        finally:
+            # cleanup even on failure; late exchanges re-inserting a slot are
+            # bounded by _trim below
+            self._received.pop(slot, None)
+            self._events.pop(slot, None)
+
+    # Bound on per-slot exchange state: peers (or late messages) can insert
+    # entries for arbitrary slots; keep only the most recent few instances.
+    MAX_PENDING_SLOTS = 16
+
+    def _trim(self) -> None:
+        while len(self._received) > self.MAX_PENDING_SLOTS:
+            oldest = min(self._received)
+            self._received.pop(oldest, None)
+            self._events.pop(oldest, None)
+
+    async def _on_message(self, sender_idx: int, slot: int,
+                          topics_json: list) -> None:
+        if sender_idx == self._peer_idx or len(topics_json) > MAX_TOPICS:
+            return
+        rec = self._received.setdefault(slot, {})
+        rec[sender_idx] = [TopicProposal.from_json(t) for t in topics_json]
+        ev = self._events.setdefault(slot, asyncio.Event())
+        ev.set()
+        self._trim()
+
+    async def _on_decided(self, duty: Duty, payload: dict) -> None:
+        if duty.type != DutyType.INFO_SYNC:
+            return
+        _agreed.inc()
+        results = [TopicResult(str(t["topic"]),
+                               [str(p) for p in t["priorities"]])
+                   for t in payload.get("topics", [])]
+        for fn in self._subs:
+            try:
+                await fn(duty, results)
+            except Exception as exc:  # noqa: BLE001 — subscriber isolation
+                _log.warn("priority subscriber failed", err=exc)
+
+
+class MemPriorityTransport:
+    """In-memory all-to-all priority exchange fabric for tests
+    (the reference's test transports pattern, core/priority tests)."""
+
+    def __init__(self) -> None:
+        self._handlers: dict[int, Callable] = {}
+        self._next = 0
+
+    def endpoint(self) -> "MemPriorityEndpoint":
+        idx = self._next
+        self._next += 1
+        return MemPriorityEndpoint(self, idx)
+
+    def deliver(self, from_idx: int, slot: int, topics_json: list) -> None:
+        for idx, h in self._handlers.items():
+            if idx != from_idx and h is not None:
+                aio.spawn(h(from_idx, slot, topics_json),
+                          name=f"priority-deliver-{idx}")
+
+
+class MemPriorityEndpoint:
+    def __init__(self, fabric: MemPriorityTransport, idx: int):
+        self._fabric = fabric
+        self.idx = idx
+
+    def register(self, handler) -> None:
+        self._fabric._handlers[self.idx] = handler
+
+    async def broadcast(self, slot: int, topics_json: list) -> None:
+        self._fabric.deliver(self.idx, slot, topics_json)

@@ -148,3 +148,62 @@ class ValidatorCache:
         """validator index -> pubkey for active validators."""
         vals = await self.get(epoch)
         return {v.index: pk for pk, v in vals.items() if v.is_active()}
+
+
+class SyntheticProposals:
+    """BeaconNode wrapper fabricating block proposals for rare-duty testing
+    (reference app/eth2wrap/synthproposer.go:38, flag cmd/run.go:81).
+
+    Real proposer duties for a small validator set are rare; with this
+    wrapper every epoch deterministically assigns one synthetic proposal per
+    validator set so clusters exercise the full proposal pipeline. Synthetic
+    blocks carry a marker graffiti and are swallowed on submission instead
+    of reaching the real BN."""
+
+    MARKER = b"charon-tpu/synth"
+
+    def __init__(self, inner: BeaconNode):
+        self._inner = inner
+        self.synthetic_submissions: list = []
+        self._synthetic_slots: set[int] = set()
+
+    def __getattr__(self, attr):
+        return getattr(self._inner, attr)
+
+    async def proposer_duties(self, epoch: int, indices: list[int]):
+        real = await self._inner.proposer_duties(epoch, indices)
+        if real or not indices:
+            return real
+        spec_obj = await self._inner.spec()
+        wanted = sorted(indices)
+        idx = wanted[epoch % len(wanted)]
+        # resolve the pubkey via attester duties for our own indices only
+        # (never an unbounded validator query against a real BN)
+        atts = await self._inner.attester_duties(epoch, [idx])
+        if not atts:
+            return real
+        pubkey = atts[0].pubkey
+        slot = epoch * spec_obj.slots_per_epoch + (idx % spec_obj.slots_per_epoch)
+        self._synthetic_slots.add(slot)
+        if len(self._synthetic_slots) > 1024:
+            self._synthetic_slots = set(
+                sorted(self._synthetic_slots)[-256:])
+        return [ProposerDuty(pubkey=pubkey, slot=slot, validator_index=idx)]
+
+    async def block_proposal(self, slot: int, randao_reveal: bytes,
+                             graffiti: bytes = b"", blinded: bool = False):
+        # only proposals for slots WE fabricated get the marker graffiti;
+        # real proposer duties pass through untouched
+        if slot in self._synthetic_slots:
+            graffiti = self.MARKER
+        return await self._inner.block_proposal(
+            slot, randao_reveal, graffiti, blinded)
+
+    async def submit_block(self, block) -> None:
+        """Swallow only OUR synthetic proposals; real blocks always reach
+        the BN (the reference's synthproposer gates on its marker the same
+        way — silently dropping a real proposal would forfeit rewards)."""
+        if getattr(block.message, "slot", None) in self._synthetic_slots:
+            self.synthetic_submissions.append(block)
+            return
+        await self._inner.submit_block(block)

@@ -1,0 +1,236 @@
+"""HTTP beacon-node client — the eth2wrap analogue for a real BN.
+
+Implements the BeaconNode protocol (eth2/beacon.py) against the standard
+beacon-API REST surface (reference app/eth2wrap: generated HTTP client +
+NewMultiHTTP, eth2wrap.go:72). Failover across endpoints comes from
+MultiBeaconNode (parallel first-success, eth2wrap.go:100); this class adds
+the per-endpoint behaviors:
+
+  * lazy connect/reconnect (reference app/eth2wrap/lazy.go:16): the aiohttp
+    session is created on first use and torn down + rebuilt after any
+    transport error, so a BN restart never wedges the client;
+  * per-endpoint latency/error metrics (eth2wrap.go:317-329).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from typing import Any
+
+from ..utils import errors, log, metrics
+from . import json_codec as jc
+from . import spec
+
+_log = log.with_topic("eth2wrap")
+
+_latency = metrics.histogram(
+    "app_eth2_request_duration_seconds", "BN request latency",
+    ("endpoint",), buckets=(0.01, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5))
+_errors_c = metrics.counter(
+    "app_eth2_request_errors_total", "BN request errors", ("endpoint",))
+
+
+class HTTPBeaconNode:
+    """One beacon node over HTTP (aiohttp), lazily connected."""
+
+    def __init__(self, base_url: str, timeout: float = 10.0):
+        self.base_url = base_url.rstrip("/")
+        self.name = self.base_url
+        self._timeout = timeout
+        self._session = None  # lazy (reference lazy.go)
+
+    async def _sess(self):
+        if self._session is None or self._session.closed:
+            import aiohttp
+
+            self._session = aiohttp.ClientSession(
+                timeout=aiohttp.ClientTimeout(total=self._timeout))
+        return self._session
+
+    async def close(self) -> None:
+        if self._session is not None and not self._session.closed:
+            await self._session.close()
+
+    async def _req(self, method: str, path: str, *, params: dict | None = None,
+                   body: Any = None) -> Any:
+        url = self.base_url + path
+        t0 = time.monotonic()
+        try:
+            sess = await self._sess()
+            async with sess.request(method, url, params=params,
+                                    json=body) as resp:
+                if resp.status // 100 != 2:
+                    text = await resp.text()
+                    _errors_c.inc(self.base_url)
+                    raise errors.new("beacon request failed",
+                                     status=resp.status, path=path,
+                                     detail=text[:200])
+                payload = await resp.text()
+        except errors.CharonError:
+            raise
+        except Exception as exc:  # noqa: BLE001 — transport error: reconnect
+            _errors_c.inc(self.base_url)
+            # lazy reconnect: drop the session so the next call rebuilds it
+            try:
+                if self._session is not None:
+                    await self._session.close()
+            finally:
+                self._session = None
+            raise errors.new("beacon transport error", path=path,
+                             err=str(exc))
+        finally:
+            _latency.observe(time.monotonic() - t0, self.base_url)
+        obj = json.loads(payload) if payload else {}
+        return obj.get("data", obj)
+
+    # -- chain info -----------------------------------------------------------
+
+    async def spec(self) -> spec.ChainSpec:
+        gen = await self._req("GET", "/eth/v1/beacon/genesis")
+        cfg = await self._req("GET", "/eth/v1/config/spec")
+        gt = float(gen.get("genesis_time_frac", gen["genesis_time"]))
+        return spec.ChainSpec(
+            genesis_time=gt,
+            genesis_validators_root=bytes.fromhex(
+                gen["genesis_validators_root"][2:]),
+            seconds_per_slot=float(cfg.get("SECONDS_PER_SLOT", 12)),
+            slots_per_epoch=int(cfg.get("SLOTS_PER_EPOCH", 32)),
+            epochs_per_sync_committee_period=int(
+                cfg.get("EPOCHS_PER_SYNC_COMMITTEE_PERIOD", 256)),
+        )
+
+    async def node_syncing(self) -> bool:
+        data = await self._req("GET", "/eth/v1/node/syncing")
+        return bool(data["is_syncing"])
+
+    async def validators_by_pubkey(
+            self, pubkeys: list[bytes]) -> dict[bytes, spec.Validator]:
+        data = await self._req(
+            "POST", "/eth/v1/beacon/states/head/validators",
+            body={"ids": ["0x" + bytes(pk).hex() for pk in pubkeys]})
+        out = {}
+        for item in data:
+            v = spec.Validator(
+                index=int(item["index"]),
+                pubkey=bytes.fromhex(item["validator"]["pubkey"][2:]),
+                status=item.get("status", "active_ongoing"),
+                effective_balance=int(
+                    item["validator"].get("effective_balance", 32 * 10**9)),
+                activation_epoch=int(
+                    item["validator"].get("activation_epoch", 0)),
+                withdrawal_credentials=bytes.fromhex(
+                    item["validator"].get("withdrawal_credentials",
+                                          "0x" + "00" * 32)[2:]),
+            )
+            out[v.pubkey] = v
+        return out
+
+    # -- duties ---------------------------------------------------------------
+
+    async def attester_duties(self, epoch, indices):
+        data = await self._req(
+            "POST", f"/eth/v1/validator/duties/attester/{epoch}",
+            body=[str(i) for i in indices])
+        return [jc.decode_attester_duty(o) for o in data]
+
+    async def proposer_duties(self, epoch, indices):
+        data = await self._req(
+            "GET", f"/eth/v1/validator/duties/proposer/{epoch}")
+        wanted = set(indices)
+        return [d for d in (jc.decode_proposer_duty(o) for o in data)
+                if d.validator_index in wanted]
+
+    async def sync_committee_duties(self, epoch, indices):
+        data = await self._req(
+            "POST", f"/eth/v1/validator/duties/sync/{epoch}",
+            body=[str(i) for i in indices])
+        return [jc.decode_sync_duty(o) for o in data]
+
+    # -- duty data ------------------------------------------------------------
+
+    async def attestation_data(self, slot, committee_index):
+        data = await self._req(
+            "GET", "/eth/v1/validator/attestation_data",
+            params={"slot": str(slot),
+                    "committee_index": str(committee_index)})
+        return jc.decode_container(spec.AttestationData, data)
+
+    async def aggregate_attestation(self, slot, att_data_root):
+        data = await self._req(
+            "GET", "/eth/v1/validator/aggregate_attestation",
+            params={"slot": str(slot),
+                    "attestation_data_root": "0x" + bytes(att_data_root).hex()})
+        return jc.decode_container(spec.Attestation, data)
+
+    async def block_proposal(self, slot, randao_reveal, graffiti=b"",
+                             blinded=False):
+        params = {"randao_reveal": "0x" + bytes(randao_reveal).hex()}
+        if graffiti:
+            params["graffiti"] = "0x" + bytes(graffiti).hex()
+        if blinded:
+            params["blinded"] = "true"
+        data = await self._req("GET", f"/eth/v2/validator/blocks/{slot}",
+                               params=params)
+        return jc.decode_beacon_block(data)
+
+    async def sync_committee_contribution(self, slot, subcommittee_index,
+                                          beacon_block_root):
+        data = await self._req(
+            "GET", "/eth/v1/validator/sync_committee_contribution",
+            params={"slot": str(slot),
+                    "subcommittee_index": str(subcommittee_index),
+                    "beacon_block_root":
+                        "0x" + bytes(beacon_block_root).hex()})
+        return jc.decode_container(spec.SyncCommitteeContribution, data)
+
+    # -- inclusion-checker surface -------------------------------------------
+
+    async def head_slot(self) -> int:
+        data = await self._req("GET", "/eth/v1/beacon/headers/head")
+        return int(data["header"]["message"]["slot"])
+
+    async def block_attestation_roots(self, slot: int) -> list[bytes]:
+        """Attestation data roots included in the block at `slot`, via the
+        STANDARD endpoint (/eth/v1/beacon/blocks/{id}/attestations) so real
+        beacon nodes serve it; roots are computed client-side."""
+        try:
+            data = await self._req(
+                "GET", f"/eth/v1/beacon/blocks/{slot}/attestations")
+        except errors.CharonError:
+            return []  # empty slot / pruned block
+        out = []
+        for o in data:
+            att = jc.decode_container(spec.Attestation, o)
+            out.append(att.data.hash_tree_root())
+        return out
+
+    # -- submissions ----------------------------------------------------------
+
+    async def submit_attestations(self, atts) -> None:
+        await self._req("POST", "/eth/v1/beacon/pool/attestations",
+                        body=[jc.encode_container(a) for a in atts])
+
+    async def submit_block(self, block) -> None:
+        await self._req("POST", "/eth/v2/beacon/blocks",
+                        body=jc.encode_signed_beacon_block(block))
+
+    async def submit_aggregate_and_proofs(self, aggs) -> None:
+        await self._req("POST", "/eth/v1/validator/aggregate_and_proofs",
+                        body=[jc.encode_container(a) for a in aggs])
+
+    async def submit_sync_messages(self, msgs) -> None:
+        await self._req("POST", "/eth/v1/beacon/pool/sync_committees",
+                        body=[jc.encode_container(m) for m in msgs])
+
+    async def submit_contribution_and_proofs(self, contribs) -> None:
+        await self._req("POST", "/eth/v1/validator/contribution_and_proofs",
+                        body=[jc.encode_container(c) for c in contribs])
+
+    async def submit_validator_registrations(self, regs) -> None:
+        await self._req("POST", "/eth/v1/validator/register_validator",
+                        body=[jc.encode_container(r) for r in regs])
+
+    async def submit_voluntary_exit(self, exit_) -> None:
+        await self._req("POST", "/eth/v1/beacon/pool/voluntary_exits",
+                        body=jc.encode_container(exit_))

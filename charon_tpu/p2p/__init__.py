@@ -12,9 +12,11 @@ from .adapters import (
     PROTO_CONSENSUS,
     PROTO_LEADERCAST,
     PROTO_PARSIGEX,
+    PROTO_PRIORITY,
     ConsensusTCPEndpoint,
     LeadercastTCPTransport,
     ParSigExTCPTransport,
+    PriorityTCPTransport,
 )
 from .channel import HandshakeError, SecureChannel, TCPFrameStream
 from .node import PeerSpec, TCPNode, peer_id
@@ -33,6 +35,8 @@ __all__ = [
     "PROTO_CONSENSUS",
     "PROTO_LEADERCAST",
     "PROTO_PARSIGEX",
+    "PROTO_PRIORITY",
+    "PriorityTCPTransport",
     "RelayClient",
     "RelayServer",
     "SecureChannel",

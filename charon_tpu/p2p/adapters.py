@@ -35,6 +35,10 @@ _log = log.with_topic("p2p")
 PROTO_PARSIGEX = "/charon/parsigex/2.0.0"
 PROTO_CONSENSUS = "/charon/consensus/qbft/2.0.0"
 PROTO_LEADERCAST = "/charon/leadercast/1.0.0"
+# NOTE: unlike its siblings this ID has no leading slash — matching the
+# reference, whose priority protocol is registered as "charon/priority/2.0.0"
+# (reference core/priority/prioritiser.go:39).
+PROTO_PRIORITY = "charon/priority/2.0.0"
 
 
 def _encode_duty(duty: Duty) -> dict:
@@ -95,6 +99,32 @@ class ConsensusTCPEndpoint:
         if self._handler is None:
             return None
         await self._handler(json.loads(payload.decode()))
+        return None
+
+
+class PriorityTCPTransport:
+    """Priority-protocol exchange over TCP (reference charon/priority/2.0.0,
+    core/priority/prioritiser.go:39). Sender identity comes from the
+    authenticated channel; payloads are bounded by the Prioritiser's caps."""
+
+    def __init__(self, node: TCPNode):
+        self._node = node
+        self._handler = None
+        node.register_handler(PROTO_PRIORITY, self._on_message)
+
+    def register(self, handler) -> None:
+        self._handler = handler
+
+    async def broadcast(self, slot: int, topics_json: list) -> None:
+        payload = json.dumps({"slot": slot, "topics": topics_json}).encode()
+        self._node.broadcast(PROTO_PRIORITY, payload)
+
+    async def _on_message(self, sender_idx: int, payload: bytes) -> None:
+        if self._handler is None:
+            return None
+        obj = json.loads(payload.decode())
+        await self._handler(sender_idx, int(obj["slot"]),
+                            list(obj["topics"]))
         return None
 
 

@@ -1,0 +1,225 @@
+"""HTTP face for BeaconMock — an in-process beacon node served over REST.
+
+The reference's beaconmock IS an HTTP server (testutil/beaconmock/
+beaconmock.go:51 serves static + functional endpoints); here the same role
+is played by an aiohttp layer over the in-memory BeaconMock, speaking the
+standard beacon-API JSON (shared codec eth2/json_codec.py), so the
+HTTPBeaconNode client (eth2/http_beacon.py) and full charon nodes can be
+driven end-to-end over real HTTP.
+"""
+
+from __future__ import annotations
+
+import json
+
+from aiohttp import web
+
+from ..eth2 import json_codec as jc
+from ..eth2 import spec
+from .beaconmock import BeaconMock
+
+
+def _data(payload) -> web.Response:
+    return web.json_response({"data": payload})
+
+
+class HTTPBeaconMock:
+    """Serves a BeaconMock over the beacon-API (start() binds the port)."""
+
+    def __init__(self, mock: BeaconMock, host: str = "127.0.0.1",
+                 port: int = 0):
+        self.mock = mock
+        self.host = host
+        self.port = port
+        app = web.Application()
+        r = app.router
+        r.add_get("/eth/v1/beacon/genesis", self._genesis)
+        r.add_get("/eth/v1/config/spec", self._spec)
+        r.add_get("/eth/v1/node/syncing", self._syncing)
+        r.add_get("/eth/v1/node/version", self._version)
+        r.add_post("/eth/v1/beacon/states/head/validators", self._validators)
+        r.add_post("/eth/v1/validator/duties/attester/{epoch}", self._att_duties)
+        r.add_get("/eth/v1/validator/duties/proposer/{epoch}", self._pro_duties)
+        r.add_post("/eth/v1/validator/duties/sync/{epoch}", self._sync_duties)
+        r.add_get("/eth/v1/validator/attestation_data", self._att_data)
+        r.add_get("/eth/v1/validator/aggregate_attestation", self._agg_att)
+        r.add_get("/eth/v2/validator/blocks/{slot}", self._block)
+        r.add_get("/eth/v1/validator/sync_committee_contribution", self._contrib)
+        r.add_get("/eth/v1/beacon/headers/head", self._head)
+        r.add_get("/eth/v1/beacon/blocks/{slot}/attestations", self._block_atts)
+        r.add_post("/eth/v1/beacon/pool/attestations", self._sub_atts)
+        r.add_post("/eth/v1/beacon/blocks", self._sub_block)
+        r.add_post("/eth/v2/beacon/blocks", self._sub_block)
+        r.add_post("/eth/v1/validator/aggregate_and_proofs", self._sub_aggs)
+        r.add_post("/eth/v1/beacon/pool/sync_committees", self._sub_msgs)
+        r.add_post("/eth/v1/validator/contribution_and_proofs", self._sub_contribs)
+        r.add_post("/eth/v1/validator/register_validator", self._sub_regs)
+        r.add_post("/eth/v1/beacon/pool/voluntary_exits", self._sub_exit)
+        self._app = app
+        self._runner: web.AppRunner | None = None
+
+    async def start(self) -> None:
+        self._runner = web.AppRunner(self._app)
+        await self._runner.setup()
+        site = web.TCPSite(self._runner, self.host, self.port)
+        await site.start()
+        self.port = site._server.sockets[0].getsockname()[1]
+
+    async def stop(self) -> None:
+        if self._runner is not None:
+            await self._runner.cleanup()
+
+    @property
+    def base_url(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+    # -- chain info -----------------------------------------------------------
+
+    async def _genesis(self, request) -> web.Response:
+        s = self.mock._spec
+        return _data({
+            "genesis_time": str(int(s.genesis_time)),
+            "genesis_validators_root": "0x" + s.genesis_validators_root.hex(),
+            "genesis_fork_version": "0x" + s.genesis_fork_version.hex(),
+            # non-standard: fractional genesis time for sub-second test slots
+            "genesis_time_frac": repr(s.genesis_time),
+        })
+
+    async def _spec(self, request) -> web.Response:
+        s = self.mock._spec
+        return _data({
+            "SECONDS_PER_SLOT": repr(s.seconds_per_slot),
+            "SLOTS_PER_EPOCH": str(s.slots_per_epoch),
+            "EPOCHS_PER_SYNC_COMMITTEE_PERIOD":
+                str(s.epochs_per_sync_committee_period),
+        })
+
+    async def _syncing(self, request) -> web.Response:
+        return _data({"is_syncing": await self.mock.node_syncing(),
+                      "head_slot": str(await self.mock.head_slot())})
+
+    async def _version(self, request) -> web.Response:
+        return _data({"version": "charon-tpu-beaconmock/http"})
+
+    async def _validators(self, request) -> web.Response:
+        body = await request.json()
+        pubkeys = [bytes.fromhex(pk[2:]) for pk in body.get("ids", [])]
+        vals = await self.mock.validators_by_pubkey(pubkeys)
+        return _data([{
+            "index": str(v.index),
+            "status": v.status,
+            "validator": {
+                "pubkey": "0x" + v.pubkey.hex(),
+                "effective_balance": str(v.effective_balance),
+                "activation_epoch": str(v.activation_epoch),
+                "withdrawal_credentials":
+                    "0x" + v.withdrawal_credentials.hex(),
+            },
+        } for v in vals.values()])
+
+    # -- duties ---------------------------------------------------------------
+
+    async def _att_duties(self, request) -> web.Response:
+        epoch = int(request.match_info["epoch"])
+        indices = [int(i) for i in await request.json()]
+        duties = await self.mock.attester_duties(epoch, indices)
+        return _data([jc.encode_attester_duty(d) for d in duties])
+
+    async def _pro_duties(self, request) -> web.Response:
+        epoch = int(request.match_info["epoch"])
+        indices = [v.index for v in self.mock.validators.values()]
+        duties = await self.mock.proposer_duties(epoch, indices)
+        return _data([jc.encode_proposer_duty(d) for d in duties])
+
+    async def _sync_duties(self, request) -> web.Response:
+        epoch = int(request.match_info["epoch"])
+        indices = [int(i) for i in await request.json()]
+        duties = await self.mock.sync_committee_duties(epoch, indices)
+        return _data([jc.encode_sync_duty(d) for d in duties])
+
+    # -- duty data ------------------------------------------------------------
+
+    async def _att_data(self, request) -> web.Response:
+        slot = int(request.query["slot"])
+        idx = int(request.query["committee_index"])
+        data = await self.mock.attestation_data(slot, idx)
+        return _data(jc.encode_container(data))
+
+    async def _agg_att(self, request) -> web.Response:
+        slot = int(request.query["slot"])
+        root = bytes.fromhex(request.query["attestation_data_root"][2:])
+        att = await self.mock.aggregate_attestation(slot, root)
+        return _data(jc.encode_container(att))
+
+    async def _block(self, request) -> web.Response:
+        slot = int(request.match_info["slot"])
+        randao = bytes.fromhex(request.query["randao_reveal"][2:])
+        graffiti = bytes.fromhex(request.query.get("graffiti", "0x")[2:])
+        blinded = request.query.get("blinded") == "true"
+        block = await self.mock.block_proposal(slot, randao, graffiti, blinded)
+        return _data(jc.encode_beacon_block(block))
+
+    async def _contrib(self, request) -> web.Response:
+        slot = int(request.query["slot"])
+        sub = int(request.query["subcommittee_index"])
+        root = bytes.fromhex(request.query["beacon_block_root"][2:])
+        c = await self.mock.sync_committee_contribution(slot, sub, root)
+        return _data(jc.encode_container(c))
+
+    async def _head(self, request) -> web.Response:
+        return _data({"header": {"message": {
+            "slot": str(await self.mock.head_slot())}}})
+
+    async def _block_atts(self, request) -> web.Response:
+        """Standard block-attestations endpoint: the mock chain includes
+        every attestation submitted for the previous slot."""
+        slot = int(request.match_info["slot"])
+        atts = [a for a in self.mock.attestations if a.data.slot == slot - 1]
+        return _data([jc.encode_container(a) for a in atts])
+
+    # -- submissions ----------------------------------------------------------
+
+    async def _sub_atts(self, request) -> web.Response:
+        body = await request.json()
+        atts = [jc.decode_container(spec.Attestation, o) for o in body]
+        await self.mock.submit_attestations(atts)
+        return web.json_response({})
+
+    async def _sub_block(self, request) -> web.Response:
+        body = await request.json()
+        await self.mock.submit_block(jc.decode_signed_beacon_block(body))
+        return web.json_response({})
+
+    async def _sub_aggs(self, request) -> web.Response:
+        body = await request.json()
+        aggs = [jc.decode_container(spec.SignedAggregateAndProof, o)
+                for o in body]
+        await self.mock.submit_aggregate_and_proofs(aggs)
+        return web.json_response({})
+
+    async def _sub_msgs(self, request) -> web.Response:
+        body = await request.json()
+        msgs = [jc.decode_container(spec.SyncCommitteeMessage, o)
+                for o in body]
+        await self.mock.submit_sync_messages(msgs)
+        return web.json_response({})
+
+    async def _sub_contribs(self, request) -> web.Response:
+        body = await request.json()
+        contribs = [jc.decode_container(spec.SignedContributionAndProof, o)
+                    for o in body]
+        await self.mock.submit_contribution_and_proofs(contribs)
+        return web.json_response({})
+
+    async def _sub_regs(self, request) -> web.Response:
+        body = await request.json()
+        regs = [jc.decode_container(spec.SignedValidatorRegistration, o)
+                for o in body]
+        await self.mock.submit_validator_registrations(regs)
+        return web.json_response({})
+
+    async def _sub_exit(self, request) -> web.Response:
+        body = await request.json()
+        await self.mock.submit_voluntary_exit(
+            jc.decode_container(spec.SignedVoluntaryExit, body))
+        return web.json_response({})

@@ -56,7 +56,7 @@ async def _boot_cluster(tmp_path, num_nodes=4, threshold=3, num_validators=1,
         config = Config(data_dir=tmp_path / f"node{i}",
                         p2p_port=ports[i], peer_addrs=peer_addrs,
                         test=TestConfig(beacon=beacon, use_vmock=use_vmock))
-        apps.append(assemble(config))
+        apps.append(await assemble(config))
     for app in apps:
         await app.start()
     return apps, beacon
@@ -90,6 +90,16 @@ class TestAppShell:
                     await asyncio.sleep(0.1)
                 assert apps[0].inclusion.included, "inclusion checker saw nothing"
                 assert apps[0].inclusion.included[0][1] >= 1  # delay in slots
+
+                # infosync: versions/protocols agreed cluster-wide via the
+                # priority protocol at the epoch head
+                while asyncio.get_running_loop().time() < deadline:
+                    if all(a.infosync.agreed_version() for a in apps):
+                        break
+                    await asyncio.sleep(0.1)
+                versions = {a.infosync.agreed_version() for a in apps}
+                assert len(versions) == 1 and None not in versions, versions
+                assert apps[0].infosync.agreed_protocols()
 
                 async with ClientSession() as sess:
                     base = f"http://127.0.0.1:{apps[0].monitoring.port}"

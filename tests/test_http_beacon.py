@@ -1,0 +1,229 @@
+"""HTTP beacon layer: HTTPBeaconNode client vs the HTTP beaconmock server,
+lazy reconnect, the full app over beacon_urls, Recaster, and synthetic
+proposals (reference app/eth2wrap: eth2wrap.go, lazy.go, synthproposer.go;
+core/bcast/recast.go)."""
+
+import asyncio
+import time
+
+import pytest
+
+from charon_tpu.eth2.beacon import SyntheticProposals
+from charon_tpu.eth2.http_beacon import HTTPBeaconNode
+from charon_tpu.testutil.beaconmock import BeaconMock
+from charon_tpu.testutil.beaconmock_http import HTTPBeaconMock
+from charon_tpu.utils.errors import CharonError
+
+
+def _run(coro, timeout=60):
+    async def wrapped():
+        return await asyncio.wait_for(coro, timeout)
+
+    return asyncio.run(wrapped())
+
+
+def _mock(n_validators=2, seconds_per_slot=0.4, genesis_delay=1.0):
+    pubkeys = [bytes([i + 1]) * 48 for i in range(n_validators)]
+    return BeaconMock(pubkeys, genesis_time=time.time() + genesis_delay,
+                      seconds_per_slot=seconds_per_slot, slots_per_epoch=8)
+
+
+class TestHTTPBeaconNode:
+    def test_roundtrip_against_http_mock(self):
+        async def run():
+            mock = _mock()
+            server = HTTPBeaconMock(mock)
+            await server.start()
+            client = HTTPBeaconNode(server.base_url)
+            try:
+                chain = await client.spec()
+                assert abs(chain.genesis_time - mock._spec.genesis_time) < 1e-6
+                assert chain.slots_per_epoch == 8
+                assert not await client.node_syncing()
+
+                pks = list(mock.validators)
+                vals = await client.validators_by_pubkey(pks)
+                assert {v.index for v in vals.values()} == {0, 1}
+
+                duties = await client.attester_duties(0, [0, 1])
+                want = await mock.attester_duties(0, [0, 1])
+                assert duties == want
+
+                pduties = await client.proposer_duties(0, [0, 1])
+                assert pduties == await mock.proposer_duties(0, [0, 1])
+
+                data = await client.attestation_data(3, 0)
+                assert data == await mock.attestation_data(3, 0)
+
+                agg = await client.aggregate_attestation(
+                    3, data.hash_tree_root())
+                assert agg.data == data
+
+                block = await client.block_proposal(5, b"\x01" * 96)
+                assert block == await mock.block_proposal(5, b"\x01" * 96)
+
+                # submission roundtrip: attestation arrives in the mock
+                from charon_tpu.eth2 import spec as spec_mod
+
+                att = spec_mod.Attestation(
+                    aggregation_bits=[True, False], data=data,
+                    signature=b"\x05" * 96)
+                await client.submit_attestations([att])
+                assert mock.attestations == [att]
+
+                assert await client.head_slot() >= 0
+            finally:
+                await client.close()
+                await server.stop()
+
+        _run(run())
+
+    def test_lazy_reconnect_after_server_restart(self):
+        async def run():
+            mock = _mock()
+            server = HTTPBeaconMock(mock)
+            await server.start()
+            port = server.port
+            client = HTTPBeaconNode(server.base_url)
+            try:
+                assert not await client.node_syncing()
+                await server.stop()
+                with pytest.raises(CharonError):
+                    await client.node_syncing()
+                # restart on the same port: the lazily-rebuilt session connects
+                server2 = HTTPBeaconMock(mock, port=port)
+                await server2.start()
+                try:
+                    assert not await client.node_syncing()
+                finally:
+                    await server2.stop()
+            finally:
+                await client.close()
+
+        _run(run())
+
+
+class TestAppOverHTTP:
+    def test_cluster_attests_via_beacon_urls(self, tmp_path):
+        """Full nodes with NO injected beacon: the HTTP client path
+        (beacon_urls) drives the whole duty pipeline."""
+
+        async def run():
+            import socket
+
+            from charon_tpu.app import Config, TestConfig, assemble
+            from charon_tpu.cluster import create_cluster, load_node
+
+            num_nodes = 3
+            create_cluster("http-test", num_validators=1,
+                           num_nodes=num_nodes, threshold=2,
+                           out_dir=tmp_path)
+            _, lock, _ = load_node(tmp_path / "node0")
+            mock = BeaconMock([v.public_key for v in lock.validators],
+                              genesis_time=time.time() + 1.2,
+                              seconds_per_slot=0.4, slots_per_epoch=8)
+            server = HTTPBeaconMock(mock)
+            await server.start()
+
+            socks = [socket.socket() for _ in range(num_nodes)]
+            for s in socks:
+                s.bind(("127.0.0.1", 0))
+            ports = [s.getsockname()[1] for s in socks]
+            for s in socks:
+                s.close()
+            peer_addrs = {i: ("127.0.0.1", ports[i])
+                          for i in range(num_nodes)}
+            apps = []
+            for i in range(num_nodes):
+                apps.append(await assemble(Config(
+                    data_dir=tmp_path / f"node{i}", p2p_port=ports[i],
+                    peer_addrs=peer_addrs,
+                    beacon_urls=[server.base_url],
+                    test=TestConfig(use_vmock=True))))
+            for a in apps:
+                await a.start()
+            try:
+                deadline = asyncio.get_running_loop().time() + 40
+                while asyncio.get_running_loop().time() < deadline:
+                    if mock.attestations:
+                        break
+                    await asyncio.sleep(0.1)
+                assert mock.attestations, "no attestation over the HTTP path"
+            finally:
+                import contextlib
+
+                for a in apps:
+                    with contextlib.suppress(asyncio.TimeoutError):
+                        await asyncio.wait_for(a.stop(), 10)
+                await server.stop()
+
+        _run(run())
+
+
+class TestRecaster:
+    def test_replays_registrations_each_epoch(self):
+        async def run():
+            from charon_tpu.core.bcast import Recaster
+            from charon_tpu.core.signeddata import SignedRegistration
+            from charon_tpu.core.types import Duty, DutyType
+            from charon_tpu.eth2 import spec as spec_mod
+
+            mock = _mock()
+            rec = Recaster(mock)
+            reg = spec_mod.ValidatorRegistration(
+                fee_recipient=b"\x01" * 20, gas_limit=30_000_000,
+                timestamp=123, pubkey=b"\x02" * 48)
+            sd = SignedRegistration(registration=reg, sig=b"\x03" * 96)
+            await rec.on_broadcast(
+                Duty(9, DutyType.BUILDER_REGISTRATION), {"0xpk": sd})
+            assert not mock.registrations  # storing is not submitting
+
+            class Slot:
+                slot = 16
+                epoch = 2
+                first_in_epoch = True
+
+            await rec.on_slot(Slot())
+            assert len(mock.registrations) == 1
+            # same epoch: no duplicate replay
+            await rec.on_slot(Slot())
+            assert len(mock.registrations) == 1
+
+            class Next:
+                slot = 24
+                epoch = 3
+                first_in_epoch = True
+
+            await rec.on_slot(Next())
+            assert len(mock.registrations) == 2
+
+        _run(run())
+
+
+class TestSyntheticProposals:
+    def test_fabricates_and_swallows(self):
+        async def run():
+            mock = _mock()
+
+            async def no_duties(epoch, indices):
+                return []
+
+            mock.overrides["proposer_duties"] = no_duties
+            synth = SyntheticProposals(mock)
+            duties = await synth.proposer_duties(1, [0, 1])
+            assert len(duties) == 1
+            assert duties[0].validator_index in (0, 1)
+            block = await synth.block_proposal(duties[0].slot, b"\x01" * 96)
+            assert block is not None
+            from charon_tpu.eth2 import spec as spec_mod
+
+            signed = spec_mod.SignedBeaconBlock(block, b"\x04" * 96)
+            await synth.submit_block(signed)
+            assert mock.blocks == []              # never reaches the BN
+            assert synth.synthetic_submissions == [signed]
+            # real duties pass through untouched
+            del mock.overrides["proposer_duties"]
+            real = await synth.proposer_duties(1, [0, 1])
+            assert real == await mock.proposer_duties(1, [0, 1])
+
+        _run(run())

@@ -1,0 +1,159 @@
+"""Priority protocol + infosync (reference core/priority/prioritiser.go,
+core/priority/calculate.go, core/infosync/infosync.go)."""
+
+import asyncio
+
+import pytest
+
+from charon_tpu.core import consensus as consensus_mod
+from charon_tpu.core.consensus import Component, MemTransport
+from charon_tpu.core.infosync import InfoSync
+from charon_tpu.core.priority import (
+    MemPriorityTransport,
+    Prioritiser,
+    TopicProposal,
+    TopicResult,
+    calculate,
+)
+from charon_tpu.utils import k1util
+
+
+def _run(coro, timeout=30.0):
+    async def wrapped():
+        return await asyncio.wait_for(coro, timeout)
+
+    return asyncio.run(wrapped())
+
+
+class TestCalculate:
+    def test_quorum_filter_and_score_order(self):
+        # 4 peers, quorum 3: "v2" listed by all first; "v1" by 3 peers
+        # second; "rogue" by only one peer (dropped).
+        proposals = {
+            0: [TopicProposal("version", ["v2", "v1"])],
+            1: [TopicProposal("version", ["v2", "v1"])],
+            2: [TopicProposal("version", ["v2", "v1", "rogue"])],
+            3: [TopicProposal("version", ["v1", "v2"])],
+        }
+        out = calculate(proposals, quorum=3)
+        assert out == [TopicResult("version", ["v2", "v1"])]
+
+    def test_deterministic_tiebreak_and_multiple_topics(self):
+        proposals = {
+            0: [TopicProposal("b", ["x"]), TopicProposal("a", ["p", "q"])],
+            1: [TopicProposal("a", ["q", "p"]), TopicProposal("b", ["x"])],
+        }
+        out = calculate(proposals, quorum=2)
+        # topics sorted; equal scores break ties by priority string
+        assert [r.topic for r in out] == ["a", "b"]
+        assert out[0].priorities == ["p", "q"]
+        assert out[1].priorities == ["x"]
+
+    def test_minority_cannot_force(self):
+        proposals = {
+            0: [TopicProposal("t", ["evil"])],
+            1: [TopicProposal("t", ["good"])],
+            2: [TopicProposal("t", ["good"])],
+        }
+        out = calculate(proposals, quorum=2)
+        assert out == [TopicResult("t", ["good"])]
+
+
+def _priority_cluster(n, quorum):
+    """n Prioritisers over in-memory exchange + in-memory QBFT."""
+    qbft_fabric = MemTransport()
+    prio_fabric = MemPriorityTransport()
+    privs = [k1util.generate_private_key() for _ in range(n)]
+    pubkeys = {i: k1util.public_key(privs[i]) for i in range(n)}
+    prios = []
+    for i in range(n):
+        comp = Component(qbft_fabric.endpoint(), peer_idx=i, nodes=n,
+                         privkey=privs[i], peer_pubkeys=pubkeys,
+                         deadliner=None, gater=lambda d: True,
+                         timer_func=consensus_mod.default_timer_func)
+        prios.append(Prioritiser(prio_fabric.endpoint(), comp, peer_idx=i,
+                                 nodes=n, quorum=quorum,
+                                 exchange_timeout=2.0))
+    return prios
+
+
+class TestPrioritiser:
+    def test_cluster_agrees_on_overlap(self):
+        async def run():
+            n, quorum = 4, 3
+            prios = _priority_cluster(n, quorum)
+            agreed = {i: [] for i in range(n)}
+            for i, p in enumerate(prios):
+                async def sub(duty, results, i=i):
+                    agreed[i].append(results)
+
+                p.subscribe(sub)
+            proposals = [
+                [TopicProposal("version", ["v2", "v1"])],
+                [TopicProposal("version", ["v2", "v1"])],
+                [TopicProposal("version", ["v1", "v2"])],
+                [TopicProposal("version", ["v2", "only-me"])],
+            ]
+            await asyncio.gather(*(p.prioritise(32, proposals[i])
+                                   for i, p in enumerate(prios)))
+            deadline = asyncio.get_running_loop().time() + 10
+            while asyncio.get_running_loop().time() < deadline:
+                if all(agreed[i] for i in range(n)):
+                    break
+                await asyncio.sleep(0.05)
+            # every node got the SAME agreed result; minority dropped
+            results = {tuple((r.topic, tuple(r.priorities))
+                             for r in agreed[i][0]) for i in range(n)}
+            assert len(results) == 1
+            (pairs,) = results
+            topic, prio_order = pairs[0]
+            assert topic == "version"
+            assert "only-me" not in prio_order
+            assert prio_order[0] == "v2"
+
+        _run(run())
+
+    def test_insufficient_exchanges_raises(self):
+        async def run():
+            prios = _priority_cluster(3, 3)
+            # only one node participates: cannot reach quorum
+            from charon_tpu.utils.errors import CharonError
+
+            with pytest.raises(CharonError):
+                await prios[0].prioritise(
+                    5, [TopicProposal("version", ["v1"])])
+
+        _run(run())
+
+
+class TestInfoSync:
+    def test_epoch_tick_agrees_versions(self):
+        async def run():
+            n, quorum = 3, 2
+            prios = _priority_cluster(n, quorum)
+            syncs = [InfoSync(p, versions=["v2", "v1"],
+                              protocols=["/p/2", "/p/1"],
+                              proposal_types=["full"]) for p in prios]
+
+            class Slot:
+                slot = 64
+                epoch = 2
+                first_in_epoch = True
+
+            await asyncio.gather(*(s.on_slot(Slot()) for s in syncs))
+            deadline = asyncio.get_running_loop().time() + 10
+            while asyncio.get_running_loop().time() < deadline:
+                if all(s.agreed_version() for s in syncs):
+                    break
+                await asyncio.sleep(0.05)
+            assert {s.agreed_version() for s in syncs} == {"v2"}
+            assert syncs[0].agreed_protocols() == ["/p/2", "/p/1"]
+            # non-epoch slots do nothing
+            class Mid:
+                slot = 65
+                epoch = 2
+                first_in_epoch = False
+
+            await syncs[0].on_slot(Mid())
+
+        _run(run())
