@@ -65,6 +65,7 @@ class Config:
     monitoring_port: int = 0
     beacon_urls: list[str] = field(default_factory=list)
     synthetic_proposals: bool = False
+    p2p_fuzz: float = 0.0
     consensus_type: str = "qbft"
     test: TestConfig = field(default_factory=TestConfig)
 
@@ -178,7 +179,8 @@ async def assemble(config: Config) -> App:
         host, port = config.peer_addrs.get(i, ("", 0))
         specs.append(PeerSpec(i, peer_pubkeys.get(i, b"\x02" + bytes(32)), host, port))
     node = TCPNode(identity, my_idx, specs, listen_host=config.p2p_host,
-                   listen_port=config.p2p_port, own_spec=specs[my_idx])
+                   listen_port=config.p2p_port, own_spec=specs[my_idx],
+                   fuzz=config.p2p_fuzz)
     relay_client = RelayClient(node, config.relays) if config.relays else None
     ping = PingService(node)
     peerinfo = PeerInfo(node)

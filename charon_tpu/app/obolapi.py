@@ -1,0 +1,45 @@
+"""Obol-API client — publish cluster lock files (reference app/obolapi/api.go).
+
+After a successful DKG the cluster lock can be published to a REST registry
+so operators and UIs can discover it. The endpoint shape follows the
+reference: POST {base}/lock with the lock JSON; best-effort (a publish
+failure never fails the ceremony — reference logs and continues).
+"""
+
+from __future__ import annotations
+
+from ..utils import errors, log
+
+_log = log.with_topic("obolapi")
+
+DEFAULT_TIMEOUT = 10.0
+
+
+class ObolAPIClient:
+    def __init__(self, base_url: str, timeout: float = DEFAULT_TIMEOUT):
+        self.base_url = base_url.rstrip("/")
+        self._timeout = timeout
+
+    async def publish_lock(self, lock_json: dict) -> None:
+        import aiohttp
+
+        async with aiohttp.ClientSession(
+                timeout=aiohttp.ClientTimeout(total=self._timeout)) as sess:
+            async with sess.post(self.base_url + "/lock",
+                                 json=lock_json) as resp:
+                if resp.status // 100 != 2:
+                    raise errors.new("lock publish failed",
+                                     status=resp.status,
+                                     detail=(await resp.text())[:200])
+        _log.info("published cluster lock", url=self.base_url)
+
+
+async def publish_lock_best_effort(base_url: str, lock_json: dict) -> bool:
+    """The DKG-side wrapper: failures are logged, never raised
+    (reference dkg.go publishes best-effort)."""
+    try:
+        await ObolAPIClient(base_url).publish_lock(lock_json)
+        return True
+    except Exception as exc:  # noqa: BLE001 — publish is best-effort
+        _log.warn("lock publish failed; continuing", err=exc)
+        return False

@@ -102,6 +102,9 @@ def build_parser() -> argparse.ArgumentParser:
     run_p.add_argument("--validator-api-address", dest="validator_api_address", default=None)
     run_p.add_argument("--monitoring-address", dest="monitoring_address", default=None)
     run_p.add_argument("--beacon-node-endpoints", dest="beacon_node_endpoints", default=None)
+    run_p.add_argument("--p2p-fuzz", dest="p2p_fuzz", type=float, default=None,
+                       help="probability of corrupting outbound p2p messages "
+                            "(byzantine fault injection; test clusters only)")
     run_p.add_argument("--simnet-beacon-mock", dest="simnet_beacon_mock",
                        action="store_true", default=None,
                        help="use the in-process beacon mock (dev/simnet)")
@@ -193,6 +196,9 @@ def _cmd_run(args: argparse.Namespace) -> int:
     mon_host, mon_port = _split_addr(
         resolve(args, "monitoring_address", "127.0.0.1:3620"), 3620)
     test = TestConfig()
+    # the in-process validator mock works with ANY beacon source (in-process
+    # mock or HTTP endpoints) — it drives the validatorapi component directly
+    test.use_vmock = resolve_bool(args, "simnet_validator_mock")
     if resolve_bool(args, "simnet_beacon_mock"):
         # dev-mode beacon mock fed from the node's own lock
         from .. import cluster as cluster_mod
@@ -200,7 +206,6 @@ def _cmd_run(args: argparse.Namespace) -> int:
 
         _, lock, _ = cluster_mod.load_node(resolve(args, "data_dir", ".charon"))
         test.beacon = BeaconMock([v.public_key for v in lock.validators])
-        test.use_vmock = resolve_bool(args, "simnet_validator_mock")
     bn = resolve(args, "beacon_node_endpoints", "")
     config = Config(
         data_dir=resolve(args, "data_dir", ".charon"),
@@ -209,6 +214,7 @@ def _cmd_run(args: argparse.Namespace) -> int:
         vapi_host=vapi_host, vapi_port=vapi_port,
         monitoring_host=mon_host, monitoring_port=mon_port,
         beacon_urls=[u for u in (bn or "").split(",") if u],
+        p2p_fuzz=float(resolve(args, "p2p_fuzz", 0.0) or 0.0),
         test=test,
     )
     asyncio.run(app_run(config))

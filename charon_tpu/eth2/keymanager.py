@@ -1,0 +1,64 @@
+"""Keymanager-API client — push share keystores into a validator client
+(reference eth2util/keymanager/keymanager.go:23).
+
+After a DKG (or cluster creation), each node's BLS key shares can be
+delivered straight to the operator's VC over the standard keymanager API
+(POST /eth/v1/keystores with EIP-2335 keystores + passwords + bearer auth)
+instead of writing them to disk for manual import.
+"""
+
+from __future__ import annotations
+
+import json
+import secrets as secrets_mod
+
+from .. import tbls
+from ..utils import errors, log
+from . import keystore
+
+_log = log.with_topic("keymanager")
+
+
+class KeymanagerClient:
+    def __init__(self, base_url: str, auth_token: str = "",
+                 timeout: float = 10.0):
+        self.base_url = base_url.rstrip("/")
+        self._token = auth_token
+        self._timeout = timeout
+
+    async def import_share_keys(self, shares: list[tbls.PrivateKey],
+                                *, insecure_crypto: bool = False) -> None:
+        """Encrypt each share under a fresh random password and import the
+        batch (keymanager.go ImportKeystores)."""
+        keystores, passwords = [], []
+        for share in shares:
+            pw = secrets_mod.token_hex(16)
+            keystores.append(json.dumps(
+                keystore.encrypt(share, pw, insecure=insecure_crypto)))
+            passwords.append(pw)
+        await self.import_keystores(keystores, passwords)
+
+    async def import_keystores(self, keystores: list[str],
+                               passwords: list[str]) -> None:
+        import aiohttp
+
+        headers = {}
+        if self._token:
+            headers["Authorization"] = f"Bearer {self._token}"
+        async with aiohttp.ClientSession(
+                timeout=aiohttp.ClientTimeout(total=self._timeout)) as sess:
+            async with sess.post(
+                    self.base_url + "/eth/v1/keystores",
+                    json={"keystores": keystores, "passwords": passwords},
+                    headers=headers) as resp:
+                if resp.status // 100 != 2:
+                    raise errors.new("keymanager import failed",
+                                     status=resp.status,
+                                     detail=(await resp.text())[:200])
+                body = await resp.json()
+        statuses = [d.get("status") for d in body.get("data", [])]
+        if any(s == "error" for s in statuses):
+            raise errors.new("keymanager rejected keystores",
+                             statuses=statuses)
+        _log.info("pushed keystores to keymanager", count=len(keystores),
+                  url=self.base_url)

@@ -28,6 +28,7 @@ Frame body layout inside the encrypted channel:
 from __future__ import annotations
 
 import asyncio
+import random as _random
 import struct
 from dataclasses import dataclass, field
 from typing import Awaitable, Callable
@@ -164,7 +165,12 @@ class TCPNode:
 
     def __init__(self, privkey: bytes, own_index: int, peers: list[PeerSpec],
                  listen_host: str = "127.0.0.1", listen_port: int = 0,
-                 own_spec: PeerSpec | None = None):
+                 own_spec: PeerSpec | None = None,
+                 fuzz: float = 0.0):
+        # fuzz: probability of corrupting each outbound payload (byzantine
+        # fault injection, reference p2p/fuzz.go + --p2p-fuzz cmd/run.go:96);
+        # the cluster must tolerate floor((n-1)/3) such nodes.
+        self.fuzz = fuzz
         self.privkey = privkey
         self.pubkey = k1util.public_key(privkey)
         self.own_index = own_index
@@ -218,6 +224,7 @@ class TCPNode:
     async def send_receive(self, peer_index: int, protocol: str, payload: bytes,
                            timeout: float = 10.0) -> bytes:
         """RPC: send a request, await the peer's response."""
+        payload = self._maybe_fuzz(payload)
         conn = self._conn(peer_index)
         try:
             resp = await conn.request(protocol, payload, timeout)
@@ -231,8 +238,27 @@ class TCPNode:
     def send_async(self, peer_index: int, protocol: str, payload: bytes) -> None:
         """Fire-and-forget with retry/backoff (reference p2p/sender.go:107
         SendAsync: async, state-tracked retries, logs on state change)."""
+        payload = self._maybe_fuzz(payload)
         aio.spawn(self._send_with_retry(peer_index, protocol, payload),
                   name=f"p2p-send-{peer_index}-{protocol}")
+
+    def _maybe_fuzz(self, payload: bytes) -> bytes:
+        """Corrupt outbound payloads with probability self.fuzz (reference
+        p2p/fuzz.go): flips bytes, truncates, or replaces with junk."""
+        if not self.fuzz:
+            return payload
+        if _random.random() >= self.fuzz:
+            return payload
+        mode = _random.randrange(3)
+        if mode == 0 and payload:                      # flip random bytes
+            b = bytearray(payload)
+            for _ in range(max(1, len(b) // 16)):
+                b[_random.randrange(len(b))] ^= _random.randrange(1, 256)
+            return bytes(b)
+        if mode == 1:                                  # truncate
+            return payload[:_random.randrange(len(payload) + 1)]
+        return bytes(_random.randrange(256)            # junk of random size
+                     for _ in range(_random.randrange(1, 512)))
 
     def broadcast(self, protocol: str, payload: bytes) -> None:
         for idx in self.peers:

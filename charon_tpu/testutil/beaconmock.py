@@ -10,6 +10,7 @@ from __future__ import annotations
 
 import asyncio
 import hashlib
+import random
 import time
 from typing import Callable
 
@@ -53,6 +54,17 @@ class BeaconMock:
 
         # Per-function stub overrides (reference beaconmock option funcs).
         self.overrides: dict[str, Callable] = {}
+        # duty-generation memo: every node asks the same questions each
+        # epoch; at 1000s of validators regeneration dominates the loop
+        self._duty_memo: dict = {}
+        # Response fuzzing probability (reference beaconmock_fuzz.go +
+        # --simnet-beacon-mock-fuzz cmd/run.go:84): corrupted duty data feeds
+        # the pipeline, which must fail loudly per duty, never crash.
+        self.fuzz: float = 0.0
+        self._fuzz_rng = random.Random(0xFBAD)
+
+    def _fuzzed(self) -> bool:
+        return self.fuzz > 0 and self._fuzz_rng.random() < self.fuzz
 
     # -- BeaconNode interface ------------------------------------------------
 
@@ -70,6 +82,9 @@ class BeaconMock:
                               indices: list[int]) -> list[spec.AttesterDuty]:
         if "attester_duties" in self.overrides:
             return await self.overrides["attester_duties"](epoch, indices)
+        memo_key = ("att", epoch, tuple(sorted(indices)))
+        if memo_key in self._duty_memo:
+            return self._duty_memo[memo_key]
         by_index = {v.index: v for v in self.validators.values()}
         duties = []
         wanted = [i for i in indices if i in by_index]
@@ -93,6 +108,9 @@ class BeaconMock:
                             pubkey=v.pubkey, slot=slot, validator_index=idx,
                             committee_index=0, committee_length=len(wanted),
                             committees_at_slot=1, validator_committee_index=pos))
+        if len(self._duty_memo) > 64:
+            self._duty_memo.clear()
+        self._duty_memo[memo_key] = duties
         return duties
 
     async def proposer_duties(self, epoch: int,
@@ -121,6 +139,15 @@ class BeaconMock:
                                committee_index: int) -> spec.AttestationData:
         if "attestation_data" in self.overrides:
             return await self.overrides["attestation_data"](slot, committee_index)
+        if self._fuzzed():
+            r = self._fuzz_rng
+            return spec.AttestationData(
+                slot=r.randrange(1 << 32), index=r.randrange(64),
+                beacon_block_root=bytes(r.randrange(256) for _ in range(32)),
+                source=spec.Checkpoint(r.randrange(1 << 20),
+                                       bytes(r.randrange(256) for _ in range(32))),
+                target=spec.Checkpoint(r.randrange(1 << 20),
+                                       bytes(r.randrange(256) for _ in range(32))))
         epoch = self._spec.epoch_of(slot)
         return spec.AttestationData(
             slot=slot, index=committee_index,

@@ -1,0 +1,152 @@
+"""Compose — multi-PROCESS cluster harness (reference testutil/compose).
+
+The reference generates docker-compose topologies of real charon containers
+for smoke and fuzz testing (compose/smoke/smoke_test.go:30,
+compose/fuzz/fuzz_test.go:26). The equivalent here: generate a cluster on
+disk, then launch each node as a REAL `python -m charon_tpu run` subprocess
+(the production CLI entrypoint — config file + env precedence, privkey
+lock, HTTP beacon client, TCP p2p), against an HTTP beaconmock served from
+the harness process. Faults are injected per node: `p2p_fuzz` corrupts a
+node's outbound p2p traffic; `beacon_fuzz` corrupts the mock BN's duty
+data.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+import socket
+import subprocess
+import sys
+import time
+from dataclasses import dataclass, field
+from pathlib import Path
+
+from ..cluster import create_cluster, load_node
+from ..utils import log
+from .beaconmock import BeaconMock
+from .beaconmock_http import HTTPBeaconMock
+
+_log = log.with_topic("compose")
+
+
+def _free_ports(n: int) -> list[int]:
+    socks, ports = [], []
+    for _ in range(n):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        socks.append(s)
+        ports.append(s.getsockname()[1])
+    for s in socks:
+        s.close()
+    return ports
+
+
+@dataclass
+class ComposeCluster:
+    """A generated on-disk cluster + the process handles running it."""
+
+    dir: Path
+    num_nodes: int
+    threshold: int
+    num_validators: int
+    seconds_per_slot: float = 0.4
+    slots_per_epoch: int = 8
+    p2p_fuzz: dict[int, float] = field(default_factory=dict)
+    beacon_fuzz: float = 0.0
+
+    mock: BeaconMock = None
+    server: HTTPBeaconMock = None
+    procs: dict[int, subprocess.Popen] = field(default_factory=dict)
+    p2p_ports: list[int] = field(default_factory=list)
+    monitoring_ports: list[int] = field(default_factory=list)
+
+    @classmethod
+    def generate(cls, dir, num_nodes=4, threshold=3, num_validators=1,
+                 **kw) -> "ComposeCluster":
+        """create the cluster artifacts + per-node charon.yaml configs
+        (the reference's compose.Define/Lock steps)."""
+        self = cls(Path(dir), num_nodes, threshold, num_validators, **kw)
+        create_cluster("compose", num_validators=num_validators,
+                       num_nodes=num_nodes, threshold=threshold,
+                       out_dir=self.dir)
+        self.p2p_ports = _free_ports(num_nodes)
+        self.monitoring_ports = _free_ports(num_nodes)
+        peers = ",".join(f"{i}=127.0.0.1:{self.p2p_ports[i]}"
+                         for i in range(num_nodes))
+        for i in range(num_nodes):
+            cfg = [
+                f"p2p-tcp-address: 127.0.0.1:{self.p2p_ports[i]}",
+                f"p2p-peers: {peers}",
+                f"monitoring-address: 127.0.0.1:{self.monitoring_ports[i]}",
+                "validator-api-address: 127.0.0.1:0",
+                "simnet-validator-mock: true",
+            ]
+            if self.p2p_fuzz.get(i):
+                cfg.append(f"p2p-fuzz: {self.p2p_fuzz[i]}")
+            (self.dir / f"node{i}" / "charon.yaml").write_text(
+                "\n".join(cfg) + "\n")
+        return self
+
+    async def start(self) -> None:
+        """Serve the HTTP beaconmock, then spawn every node process via the
+        real CLI (the reference runs real charon containers)."""
+        _, lock, _ = load_node(self.dir / "node0")
+        self.mock = BeaconMock(
+            [v.public_key for v in lock.validators],
+            genesis_time=time.time() + 2.0,
+            seconds_per_slot=self.seconds_per_slot,
+            slots_per_epoch=self.slots_per_epoch)
+        self.mock.fuzz = self.beacon_fuzz
+        self.server = HTTPBeaconMock(self.mock)
+        await self.server.start()
+        env = dict(os.environ)
+        env["CHARON_BEACON_NODE_ENDPOINTS"] = self.server.base_url
+        env.pop("JAX_PLATFORMS", None)  # nodes never touch the device
+        for i in range(self.num_nodes):
+            # per-node log FILES: pipes would fill (~64KB) with nothing
+            # draining them and block the node mid-run
+            logf = open(self.dir / f"node{i}" / "node.log", "wb")
+            self.procs[i] = subprocess.Popen(
+                [sys.executable, "-m", "charon_tpu", "run",
+                 "--data-dir", str(self.dir / f"node{i}")],
+                env=env, cwd=str(Path(__file__).resolve().parents[2]),
+                stdout=logf, stderr=subprocess.STDOUT)
+            logf.close()
+        _log.info("compose cluster started", nodes=self.num_nodes,
+                  beacon=self.server.base_url)
+
+    async def await_attestations(self, min_count: int = 1,
+                                 timeout: float = 60.0) -> None:
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            dead = [i for i, p in self.procs.items() if p.poll() is not None]
+            if dead:
+                raise RuntimeError(
+                    f"node {dead[0]} exited rc={self.procs[dead[0]].returncode}"
+                    f": {self.node_log(dead[0])[-2000:]}")
+            if len(self.mock.attestations) >= min_count:
+                return
+            await asyncio.sleep(0.2)
+        raise TimeoutError(
+            f"only {len(self.mock.attestations)}/{min_count} attestations")
+
+    async def stop(self) -> None:
+        for p in self.procs.values():
+            if p.poll() is None:
+                p.terminate()
+        deadline = time.monotonic() + 10
+        for p in self.procs.values():
+            while p.poll() is None and time.monotonic() < deadline:
+                await asyncio.sleep(0.1)
+            if p.poll() is None:
+                p.kill()
+        if self.server is not None:
+            await self.server.stop()
+
+    def node_log(self, i: int) -> str:
+        path = self.dir / f"node{i}" / "node.log"
+        try:
+            return path.read_text(errors="replace")
+        except OSError:
+            return ""

@@ -89,7 +89,8 @@ def new_simnet(num_validators: int = 2, threshold: int = 3, num_nodes: int = 4,
                genesis_delay: float = 0.3, use_vmock: bool = True,
                verify_peer_partials: bool = True,
                consensus_type: str = "qbft",
-               transport: str = "mem") -> SimCluster:
+               transport: str = "mem",
+               attest_all_every_slot: bool = True) -> SimCluster:
     """Assemble an n-node in-process cluster sharing one beaconmock.
 
     consensus_type: "qbft" (the production default, like the reference) or
@@ -105,7 +106,8 @@ def new_simnet(num_validators: int = 2, threshold: int = 3, num_nodes: int = 4,
     beacon = BeaconMock(root_pubkey_bytes,
                         genesis_time=time.time() + genesis_delay,
                         seconds_per_slot=seconds_per_slot,
-                        slots_per_epoch=slots_per_epoch)
+                        slots_per_epoch=slots_per_epoch,
+                        attest_all_every_slot=attest_all_every_slot)
     chain = beacon._spec
 
     # Node identity keys (p2p/consensus signing, reference app/k1util).

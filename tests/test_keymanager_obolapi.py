@@ -1,0 +1,109 @@
+"""Keymanager push + Obol-API lock publish against local HTTP stubs
+(reference eth2util/keymanager/keymanager.go, app/obolapi/api.go)."""
+
+import asyncio
+
+import pytest
+from aiohttp import web
+
+from charon_tpu import tbls
+from charon_tpu.app.obolapi import ObolAPIClient, publish_lock_best_effort
+from charon_tpu.eth2 import keystore
+from charon_tpu.eth2.keymanager import KeymanagerClient
+from charon_tpu.utils.errors import CharonError
+
+
+def _run(coro, timeout=60):
+    async def wrapped():
+        return await asyncio.wait_for(coro, timeout)
+
+    return asyncio.run(wrapped())
+
+
+async def _serve(routes):
+    app = web.Application()
+    for method, path, handler in routes:
+        app.router.add_route(method, path, handler)
+    runner = web.AppRunner(app)
+    await runner.setup()
+    site = web.TCPSite(runner, "127.0.0.1", 0)
+    await site.start()
+    port = site._server.sockets[0].getsockname()[1]
+    return runner, f"http://127.0.0.1:{port}"
+
+
+class TestKeymanager:
+    def test_import_share_keys_roundtrip(self):
+        async def run():
+            received = {}
+
+            async def handler(request):
+                received["auth"] = request.headers.get("Authorization")
+                received["body"] = await request.json()
+                n = len(received["body"]["keystores"])
+                return web.json_response(
+                    {"data": [{"status": "imported"}] * n})
+
+            runner, url = await _serve(
+                [("POST", "/eth/v1/keystores", handler)])
+            try:
+                shares = [tbls.generate_secret_key() for _ in range(3)]
+                client = KeymanagerClient(url, auth_token="tok123")
+                await client.import_share_keys(shares, insecure_crypto=True)
+            finally:
+                await runner.cleanup()
+
+            assert received["auth"] == "Bearer tok123"
+            body = received["body"]
+            assert len(body["keystores"]) == len(body["passwords"]) == 3
+            # the pushed keystores decrypt back to the exact shares
+            import json as json_mod
+
+            for ks_json, pw, share in zip(body["keystores"],
+                                          body["passwords"], shares):
+                got = keystore.decrypt(json_mod.loads(ks_json), pw)
+                assert bytes(got) == bytes(share)
+
+        _run(run())
+
+    def test_rejection_raises(self):
+        async def run():
+            async def handler(request):
+                return web.json_response(
+                    {"data": [{"status": "error",
+                               "message": "duplicate"}]})
+
+            runner, url = await _serve(
+                [("POST", "/eth/v1/keystores", handler)])
+            try:
+                with pytest.raises(CharonError):
+                    await KeymanagerClient(url).import_share_keys(
+                        [tbls.generate_secret_key()], insecure_crypto=True)
+            finally:
+                await runner.cleanup()
+
+        _run(run())
+
+
+class TestObolAPI:
+    def test_publish_and_best_effort(self):
+        async def run():
+            seen = {}
+
+            async def handler(request):
+                seen["lock"] = await request.json()
+                return web.json_response({}, status=201)
+
+            runner, url = await _serve([("POST", "/lock", handler)])
+            try:
+                await ObolAPIClient(url).publish_lock({"lock_hash": "0xabc"})
+                assert seen["lock"]["lock_hash"] == "0xabc"
+            finally:
+                await runner.cleanup()
+
+            # best-effort: unreachable registry returns False, never raises
+            ok = await publish_lock_best_effort(
+                "http://127.0.0.1:1", {"lock_hash": "0xdef"})
+            assert ok is False
+
+        _run(run())
