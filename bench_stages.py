@@ -1,0 +1,164 @@
+"""Per-stage profiling of the north-star bench (1000 validators, 4-of-6).
+
+Times the ACTUAL production call paths (charon_tpu/ops/plane_agg.py) and, a
+level down, the individual jitted dispatches they are built from, so
+optimization effort lands on the real bottleneck. Run on real TPU hardware.
+Prints one line per stage to stderr and a JSON summary to stdout.
+"""
+
+from __future__ import annotations
+
+import json
+import random
+import secrets
+import sys
+import time
+
+import numpy as np
+
+N = 1000
+T = 4
+NS = 6
+
+
+def tick(label, t0):
+    dt = time.time() - t0
+    print(f"# {label}: {dt:.3f}s", file=sys.stderr)
+    return dt
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+
+    from charon_tpu.tbls.native_impl import NativeImpl
+    from charon_tpu.ops import pallas_plane as PP
+    from charon_tpu.ops import plane_agg as PA
+
+    native = NativeImpl()
+    msg = b"\x42" * 32
+    rng = random.Random(99)
+    stages: dict[str, float] = {}
+
+    t0 = time.time()
+    batches, pubkeys = [], []
+    for _ in range(N):
+        sk = native.generate_secret_key()
+        pubkeys.append(bytes(native.secret_to_public_key(sk)))
+        shares = native.threshold_split(sk, NS, T)
+        ids = sorted(rng.sample(range(1, NS + 1), T))
+        batches.append({i: bytes(native.sign(shares[i], msg)) for i in ids})
+    tick("setup", t0)
+
+    # warm every compile at the production shapes
+    aggs = PA.threshold_aggregate_batch(batches)
+    assert PA.rlc_verify_batch(pubkeys, [msg] * N, aggs)
+
+    # ---- aggregate: end-to-end, then each internal dispatch ---------------
+    t0 = time.time()
+    aggs = PA.threshold_aggregate_batch(batches)
+    stages["agg.total"] = tick("agg.total (production call)", t0)
+
+    V = len(batches)
+    Vp = PA._bucket(V)
+    Wv = Vp // PP.SUB
+    W4 = (Vp * T) // PP.SUB
+    zero96 = b"\xc0" + bytes(95)
+    t0 = time.time()
+    sigs_all = [zero96] * (Vp * T)
+    scalars_all = [0] * (Vp * T)
+    for i, batch in enumerate(batches):
+        ids = sorted(batch)
+        lam = PA._lagrange(tuple(ids))
+        base = (i // Wv) * W4 + (i % Wv)
+        for j in range(len(ids)):
+            sigs_all[base + j * Wv] = bytes(batch[ids[j]])
+            scalars_all[base + j * Wv] = lam[j]
+    stages["agg.gather+lagrange"] = tick("agg.gather+lagrange (host)", t0)
+
+    t0 = time.time()
+    plane = PA.g2_plane_from_compressed(sigs_all, Vp * T)
+    jax.block_until_ready((plane.X, plane.Y, plane.Z))
+    stages["agg.decompress_device"] = tick(
+        "agg.device decompress 4096 G2 (1 jit)", t0)
+
+    t0 = time.time()
+    bits = PP.scalars_to_bitplanes(scalars_all, Vp * T)
+    stages["agg.bitplanes"] = tick("agg.bitplanes (host)", t0)
+
+    t0 = time.time()
+    out = PA._sweep_combine_jit(plane.X, plane.Y, plane.Z,
+                                jnp.asarray(bits), T, Wv)
+    jax.block_until_ready(out)
+    stages["agg.sweep+combine"] = tick("agg.sweep+combine (1 jit)", t0)
+
+    t0 = time.time()
+    RX, RY, RZ = (np.asarray(c) for c in out)
+    from charon_tpu.ops import field as F
+
+    flatX = PP.from_plane(RX, V)
+    flatY = PP.from_plane(RY, V)
+    flatZ = PP.from_plane(RZ, V)
+    jacs = [(F.fq2_to_ints(flatX[i]), F.fq2_to_ints(flatY[i]),
+             F.fq2_to_ints(flatZ[i])) for i in range(V)]
+    got = PA._g2_jacs_to_bytes(jacs)
+    stages["agg.fetch+serialize"] = tick(
+        "agg.fetch + batch-inverse serialize (host)", t0)
+    assert got[0] == aggs[0]
+
+    # ---- verify: end-to-end, then each internal dispatch ------------------
+    t0 = time.time()
+    assert PA.rlc_verify_batch(pubkeys, [msg] * N, aggs)
+    stages["ver.total"] = tick("ver.total (production call, pk cache warm)",
+                               t0)
+
+    Bp = PA._bucket(N)
+    t0 = time.time()
+    sig_plane = PA.g2_plane_from_compressed(aggs, Bp, reject_infinity=True)
+    jax.block_until_ready((sig_plane.X, sig_plane.Y, sig_plane.Z))
+    stages["ver.decompress_sig"] = tick(
+        "ver.device decompress 1000 G2 (1 jit)", t0)
+    t0 = time.time()
+    pk_plane = PA._pk_plane_cached(pubkeys, Bp)
+    stages["ver.pk_plane_cached"] = tick("ver.pk plane (cache hit)", t0)
+
+    t0 = time.time()
+    assert PA.g2_subgroup_ok(sig_plane)
+    stages["ver.subgroup_g2"] = tick("ver.device G2 subgroup (1 jit)", t0)
+
+    rs = [secrets.randbits(PA.RLC_BITS) | 1 for _ in range(N)]
+    t0 = time.time()
+    bits = PP.scalars_to_bitplanes(rs, Bp, nbits=PA.RLC_BITS)
+    stages["ver.rlc_bitplanes"] = tick("ver.rlc bitplanes (host)", t0)
+
+    t0 = time.time()
+    S = PP.pt_reduce_sum(PP.scalar_mul(sig_plane, bits))
+    stages["ver.sig_msm"] = tick("ver.sig G2 MSM sweep+reduce", t0)
+    t0 = time.time()
+    P = PP.pt_reduce_sum(PP.scalar_mul(pk_plane, bits))
+    stages["ver.pk_msm"] = tick("ver.pk G1 MSM sweep+reduce", t0)
+
+    t0 = time.time()
+    from charon_tpu.crypto.curve import g1_generator
+    from charon_tpu.crypto.serialize import g1_to_bytes, g2_to_bytes
+    import ctypes
+
+    lib = PA._native_lib()
+    out96 = (ctypes.c_uint8 * 96)()
+    lib.ct_hash_to_g2(msg, len(msg), out96)
+    g1s = [g1_to_bytes(P), g1_to_bytes(g1_generator())]
+    g2s = [bytes(out96), g2_to_bytes(S)]
+    rc = lib.ct_pairing_check(b"".join(g1s), b"".join(g2s),
+                              bytes([0, 1]), 2, 0)
+    stages["ver.hash+pairing"] = tick("ver.hash_to_g2 + 2 pairings (native)",
+                                      t0)
+    assert rc == 1, "verification failed"
+
+    print(json.dumps({
+        "stages": {k: round(v, 3) for k, v in stages.items()},
+        "throughput": round(N / (stages["agg.total"] + stages["ver.total"]),
+                            1)}))
+
+
+if __name__ == "__main__":
+    main()

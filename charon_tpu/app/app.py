@@ -67,6 +67,7 @@ class Config:
     synthetic_proposals: bool = False
     p2p_fuzz: float = 0.0
     consensus_type: str = "qbft"
+    loki_endpoint: str = ""  # push logs to Loki when set (utils/loki.py)
     test: TestConfig = field(default_factory=TestConfig)
 
 
@@ -143,6 +144,11 @@ class App:
             await asyncio.gather(*closers, return_exceptions=True)
         if self.privkey_lock is not None:
             self.privkey_lock.release()
+        if self.config.loki_endpoint:
+            # flush buffered lines (incl. shutdown logs) and drop the sink
+            from ..utils import loki as loki_mod
+
+            loki_mod.uninstall()
 
 
 async def assemble(config: Config) -> App:
@@ -160,6 +166,14 @@ async def assemble(config: Config) -> App:
     metrics.default_registry.set_const_labels(
         cluster_hash=lock.lock_hash().hex()[:10] if lock is not None else "test",
         cluster_peer=str(keys.my_share_idx))
+
+    if config.loki_endpoint:
+        # ship structured logs with the same identity labels the reference
+        # attaches to its Loki streams (app/app.go:209)
+        from ..utils import loki as loki_mod
+
+        loki_mod.install(config.loki_endpoint, dict(
+            metrics.default_registry.const_labels))
 
     num_nodes = (len(lock.definition.operators) if lock is not None
                  else keys.num_shares)

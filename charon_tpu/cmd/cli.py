@@ -110,6 +110,9 @@ def build_parser() -> argparse.ArgumentParser:
                        help="use the in-process beacon mock (dev/simnet)")
     run_p.add_argument("--simnet-validator-mock", dest="simnet_validator_mock",
                        action="store_true", default=None)
+    run_p.add_argument("--loki-addresses", dest="loki_addresses", default=None,
+                       help="comma-separated Loki push endpoints for log "
+                            "shipping (reference app/log/loki)")
 
     dkg_p = sub.add_parser("dkg", help="participate in a DKG ceremony")
     dkg_p.add_argument("--data-dir", dest="data_dir", default=None,
@@ -215,6 +218,7 @@ def _cmd_run(args: argparse.Namespace) -> int:
         monitoring_host=mon_host, monitoring_port=mon_port,
         beacon_urls=[u for u in (bn or "").split(",") if u],
         p2p_fuzz=float(resolve(args, "p2p_fuzz", 0.0) or 0.0),
+        loki_endpoint=resolve(args, "loki_addresses", "") or "",
         test=test,
     )
     asyncio.run(app_run(config))

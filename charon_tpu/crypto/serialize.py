@@ -70,7 +70,13 @@ def g1_from_bytes(data: bytes, subgroup_check: bool = True):
 
 
 def g2_to_bytes(pt_jac) -> bytes:
-    aff = to_affine(Fq2Ops, pt_jac)
+    return g2_affine_to_bytes(to_affine(Fq2Ops, pt_jac))
+
+
+def g2_affine_to_bytes(aff) -> bytes:
+    """Compress an affine G2 point (None = infinity). Split out so batch
+    paths can amortize the Jacobian→affine inversion (Montgomery batch
+    inverse in ops/plane_agg.py) and serialize the affine forms directly."""
     if aff is None:
         out = bytearray(96)
         out[0] = _COMP | _INF

@@ -211,16 +211,28 @@ def _e_sub(a, b):
 
 
 def _e_mul_many(pairs):
-    """k independent element products (E=1 plain Fq, E=2 Karatsuba 3-mult)
-    through ONE stacked CIOS loop."""
+    """k independent element products through ONE stacked CIOS loop.
+
+    E=1: plain Fq (1 CIOS slot). E=2, a≠b: Karatsuba (3 slots). E=2 with
+    a and b THE SAME OBJECT: complex squaring — (a0+a1·i)² over i²=−1 is
+    ((a0+a1)(a0−a1), 2·a0·a1), 2 slots instead of 3. The point formulas
+    below pass the identical array object for squarings, so the saving is
+    picked up automatically (5 of the 7 products in a double are squares)."""
     E = pairs[0][0].shape[0]
     w = pairs[0][0].shape[-1]
     fq_pairs = []
+    specs = []
     for a, b in pairs:
         if E == 1:
+            specs.append(("q", len(fq_pairs)))
             fq_pairs.append((a[0], b[0]))
+        elif a is b:
+            a0, a1 = a[0], a[1]
+            specs.append(("s", len(fq_pairs)))
+            fq_pairs += [(_fq_add(a0, a1), _fq_sub(a0, a1)), (a0, a1)]
         else:
             a0, a1, b0, b1 = a[0], a[1], b[0], b[1]
+            specs.append(("m", len(fq_pairs)))
             fq_pairs += [(a0, b0), (a1, b1),
                          (_fq_add(a0, a1), _fq_add(b0, b1))]
     A = jnp.concatenate([p[0] for p in fq_pairs], axis=-1)
@@ -228,11 +240,14 @@ def _e_mul_many(pairs):
     R = _mont_many((A, B))
     rs = [R[..., i * w:(i + 1) * w] for i in range(len(fq_pairs))]
     outs = []
-    for i in range(len(pairs)):
-        if E == 1:
+    for kind, i in specs:
+        if kind == "q":
             outs.append(rs[i][None])
+        elif kind == "s":
+            v0, v1 = rs[i], rs[i + 1]
+            outs.append(jnp.stack([v0, _fq_add(v1, v1)], axis=0))
         else:
-            v0, v1, s = rs[3 * i], rs[3 * i + 1], rs[3 * i + 2]
+            v0, v1, s = rs[i], rs[i + 1], rs[i + 2]
             outs.append(jnp.stack(
                 [_fq_sub(v0, v1), _fq_sub(_fq_sub(s, v0), v1)], axis=0))
     return outs
@@ -364,6 +379,102 @@ def _add_call(X1, Y1, Z1, X2, Y2, Z2, E):
 
 
 @functools.partial(jax.jit, static_argnums=(2,))
+def _sub_call(A, B, E):
+    """Elementwise (a - b) mod p on planes; component-wise for E=2."""
+    S, W = A.shape[-2:]
+    tw = min(TW, W)
+
+    def kern(pref, a, b, o):
+        _PCOL[0] = pref[:]
+        o[:] = _unpack(_fq_sub(_pack(a[:]), _pack(b[:])), E)
+
+    return pl.pallas_call(
+        kern,
+        interpret=_interpret(),
+        grid=(W // tw,),
+        in_specs=[_pspec()] + [_espec(E, S, tw)] * 2,
+        out_specs=_espec(E, S, tw),
+        out_shape=_eshape(E, S, W),
+    )(jnp.asarray(_P_NP), A, B)
+
+
+def fe_sub(a, b, E: int):
+    return _sub_call(a, b, E)
+
+
+def fe_neg(a, E: int):
+    return _sub_call(a * 0, a, E)
+
+
+@functools.partial(jax.jit, static_argnums=(2,))
+def _addp_call(A, B, E):
+    """Elementwise (a + b) mod p on planes; component-wise for E=2."""
+    S, W = A.shape[-2:]
+    tw = min(TW, W)
+
+    def kern(pref, a, b, o):
+        _PCOL[0] = pref[:]
+        o[:] = _unpack(_fq_add(_pack(a[:]), _pack(b[:])), E)
+
+    return pl.pallas_call(
+        kern,
+        interpret=_interpret(),
+        grid=(W // tw,),
+        in_specs=[_pspec()] + [_espec(E, S, tw)] * 2,
+        out_specs=_espec(E, S, tw),
+        out_shape=_eshape(E, S, W),
+    )(jnp.asarray(_P_NP), A, B)
+
+
+def fe_add(a, b, E: int):
+    return _addp_call(a, b, E)
+
+
+def exp_bits(e: int, nbits: int = 384) -> np.ndarray:
+    """Fixed exponent -> (nbits,) int32 MSB-first bit array for _pow_scan."""
+    return np.asarray([(e >> (nbits - 1 - i)) & 1 for i in range(nbits)],
+                      np.int32)
+
+
+@jax.jit
+def _pow_scan(A, ebits):
+    """A^e for a packed Fq plane (1, LIMBS, 8, W); e is a SHARED exponent
+    given as an MSB-first bit array (blind square-and-multiply under
+    lax.scan, so one compiled step serves every fixed exponent of the same
+    padded bit-length). Leading zero bits are harmless (acc stays 1).
+    Powers the device square-root/inverse chains of the batched point
+    decompression (plane_agg)."""
+    one_col = np.zeros((1, LIMBS, 1, 1), np.int32)
+    one_col[0, :, 0, 0] = F.fq_from_int(1)
+    one = jnp.broadcast_to(jnp.asarray(one_col), A.shape)
+
+    def step(acc, b):
+        sq = _mul_call(acc, acc, 1)
+        sqm = _mul_call(sq, A, 1)
+        return jnp.where(b != 0, sqm, sq), None
+
+    acc, _ = jax.lax.scan(step, one, ebits)
+    return acc
+
+
+@functools.partial(jax.jit, static_argnums=(3, 4))
+def _shared_mul_call(X, Y, Z, k, E):
+    """k·P for one COMPILE-TIME scalar shared by the whole batch: unrolled
+    MSB-first double-and-add, so only the scalar's set bits cost an add.
+    Used for the endomorphism subgroup sweeps ([u]P, [u²]P) where u is the
+    BLS parameter with Hamming weight 6 — 63 doubles + 5 adds instead of a
+    per-element 64-bit sweep."""
+    assert k >= 1
+    bits = bin(k)[2:]
+    aX, aY, aZ = X, Y, Z
+    for b in bits[1:]:
+        aX, aY, aZ = _double_call(aX, aY, aZ, E)
+        if b == "1":
+            aX, aY, aZ = _add_call(aX, aY, aZ, X, Y, Z, E)
+    return aX, aY, aZ
+
+
+@functools.partial(jax.jit, static_argnums=(2,))
 def _mul_call(A, B, E):
     S, W = A.shape[-2:]
     tw = min(TW, W)
@@ -382,50 +493,87 @@ def _mul_call(A, B, E):
     )(jnp.asarray(_P_NP), A, B)
 
 
+WINDOW = 4
+
+
 @functools.partial(jax.jit, static_argnums=(4,))
-def _scalar_mul_scan(X, Y, Z, bits, E):
-    """Left-to-right double-and-add over per-element scalars.
+def _scalar_mul_windowed(X, Y, Z, digits, E):
+    """4-bit windowed double-and-add over per-element scalars.
 
-    bits: (nbits, 8, W) int32 0/1, MSB first — each batch element has its
-    own scalar. One pallas double + one pallas unified-add + a select per
-    bit, driven by lax.scan so the XLA graph stays small."""
+    digits: (nbits/4, 8, W) int32 in [0,16), MSB-first windows. Builds the
+    16-entry table k·P (7 fused doubles + 7 fused adds), then per window
+    does 4 doubles + ONE unified add of the selected entry — ~2× fewer
+    point-adds than the binary scan. The table select is a masked sum in
+    plain XLA (cheap, HBM-bound); the point ops are the fused pallas
+    kernels. digit==0 selects the ∞ entry (Z=0), which the unified add
+    treats as identity."""
+    tab = [(X * 0, Y * 0, Z * 0), (X, Y, Z)]
+    for k in range(2, 1 << WINDOW):
+        if k % 2 == 0:
+            tab.append(_double_call(*tab[k // 2], E))
+        else:
+            tab.append(_add_call(*tab[k - 1], X, Y, Z, E))
+    TX = jnp.stack([t[0] for t in tab])  # (16, E, LIMBS, 8, W)
+    TY = jnp.stack([t[1] for t in tab])
+    TZ = jnp.stack([t[2] for t in tab])
+    iota = jax.lax.broadcasted_iota(jnp.int32, (1 << WINDOW, 1, 1, 1, 1), 0)
 
-    def step(acc, bit):
+    def step(acc, digit):
         aX, aY, aZ = acc
-        dX, dY, dZ = _double_call(aX, aY, aZ, E)
-        sX, sY, sZ = _add_call(dX, dY, dZ, X, Y, Z, E)
-        m = bit[None, None].astype(bool)
-        return (jnp.where(m, sX, dX), jnp.where(m, sY, dY),
-                jnp.where(m, sZ, dZ)), None
+        for _ in range(WINDOW):
+            aX, aY, aZ = _double_call(aX, aY, aZ, E)
+        oh = (digit[None, None, None] == iota).astype(jnp.int32)
+        sX = jnp.sum(TX * oh, axis=0)
+        sY = jnp.sum(TY * oh, axis=0)
+        sZ = jnp.sum(TZ * oh, axis=0)
+        return _add_call(aX, aY, aZ, sX, sY, sZ, E), None
 
     acc0 = (X * 0, Y * 0, Z * 0)
-    acc, _ = jax.lax.scan(step, acc0, bits)
+    acc, _ = jax.lax.scan(step, acc0, digits)
     return acc
 
 
+def bits_to_digits(bits) -> jnp.ndarray:
+    """(nbits, 8, W) 0/1 MSB-first -> (nbits/WINDOW, 8, W) window digits."""
+    bits = jnp.asarray(bits)
+    n = bits.shape[0]
+    assert n % WINDOW == 0, "scalar bit-length must be a multiple of WINDOW"
+    b = bits.reshape(n // WINDOW, WINDOW, *bits.shape[1:])
+    w = jnp.asarray([1 << (WINDOW - 1 - i) for i in range(WINDOW)],
+                    jnp.int32).reshape(1, WINDOW, 1, 1)
+    return jnp.sum(b * w, axis=1)
+
+
 def scalar_mul(p: PlanePoint, bits) -> PlanePoint:
-    X, Y, Z = _scalar_mul_scan(p.X, p.Y, p.Z, jnp.asarray(bits), p.E)
+    X, Y, Z = _scalar_mul_windowed(p.X, p.Y, p.Z, bits_to_digits(bits), p.E)
     return PlanePoint(X, Y, Z, p.E, p.B)
+
+
+@functools.partial(jax.jit, static_argnums=(3,))
+def _reduce_tree_jit(X, Y, Z, E):
+    """Lane/sublane-halving additions down to (1, TW) elements, as ONE
+    compiled dispatch (each eager device call costs a host↔device round
+    trip, which dominates behind a remote-tunnel TPU)."""
+    while X.shape[-1] > TW:
+        h = X.shape[-1] // 2
+        X, Y, Z = _add_call(X[..., :h], Y[..., :h], Z[..., :h],
+                            X[..., h:], Y[..., h:], Z[..., h:], E)
+    while X.shape[-2] > 1:
+        h = X.shape[-2] // 2
+        X, Y, Z = _add_call(X[..., :h, :], Y[..., :h, :], Z[..., :h, :],
+                            X[..., h:, :], Y[..., h:, :], Z[..., h:, :], E)
+    return X, Y, Z
 
 
 def pt_reduce_sum(p: PlanePoint):
     """Sum ALL batch elements into one point: device lane/sublane-halving
-    down to (1, TW) elements, then a host fold of the final TW Jacobians
-    (pallas compiles are per-shape and expensive, so the device tree stops
-    at a fixed small shape; 127 host bigint adds cost ~10ms). Padding
-    elements are infinity (Z=0), the identity. Returns a host Jacobian
-    tuple of ints (Fq: (x,y,z); Fq2: ((x0,x1),...))."""
+    down to (1, TW) elements (one jitted dispatch), then a host fold of the
+    final TW Jacobians (127 host bigint adds cost ~10ms). Padding elements
+    are infinity (Z=0), the identity. Returns a host Jacobian tuple of ints
+    (Fq: (x,y,z); Fq2: ((x0,x1),...))."""
     from ..crypto import curve as PC
 
-    X, Y, Z = p.X, p.Y, p.Z
-    while X.shape[-1] > TW:
-        h = X.shape[-1] // 2
-        X, Y, Z = _add_call(X[..., :h], Y[..., :h], Z[..., :h],
-                            X[..., h:], Y[..., h:], Z[..., h:], p.E)
-    while X.shape[-2] > 1:
-        h = X.shape[-2] // 2
-        X, Y, Z = _add_call(X[..., :h, :], Y[..., :h, :], Z[..., :h, :],
-                            X[..., h:, :], Y[..., h:, :], Z[..., h:, :], p.E)
+    X, Y, Z = _reduce_tree_jit(p.X, p.Y, p.Z, p.E)
     xs = np.asarray(X).reshape(p.E, LIMBS, -1)
     ys = np.asarray(Y).reshape(p.E, LIMBS, -1)
     zs = np.asarray(Z).reshape(p.E, LIMBS, -1)
@@ -444,11 +592,14 @@ def pt_reduce_sum(p: PlanePoint):
 
 def scalars_to_bitplanes(scalars, B: int, nbits: int = 256) -> np.ndarray:
     """Per-element scalars -> (nbits, 8, Wp) int32 bit planes, MSB first,
-    batch mapped exactly like to_plane."""
+    batch mapped exactly like to_plane. One bulk bytes→array conversion
+    (no per-scalar numpy row writes)."""
     Bp = pad_batch(B)
-    raw = np.zeros((Bp, nbits // 8), dtype=np.uint8)
-    for i, s in enumerate(scalars):
-        raw[i] = np.frombuffer(int(s).to_bytes(nbits // 8, "big"), np.uint8)
+    nb = nbits // 8
+    blob = b"".join(int(s).to_bytes(nb, "big") for s in scalars)
+    raw = np.zeros((Bp, nb), dtype=np.uint8)
+    if len(scalars):
+        raw[:len(scalars)] = np.frombuffer(blob, np.uint8).reshape(-1, nb)
     bits = np.unpackbits(raw, axis=1).astype(np.int32)
     return bits.T.reshape(nbits, SUB, Bp // SUB)
 

@@ -29,7 +29,6 @@ from __future__ import annotations
 
 import numpy as np
 
-from ..crypto.hash_to_curve import hash_to_g2
 from .native_impl import NativeImpl
 from .types import PublicKey, Signature
 
@@ -71,14 +70,15 @@ class TPUImpl(NativeImpl):
         if n < self.min_device_batch or not _on_device():
             return NativeImpl.verify_batch(self, public_keys, datas,
                                            signatures)
-        # Curve + subgroup membership and infinity rejection (matching the
-        # native per-item verifier's semantics) are enforced inside
-        # rlc_verify_batch's bulk native decompression.
+        # Curve membership + infinity rejection run in rlc_verify_batch's
+        # bulk native decode; subgroup membership runs batched on device
+        # (endomorphism checks), matching the native per-item verifier's
+        # semantics.
         from ..ops import plane_agg
 
         return plane_agg.rlc_verify_batch(
             [bytes(pk) for pk in public_keys], [bytes(d) for d in datas],
-            [bytes(s) for s in signatures], hash_to_g2)
+            [bytes(s) for s in signatures])
 
     def verify_batch_each(self, public_keys: list[PublicKey],
                           datas: list[bytes],

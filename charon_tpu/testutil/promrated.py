@@ -1,0 +1,92 @@
+"""promrated: scrape external validator-rating stats into the metrics
+registry.
+
+Mirrors the reference's testutil/promrated (promrated.go:19-28): a small
+side service that periodically queries a rating API (rated.network in the
+reference) for each monitored validator pubkey and republishes the stats as
+gauges, so cluster dashboards can overlay effectiveness/uptime next to the
+node's own metrics. Here the fetch loop is asyncio-native and the HTTP
+client is stdlib (tests point it at a local mock server; the real API needs
+egress, which deployments provide).
+
+Gauges (labelled by pubkey):
+  promrated_effectiveness   combined attester+proposer effectiveness [0,1]
+  promrated_uptime          attester uptime [0,1]
+  promrated_inclusion_delay mean inclusion delay in slots
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import urllib.error
+import urllib.request
+
+from ..utils import log, metrics
+
+_logger = log.with_topic("promrated")
+
+_effectiveness = metrics.gauge(
+    "promrated_effectiveness",
+    "Validator effectiveness from the rating API", ("pubkey",))
+_uptime = metrics.gauge(
+    "promrated_uptime", "Validator uptime from the rating API", ("pubkey",))
+_inclusion_delay = metrics.gauge(
+    "promrated_inclusion_delay",
+    "Mean inclusion delay (slots) from the rating API", ("pubkey",))
+
+
+def fetch_stats(api_url: str, pubkey: str, timeout: float = 10.0) -> dict:
+    """GET <api_url>/v0/eth/validators/<pubkey>/effectiveness and return the
+    parsed JSON object (the rated.network v0 shape: effectiveness, uptime,
+    avgInclusionDelay)."""
+    url = f"{api_url.rstrip('/')}/v0/eth/validators/{pubkey}/effectiveness"
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        return json.loads(resp.read())
+
+
+def record_stats(pubkey: str, stats: dict) -> None:
+    if "effectiveness" in stats:
+        _effectiveness.set(float(stats["effectiveness"]), pubkey)
+    if "uptime" in stats:
+        _uptime.set(float(stats["uptime"]), pubkey)
+    if "avgInclusionDelay" in stats:
+        _inclusion_delay.set(float(stats["avgInclusionDelay"]), pubkey)
+
+
+class Promrated:
+    """Periodic scrape loop over a set of validator pubkeys."""
+
+    def __init__(self, api_url: str, pubkeys: list[str],
+                 interval: float = 600.0):
+        self.api_url = api_url
+        self.pubkeys = [p if p.startswith("0x") else "0x" + p
+                        for p in pubkeys]
+        self.interval = interval
+        self._task: asyncio.Task | None = None
+
+    async def scrape_once(self) -> int:
+        """One pass over all pubkeys; returns how many succeeded."""
+        ok = 0
+        for pk in self.pubkeys:
+            try:
+                stats = await asyncio.to_thread(
+                    fetch_stats, self.api_url, pk)
+                record_stats(pk, stats)
+                ok += 1
+            except (urllib.error.URLError, OSError, ValueError) as err:
+                _logger.warn("rating fetch failed", err=str(err), pubkey=pk)
+        return ok
+
+    async def run(self) -> None:
+        while True:
+            await self.scrape_once()
+            await asyncio.sleep(self.interval)
+
+    def start(self) -> None:
+        self._task = asyncio.get_running_loop().create_task(self.run())
+
+    def stop(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            self._task = None

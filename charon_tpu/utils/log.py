@@ -118,6 +118,25 @@ class Logger:
             print(line, file=_config.out, flush=True)
         except ValueError:
             pass  # closed stream during interpreter shutdown
+        for sink in _sinks:
+            try:
+                sink(line)
+            except Exception:  # noqa: BLE001 — sinks must never break logging
+                pass
+
+
+# Extra line sinks (e.g. the Loki pusher, utils/loki.py). Each receives the
+# fully formatted line; failures are swallowed.
+_sinks: list = []
+
+
+def add_sink(sink) -> None:
+    _sinks.append(sink)
+
+
+def remove_sink(sink) -> None:
+    if sink in _sinks:
+        _sinks.remove(sink)
 
 
 def with_topic(topic: str, **fields: Any) -> Logger:

@@ -1,0 +1,125 @@
+"""Loki push client + promrated scraper against local HTTP mocks
+(reference app/log/loki and testutil/promrated shapes)."""
+
+import asyncio
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, HTTPServer
+
+from charon_tpu.utils import log
+from charon_tpu.utils.loki import LokiPusher
+from charon_tpu.testutil.promrated import Promrated, record_stats
+
+
+class _Recorder(BaseHTTPRequestHandler):
+    received: list = []
+    fail_next: list = []
+
+    def do_POST(self):
+        body = self.rfile.read(int(self.headers["Content-Length"]))
+        if _Recorder.fail_next:
+            _Recorder.fail_next.pop()
+            self.send_response(500)
+            self.end_headers()
+            return
+        _Recorder.received.append((self.path, json.loads(body)))
+        self.send_response(204)
+        self.end_headers()
+
+    def do_GET(self):
+        if "/effectiveness" in self.path:
+            self.send_response(200)
+            self.end_headers()
+            self.wfile.write(json.dumps({
+                "effectiveness": 0.97, "uptime": 0.995,
+                "avgInclusionDelay": 1.2}).encode())
+        else:
+            self.send_response(404)
+            self.end_headers()
+
+    def log_message(self, *a):  # quiet
+        pass
+
+
+def _serve():
+    srv = HTTPServer(("127.0.0.1", 0), _Recorder)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    return srv, f"http://127.0.0.1:{srv.server_port}"
+
+
+class TestLokiPusher:
+    def test_push_batches_lines_with_labels(self):
+        srv, url = _serve()
+        _Recorder.received.clear()
+        p = LokiPusher(url, {"cluster": "test", "node": "n0"}, interval=0.05)
+        p.add("line one", ts=1.0)
+        p.add("line two", ts=2.0)
+        assert p._push_once()
+        path, body = _Recorder.received[-1]
+        assert path == "/loki/api/v1/push"
+        stream = body["streams"][0]
+        assert stream["stream"] == {"cluster": "test", "node": "n0"}
+        assert [v[1] for v in stream["values"]] == ["line one", "line two"]
+        assert stream["values"][0][0] == str(int(1.0 * 1e9))
+        assert p.pushed_total == 2
+        srv.shutdown()
+
+    def test_failed_push_requeues_and_retries(self):
+        srv, url = _serve()
+        _Recorder.received.clear()
+        _Recorder.fail_next.append(True)
+        p = LokiPusher(url, interval=0.05)
+        p.add("will fail then succeed")
+        assert not p._push_once()      # 500 -> requeued
+        assert p.errors_total == 1
+        assert p._push_once()          # retried, delivered
+        assert p.pushed_total == 1
+        srv.shutdown()
+
+    def test_buffer_cap_drops_oldest(self):
+        p = LokiPusher("http://127.0.0.1:1")  # nothing listening
+        from charon_tpu.utils import loki as loki_mod
+
+        old = loki_mod._MAX_BUFFER
+        loki_mod._MAX_BUFFER = 5
+        try:
+            for i in range(8):
+                p.add(f"l{i}")
+            assert p.dropped_total == 3
+            assert [v for _, v in p._buf] == [f"l{i}" for i in range(3, 8)]
+        finally:
+            loki_mod._MAX_BUFFER = old
+
+    def test_log_sink_wiring(self):
+        got = []
+        log.add_sink(got.append)
+        try:
+            log.with_topic("loki-test").info("hello sink", k=1)
+        finally:
+            log.remove_sink(got.append)
+        assert any("hello sink" in line for line in got)
+
+
+class TestPromrated:
+    def test_scrape_records_gauges(self):
+        srv, url = _serve()
+        pr = Promrated(url, ["ab" * 24], interval=60)
+
+        async def run():
+            return await pr.scrape_once()
+
+        ok = asyncio.run(run())
+        assert ok == 1
+        from charon_tpu.utils import metrics
+
+        g = metrics.default_registry.gather()["promrated_effectiveness"]
+        assert g.value("0x" + "ab" * 24) == 0.97
+        srv.shutdown()
+
+    def test_record_stats_partial(self):
+        record_stats("0xdead", {"uptime": 0.5})
+        from charon_tpu.utils import metrics
+
+        assert metrics.default_registry.gather()[
+            "promrated_uptime"].value("0xdead") == 0.5

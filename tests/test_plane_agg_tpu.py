@@ -58,3 +58,105 @@ def test_aggregate_and_rlc_verify_vs_native():
     assert plane_agg.rlc_verify_batch(pks2, msgs, sigs2, hash_to_g2)
     sigs2[0] = sigs2[1]
     assert not plane_agg.rlc_verify_batch(pks2, msgs, sigs2, hash_to_g2)
+
+
+def test_device_subgroup_checks_and_batch_serialize():
+    import numpy as np
+
+    from charon_tpu.crypto import curve as PC
+    from charon_tpu.crypto import fields as PF
+    from charon_tpu.crypto.serialize import g2_affine_to_bytes, g2_to_bytes
+    from charon_tpu.ops import plane_agg
+    from charon_tpu.tbls.native_impl import NativeImpl
+
+    rng = random.Random(44)
+    native = NativeImpl()
+    pts = [PC.jac_mul(PC.Fq2Ops, PC.g2_generator(), rng.randrange(1, PF.R))
+           for _ in range(5)]
+    raw = [g2_to_bytes(p) for p in pts] + [b"\xc0" + bytes(95)]
+    plane = plane_agg.g2_plane_from_compressed(raw, 1024)
+    assert plane_agg.g2_subgroup_ok(plane)
+
+    # on-curve but OUTSIDE the r-subgroup: must be rejected on device,
+    # matching native g2_in_subgroup semantics (bls12381.cpp:800)
+    x1 = 0
+    bad_aff = None
+    while bad_aff is None:
+        x1 += 1
+        cand = (x1, 0)
+        y2 = PF.fq2_add(PF.fq2_mul(PF.fq2_sqr(cand), cand), PC.B_G2)
+        y = PF.fq2_sqrt(y2)
+        if y is not None:
+            bad_aff = (cand, y)
+    assert not PC.g2_in_subgroup(PC.to_jacobian(PC.Fq2Ops, bad_aff))
+    bad_plane = plane_agg.g2_plane_from_compressed(
+        raw[:5] + [g2_affine_to_bytes(bad_aff)], 1024)
+    assert not plane_agg.g2_subgroup_ok(bad_plane)
+
+    sk = native.generate_secret_key()
+    pk = bytes(native.secret_to_public_key(sk))
+    plane1 = plane_agg.g1_plane_from_compressed([pk], 1024)
+    assert plane_agg.g1_subgroup_ok(plane1)
+    xg, yg = 0, None
+    while yg is None:
+        xg += 1
+        yg = PF.fq_sqrt((xg * xg % PF.P * xg + PC.B_G1) % PF.P)
+    assert not PC.g1_in_subgroup(PC.to_jacobian(PC.FqOps, (xg, yg)))
+    out48 = bytearray(xg.to_bytes(48, "big"))
+    out48[0] |= 0x80 | (0x20 if yg > (PF.P - 1) // 2 else 0)
+    bad1 = plane_agg.g1_plane_from_compressed([pk, bytes(out48)], 1024)
+    assert not plane_agg.g1_subgroup_ok(bad1)
+
+    # batch Jacobian->bytes (shared inversion) == per-point serialization
+    jacs = pts + [PC.jac_infinity(PC.Fq2Ops)]
+    got = plane_agg._g2_jacs_to_bytes(jacs)
+    assert got == [g2_to_bytes(j) for j in jacs]
+
+
+def test_windowed_and_shared_scalar_mul_vs_oracle():
+    import numpy as np
+
+    from charon_tpu.crypto import curve as PC
+    from charon_tpu.crypto import fields as PF
+    from charon_tpu.ops import field as F
+    from charon_tpu.ops import pallas_plane as PP
+
+    rng = random.Random(15)
+    g2 = PC.g2_generator()
+    pts = [PC.jac_mul(PC.Fq2Ops, g2, rng.randrange(1, PF.R))
+           for _ in range(4)]
+    B = 1024
+    reps = B // len(pts)
+    X = np.stack([np.stack([F.fq_from_int(p[0][0]), F.fq_from_int(p[0][1])])
+                  for p in pts] * reps)
+    Y = np.stack([np.stack([F.fq_from_int(p[1][0]), F.fq_from_int(p[1][1])])
+                  for p in pts] * reps)
+    Z = np.stack([np.stack([F.fq_from_int(p[2][0]), F.fq_from_int(p[2][1])])
+                  for p in pts] * reps)
+    P = PP.PlanePoint.from_jacobian_arrays(X, Y, Z, 2)
+
+    def to_int(pp, i):
+        x = PP.from_plane(np.asarray(pp.X), B)[i]
+        y = PP.from_plane(np.asarray(pp.Y), B)[i]
+        z = PP.from_plane(np.asarray(pp.Z), B)[i]
+        return ((F.fq_to_int(x[0]), F.fq_to_int(x[1])),
+                (F.fq_to_int(y[0]), F.fq_to_int(y[1])),
+                (F.fq_to_int(z[0]), F.fq_to_int(z[1])))
+
+    # full-width 256-bit windowed sweep incl. scalar edge cases 0, 1, r-1
+    scalars = [rng.randrange(0, PF.R) for _ in range(B)]
+    scalars[0], scalars[1], scalars[2] = 0, 1, PF.R - 1
+    bits = PP.scalars_to_bitplanes(scalars, B)
+    W = PP.scalar_mul(P, bits)
+    for i in [0, 1, 2, 3, 7, 100, 1023]:
+        want = PC.jac_mul(PC.Fq2Ops, pts[i % 4], scalars[i])
+        assert PC.to_affine(PC.Fq2Ops, to_int(W, i)) == \
+            PC.to_affine(PC.Fq2Ops, want)
+
+    # shared compile-time scalar (the endomorphism-sweep primitive)
+    aX, aY, aZ = PP._shared_mul_call(P.X, P.Y, P.Z, PF.X_ABS, 2)
+    S = PP.PlanePoint(aX, aY, aZ, 2, B)
+    for i in range(4):
+        want = PC.jac_mul(PC.Fq2Ops, pts[i], PF.X_ABS)
+        assert PC.to_affine(PC.Fq2Ops, to_int(S, i)) == \
+            PC.to_affine(PC.Fq2Ops, want)

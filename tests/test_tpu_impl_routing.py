@@ -42,7 +42,7 @@ def test_device_branch_dispatch(monkeypatch):
 
     calls = {}
 
-    def fake_rlc(pks, datas, sigs, hash_fn):
+    def fake_rlc(pks, datas, sigs, hash_fn=None):
         calls["args"] = (pks, datas, sigs)
         return True
 
