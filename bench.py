@@ -63,32 +63,28 @@ def main() -> None:
           f"verify {cpu_verify_per*1e3:.2f} ms/op -> "
           f"{cpu_throughput:.1f} validators/s", file=sys.stderr)
 
-    # --- device: aggregate + RLC verify ------------------------------------
-    # Warm once at the FULL shape (compile cache + the static-pubkey plane
-    # cache), then time the steady-state slot: a charon cluster verifies
-    # against the same validator set every slot (reference app/app.go:339
-    # builds the share⇄root maps once from the cluster lock), so the
-    # recurring per-slot cost is what the 12s slot budget must fit.
-    tpu.threshold_aggregate_batch(batches)  # compile/warm
+    # --- device: fused aggregate + RLC verify ------------------------------
+    # The production sigagg hot path (core/sigagg.py) is the FUSED
+    # aggregate+verify device pass. Warm once at the FULL shape (compile
+    # cache + the static-pubkey plane cache), then time the steady-state
+    # slot: a charon cluster verifies against the same validator set every
+    # slot (reference app/app.go:339 builds the share⇄root maps once from
+    # the cluster lock), so the recurring per-slot cost is what the 12s
+    # slot budget must fit.
+    datas = [msg] * N_VALIDATORS
+    tpu.threshold_aggregate_verify_batch(batches, pubkeys, datas)  # warm
     t0 = time.time()
-    aggs = tpu.threshold_aggregate_batch(batches)
-    t_agg = time.time() - t0
-    print(f"# device aggregate: {t_agg:.2f}s for {len(batches)}",
-          file=sys.stderr)
+    aggs, ok = tpu.threshold_aggregate_verify_batch(batches, pubkeys, datas)
+    t_total = time.time() - t0
+    print(f"# device aggregate+verify (fused): {t_total:.2f}s for "
+          f"{len(batches)}, ok={ok}", file=sys.stderr)
+    assert ok, "device verification failed on valid aggregates"
 
     # Bit-identity spot check vs the native oracle.
     for i in range(CPU_SAMPLE):
         assert bytes(aggs[i]) == bytes(cpu_aggs[i]), "bit-identity violation"
 
-    datas = [msg] * N_VALIDATORS
-    tpu.verify_batch(pubkeys, datas, aggs)  # compile/warm + pk-plane cache
-    t0 = time.time()
-    ok = tpu.verify_batch(pubkeys, datas, aggs)
-    t_verify = time.time() - t0
-    print(f"# device verify: {t_verify:.2f}s, ok={ok}", file=sys.stderr)
-    assert ok, "device verification failed on valid aggregates"
-
-    device_throughput = N_VALIDATORS / (t_agg + t_verify)
+    device_throughput = N_VALIDATORS / t_total
     print(json.dumps({
         "metric": "partial-sig verify+aggregate throughput "
                   "(1k validators, 4-of-6)",

@@ -83,27 +83,19 @@ def main() -> None:
         "agg.device decompress 4096 G2 (1 jit)", t0)
 
     t0 = time.time()
-    bits = PP.scalars_to_bitplanes(scalars_all, Vp * T)
-    stages["agg.bitplanes"] = tick("agg.bitplanes (host)", t0)
+    digits = PP.scalars_to_digitplanes(scalars_all, Vp * T)
+    stages["agg.digitplanes"] = tick("agg.digit planes (host)", t0)
 
     t0 = time.time()
     out = PA._sweep_combine_jit(plane.X, plane.Y, plane.Z,
-                                jnp.asarray(bits), T, Wv)
+                                jnp.asarray(digits), T, Wv)
     jax.block_until_ready(out)
     stages["agg.sweep+combine"] = tick("agg.sweep+combine (1 jit)", t0)
 
     t0 = time.time()
-    RX, RY, RZ = (np.asarray(c) for c in out)
-    from charon_tpu.ops import field as F
-
-    flatX = PP.from_plane(RX, V)
-    flatY = PP.from_plane(RY, V)
-    flatZ = PP.from_plane(RZ, V)
-    jacs = [(F.fq2_to_ints(flatX[i]), F.fq2_to_ints(flatY[i]),
-             F.fq2_to_ints(flatZ[i])) for i in range(V)]
-    got = PA._g2_jacs_to_bytes(jacs)
-    stages["agg.fetch+serialize"] = tick(
-        "agg.fetch + batch-inverse serialize (host)", t0)
+    got = PA._g2_serialize_device(*out, V)
+    stages["agg.serialize_device"] = tick(
+        "agg.device affine + byte slice", t0)
     assert got[0] == aggs[0]
 
     # ---- verify: end-to-end, then each internal dispatch ------------------
@@ -128,15 +120,16 @@ def main() -> None:
 
     rs = [secrets.randbits(PA.RLC_BITS) | 1 for _ in range(N)]
     t0 = time.time()
-    bits = PP.scalars_to_bitplanes(rs, Bp, nbits=PA.RLC_BITS)
-    stages["ver.rlc_bitplanes"] = tick("ver.rlc bitplanes (host)", t0)
+    digits = jnp.asarray(PP.scalars_to_digitplanes(rs, Bp,
+                                                   nbits=PA.RLC_BITS))
+    stages["ver.rlc_digits"] = tick("ver.rlc digit planes (host+upload)", t0)
 
     t0 = time.time()
-    S = PP.pt_reduce_sum(PP.scalar_mul(sig_plane, bits))
-    stages["ver.sig_msm"] = tick("ver.sig G2 MSM sweep+reduce", t0)
+    S = PP.msm_sum(sig_plane, digits)
+    stages["ver.sig_msm"] = tick("ver.sig G2 MSM (1 jit + host fold)", t0)
     t0 = time.time()
-    P = PP.pt_reduce_sum(PP.scalar_mul(pk_plane, bits))
-    stages["ver.pk_msm"] = tick("ver.pk G1 MSM sweep+reduce", t0)
+    P = PP.msm_sum(pk_plane, digits)
+    stages["ver.pk_msm"] = tick("ver.pk G1 MSM (1 jit + host fold)", t0)
 
     t0 = time.time()
     from charon_tpu.crypto.curve import g1_generator

@@ -56,10 +56,24 @@ class SigAgg:
             pubkeys.append(pubkey)
             templates.append(chosen[0])
 
-        with _agg_hist.time(str(duty.type)), \
-                tracer.start_span("sigagg/aggregate", duty=str(duty),
-                                  batch=len(batches)):
-            agg_sigs = tbls.threshold_aggregate_batch(batches)
+        # signing roots are independent of the signature, so they can be
+        # computed up front — enabling the fused aggregate+verify device
+        # pass when every item in the batch is verifiable
+        all_eth2 = self._verify and all(
+            isinstance(t.data, _Eth2Signed) for t in templates)
+
+        if all_eth2:
+            with _agg_hist.time(str(duty.type)), \
+                    tracer.start_span("sigagg/aggregate+verify",
+                                      duty=str(duty), batch=len(batches)):
+                agg_sigs, ok = tbls.threshold_aggregate_verify_batch(
+                    batches, [pubkey_to_bytes(pk) for pk in pubkeys],
+                    [t.data.signing_root(self._chain) for t in templates])
+        else:
+            with _agg_hist.time(str(duty.type)), \
+                    tracer.start_span("sigagg/aggregate", duty=str(duty),
+                                      batch=len(batches)):
+                agg_sigs = tbls.threshold_aggregate_batch(batches)
 
         signed: SignedDataSet = {}
         verify_pks: list[tbls.PublicKey] = []
@@ -67,7 +81,7 @@ class SigAgg:
         for pubkey, template, agg in zip(pubkeys, templates, agg_sigs):
             data = template.data.set_signature(agg)
             signed[pubkey] = data
-            if self._verify and isinstance(data, _Eth2Signed):
+            if not all_eth2 and self._verify and isinstance(data, _Eth2Signed):
                 verify_pks.append(pubkey_to_bytes(pubkey))
                 verify_roots.append(data.signing_root(self._chain))
 
@@ -76,6 +90,7 @@ class SigAgg:
                 verify_pks, verify_roots,
                 [signed[pk].signature() for pk in pubkeys
                  if isinstance(signed[pk], _Eth2Signed)])
+        if verify_pks or all_eth2:
             if not ok:
                 # Identify the failing aggregate individually.
                 for pubkey in pubkeys:

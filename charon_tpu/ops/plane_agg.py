@@ -13,12 +13,12 @@ combine is then log₂T unified adds. Outputs are bit-identical to the CPU
 oracle (both compute Σ λⱼ·sigⱼ exactly, same ETH serialization).
 
 rlc_verify_batch — random-linear-combination batch verification (the same
-trick as blst's mult-verify): sample 128-bit rᵢ, compute S = Σ rᵢ·sigᵢ (G2
-MSM, on device) and per distinct message P_m = Σ rᵢ·pkᵢ (G1 MSM, on
+trick as blst's mult-verify): sample RLC_BITS-bit rᵢ, compute S = Σ rᵢ·sigᵢ
+(G2 MSM, on device) and per distinct message P_m = Σ rᵢ·pkᵢ (G1 MSM, on
 device), then check Π e(P_m, H(m)) · e(−g1, S) == 1 with one native
 multi-pairing (ct_pairing_check). Soundness: a forged batch passes with
-probability ≤ 2⁻¹²⁸ over the rᵢ. On failure the caller falls back to
-per-item verification for attribution.
+probability ≤ 2^-RLC_BITS over the rᵢ (see RLC_BITS below). On failure the
+caller falls back to per-item verification for attribution.
 
 Host⇄device traffic is kept cheap: point decompression runs in bulk in the
 native C++ library (ct_g{1,2}_uncompress_bulk) and the byte→Montgomery-limb
@@ -41,7 +41,11 @@ from ..crypto.serialize import g1_to_bytes, g2_to_bytes
 from . import field as F
 from . import pallas_plane as PP
 
-RLC_BITS = 128
+# Random-linear-combination coefficient width. 64-bit randomizers (forgery
+# probability ≤ 2⁻⁶⁴ per submitted batch) match the batch-verification
+# practice of production eth2 clients (blst's mult-verify as used by
+# Prysm/Lighthouse); raise to 128 for 2⁻¹²⁸ at ~2× the MSM cost.
+RLC_BITS = 64
 
 _MONT_ONE = F.fq_from_int(1)
 
@@ -191,15 +195,15 @@ def g1_plane_from_compressed(pks: list[bytes], Bp: int,
 # lexicographic y-sign convention, and off-curve rejection (sqrt failure).
 # ---------------------------------------------------------------------------
 
-_EXP_SQRT = None  # (p+1)/4 bits, lazily built
-_EXP_INV = None   # p-2 bits
+_EXP_SQRT = None  # (p+1)/4 window digits, lazily built
+_EXP_INV = None   # p-2 window digits
 
 
 def _sqrt_inv_bits():
     global _EXP_SQRT, _EXP_INV
     if _EXP_SQRT is None:
-        _EXP_SQRT = PP.exp_bits((PF.P + 1) // 4)
-        _EXP_INV = PP.exp_bits(PF.P - 2)
+        _EXP_SQRT = PP.exp_digits((PF.P + 1) // 4)
+        _EXP_INV = PP.exp_digits(PF.P - 2)
     return _EXP_SQRT, _EXP_INV
 
 
@@ -229,24 +233,26 @@ def _one_raw_plane(S: int, W: int):
         col[None, :, None, None], (1, F.LIMBS, S, W)).copy()
 
 
-def _gt_half(plane):
-    """(1, LIMBS, 8, W) packed MONTGOMERY-form Fq plane -> (8, W) bool:
-    standard-form value > (p-1)/2 (the lexicographic y-sign threshold).
-    Converts to standard form first — limb comparison on Montgomery
-    residues would be meaningless."""
-
+def _gt_half_std(plane):
+    """(1, LIMBS, 8, W) STANDARD-form canonical Fq plane -> (8, W) bool:
+    value > (p-1)/2 (the lexicographic y-sign threshold)."""
     global _HALF_LIMBS
     if _HALF_LIMBS is None:
         _HALF_LIMBS = [int(v) for v in F.limbs_from_int((PF.P - 1) // 2)]
-    S, W = plane.shape[-2:]
-    std = PP._mul_call(plane, _one_raw_plane(S, W), 1)
-    x = std[0]
+    x = plane[0]
     gt = jnp.zeros(x.shape[-2:], bool)
     eq = jnp.ones(x.shape[-2:], bool)
     for j in reversed(range(F.LIMBS)):
         gt = gt | (eq & (x[j] > _HALF_LIMBS[j]))
         eq = eq & (x[j] == _HALF_LIMBS[j])
     return gt
+
+
+def _gt_half(plane):
+    """Montgomery-form variant of _gt_half_std: converts to standard form
+    first — limb comparison on Montgomery residues would be meaningless."""
+    S, W = plane.shape[-2:]
+    return _gt_half_std(PP._mul_call(plane, _one_raw_plane(S, W), 1))
 
 
 def _raw_to_plane(be48: np.ndarray, Bp: int) -> "np.ndarray":
@@ -550,10 +556,12 @@ def _conj_plane(a):
 
 
 @functools.partial(jax.jit, static_argnums=(4, 5))
-def _sweep_combine_jit(X, Y, Z, bits, T, Wv):
+def _sweep_combine_jit(X, Y, Z, digits_u8, T, Wv):
     """Windowed Lagrange sweep + per-validator combine (pairwise-add of the
-    T lane blocks, log₂T rounds) as ONE compiled dispatch."""
-    pX, pY, pZ = PP._scalar_mul_windowed(X, Y, Z, PP.bits_to_digits(bits), 2)
+    T lane blocks, log₂T rounds) as ONE compiled dispatch. digits_u8:
+    (64, 8, W) uint8 window digits (4× leaner transfer than bit planes)."""
+    pX, pY, pZ = PP._scalar_mul_windowed(
+        X, Y, Z, digits_u8.astype(jnp.int32), 2)
     parts = [(pX[..., j * Wv:(j + 1) * Wv], pY[..., j * Wv:(j + 1) * Wv],
               pZ[..., j * Wv:(j + 1) * Wv]) for j in range(T)]
     while len(parts) > 1:
@@ -566,12 +574,10 @@ def _sweep_combine_jit(X, Y, Z, bits, T, Wv):
     return parts[0]
 
 
-def threshold_aggregate_batch(batches: list[dict[int, bytes]]) -> list[bytes]:
-    """Aggregate many validators' threshold partial signatures in one device
-    sweep. batches[i] maps share_idx -> 96-byte compressed G2 signature.
-    Returns compressed aggregates, bit-identical to the CPU oracle."""
-    if not batches:
-        return []
+def _aggregate_plane(batches: list[dict[int, bytes]]):
+    """Common front half of the aggregation paths: combined permuted load +
+    windowed Lagrange sweep + per-validator combine. Returns the aggregate
+    Jacobian plane (RX, RY, RZ) holding V results in a Vp-element plane."""
     V = len(batches)
     T = max(len(b) for b in batches)
     if T == 0:
@@ -596,16 +602,118 @@ def threshold_aggregate_batch(batches: list[dict[int, bytes]]) -> list[bytes]:
             sigs_all[flat] = bytes(batch[ids[j]])
             scalars_all[flat] = lam[j]
     plane = g2_plane_from_compressed(sigs_all, Vp * T)
-    bits = PP.scalars_to_bitplanes(scalars_all, Vp * T)
-    RX, RY, RZ = (np.asarray(c) for c in _sweep_combine_jit(
-        plane.X, plane.Y, plane.Z, jnp.asarray(bits), T, Wv))
+    digits = PP.scalars_to_digitplanes(scalars_all, Vp * T)
+    RX, RY, RZ = _sweep_combine_jit(
+        plane.X, plane.Y, plane.Z, jnp.asarray(digits), T, Wv)
+    return RX, RY, RZ, V, Vp
 
+
+def _serialize_aggregates(RX, RY, RZ, V: int) -> list[bytes]:
+    if not PP._interpret():
+        # affine conversion + standard form on device; host only slices
+        # bytes (the per-point host fq2 inversions/muls were ~0.4s/1000)
+        return _g2_serialize_device(RX, RY, RZ, V)
+    RX, RY, RZ = (np.asarray(c) for c in (RX, RY, RZ))
     flatX = PP.from_plane(RX, V)
     flatY = PP.from_plane(RY, V)
     flatZ = PP.from_plane(RZ, V)
     jacs = [(F.fq2_to_ints(flatX[i]), F.fq2_to_ints(flatY[i]),
              F.fq2_to_ints(flatZ[i])) for i in range(V)]
     return _g2_jacs_to_bytes(jacs)
+
+
+def threshold_aggregate_batch(batches: list[dict[int, bytes]]) -> list[bytes]:
+    """Aggregate many validators' threshold partial signatures in one device
+    sweep. batches[i] maps share_idx -> 96-byte compressed G2 signature.
+    Returns compressed aggregates, bit-identical to the CPU oracle."""
+    if not batches:
+        return []
+    RX, RY, RZ, V, _ = _aggregate_plane(batches)
+    return _serialize_aggregates(RX, RY, RZ, V)
+
+
+def threshold_aggregate_and_verify(batches: list[dict[int, bytes]],
+                                   pks: list[bytes], msgs: list[bytes],
+                                   hash_fn=None):
+    """Fused sigagg hot path: aggregate + RLC-verify in one device pass
+    (reference sigagg aggregates then verifies the SAME signatures,
+    core/sigagg/sigagg.go:144,159). The verification consumes the freshly
+    computed aggregate PLANE directly — no serialize→re-decompress round
+    trip, and no per-aggregate subgroup check (aggregates of in-subgroup
+    partials stay in the subgroup; partials are subgroup-checked on receipt
+    by parsigex/validatorapi, matching the reference's trust boundary).
+    Returns (compressed aggregates, all_valid)."""
+    if not batches:
+        return [], True
+    if not (len(batches) == len(pks) == len(msgs)):
+        raise ValueError("length mismatch")
+    RX, RY, RZ, V, Vp = _aggregate_plane(batches)
+    out = _serialize_aggregates(RX, RY, RZ, V)
+    sig_plane = PP.PlanePoint(RX, RY, RZ, 2, Vp)
+    try:
+        pk_plane = _pk_plane_cached(pks, Vp)
+    except ValueError:
+        return out, False
+    return out, _rlc_check(sig_plane, pk_plane, msgs, hash_fn)
+
+
+@jax.jit
+def _g2_affine_std_jit(X, Y, Z):
+    """Jacobian G2 plane -> affine standard-form coordinate planes + sign
+    and infinity masks, ONE compiled dispatch. The field inversion is a
+    batched fixed-exponent power scan (Fq2 inverse via conj/norm), so no
+    host bigint inversions remain on the aggregate output path."""
+    z0, z1 = Z[0][None], Z[1][None]
+    norm = PP.fe_add(PP._mul_call(z0, z0, 1), PP._mul_call(z1, z1, 1), 1)
+    _, inv_bits = _sqrt_inv_bits()
+    ninv = PP._pow_scan(norm, jnp.asarray(inv_bits))
+    zi = jnp.concatenate([PP._mul_call(z0, ninv, 1)[0][None],
+                          PP._mul_call(PP.fe_neg(z1, 1), ninv, 1)[0][None]],
+                         axis=0)  # 1/z = conj(z)/|z|²
+    zi2 = PP.fe_mul(zi, zi, 2)
+    zi3 = PP.fe_mul(zi2, zi, 2)
+    xa = PP.fe_mul(X, zi2, 2)
+    ya = PP.fe_mul(Y, zi3, 2)
+    # standard form for byte emission + sign convention
+    S, W = z0.shape[-2:]
+    one_raw = _one_raw_plane(S, 2 * W)
+    xs = PP._unpack(PP._mul_call(PP._pack(xa)[None], one_raw, 1)[0], 2)
+    ys = PP._unpack(PP._mul_call(PP._pack(ya)[None], one_raw, 1)[0], 2)
+    inf = jnp.all(Z == 0, axis=(0, 1))
+    y0s, y1s = ys[0][None], ys[1][None]
+    y1nz = ~jnp.all(y1s == 0, axis=(0, 1))
+    sign = jnp.where(y1nz, _gt_half_std(y1s), _gt_half_std(y0s))
+    return xs, sign, inf
+
+
+def _fp_limbs_to_be(limbs: np.ndarray) -> np.ndarray:
+    """(n, 32) int32 12-bit limbs -> (n, 48) uint8 big-endian bytes
+    (vectorized inverse of _fp_limbs_raw)."""
+    lo, hi = limbs[:, 0::2], limbs[:, 1::2]
+    b0 = lo & 0xFF
+    b1 = ((lo >> 8) & 0xF) | ((hi & 0xF) << 4)
+    b2 = (hi >> 4) & 0xFF
+    le = np.stack([b0, b1, b2], axis=2).reshape(len(limbs), 48)
+    return le[:, ::-1].astype(np.uint8)
+
+
+def _g2_serialize_device(RX, RY, RZ, V: int) -> list[bytes]:
+    xs, sign, inf = _g2_affine_std_jit(RX, RY, RZ)
+    x_np = np.asarray(xs)
+    sign_np = np.asarray(sign).reshape(-1)[:V]
+    inf_np = np.asarray(inf).reshape(-1)[:V]
+    x0 = _fp_limbs_to_be(PP.from_plane(x_np[0][None], V))
+    x1 = _fp_limbs_to_be(PP.from_plane(x_np[1][None], V))
+    inf_bytes = b"\xc0" + bytes(95)
+    out = []
+    for i in range(V):
+        if inf_np[i]:
+            out.append(inf_bytes)
+            continue
+        b = bytearray(x1[i].tobytes() + x0[i].tobytes())
+        b[0] |= 0x80 | (0x20 if sign_np[i] else 0)
+        out.append(bytes(b))
+    return out
 
 
 def _g2_jacs_to_bytes(jacs: list) -> list[bytes]:
@@ -685,7 +793,6 @@ def rlc_verify_batch(pks: list[bytes], msgs: list[bytes], sigs: list[bytes],
         return True
     if not (len(pks) == len(sigs) == n):
         raise ValueError("length mismatch")
-    rs = [secrets.randbits(RLC_BITS) | 1 for _ in range(n)]
     Bp = _bucket(n)
 
     try:
@@ -695,29 +802,57 @@ def rlc_verify_batch(pks: list[bytes], msgs: list[bytes], sigs: list[bytes],
         return False
     if not g2_subgroup_ok(sig_plane):
         return False
-    bits = PP.scalars_to_bitplanes(rs, Bp, nbits=RLC_BITS)
+    return _rlc_check(sig_plane, pk_plane, msgs, hash_fn)
 
-    S = PP.pt_reduce_sum(PP.scalar_mul(sig_plane, bits))
+
+def _rlc_dispatch(sig_plane: PP.PlanePoint, pk_plane: PP.PlanePoint,
+                  msgs: list[bytes]):
+    """Issue the RLC MSM device work ASYNCHRONOUSLY and return the pending
+    state. Callers can overlap host work (e.g. aggregate serialization)
+    between dispatch and _rlc_finish. Padding lanes beyond len(msgs) carry
+    zero randomizers (∞ contributions)."""
+    n = len(msgs)
+    Bp = sig_plane.B
+    rs = [secrets.randbits(RLC_BITS) | 1 for _ in range(n)]
+    # one uint8 digit transfer shared by the sig and pk MSM dispatches
+    digits = jnp.asarray(
+        PP.scalars_to_digitplanes(rs, Bp, nbits=RLC_BITS))
+
+    sig_red = PP._msm_reduce_jit(sig_plane.X, sig_plane.Y, sig_plane.Z,
+                                 digits, 2)
 
     groups: dict[bytes, list[int]] = {}
     for i, m in enumerate(msgs):
         groups.setdefault(bytes(m), []).append(i)
 
-    pk_mul = PP.scalar_mul(pk_plane, bits)
-    g1_pts, g2_pts, negs = [], [], []
-
-    for m, idxs in groups.items():
-        if len(groups) == 1:
-            P = PP.pt_reduce_sum(pk_mul)
-        else:
+    pk_reds: list[tuple[bytes, tuple]] = []
+    if len(groups) == 1:
+        m = next(iter(groups))
+        pk_reds.append((m, PP._msm_reduce_jit(
+            pk_plane.X, pk_plane.Y, pk_plane.Z, digits, 1)))
+    else:
+        pX, pY, pZ = PP._scalar_mul_windowed(
+            pk_plane.X, pk_plane.Y, pk_plane.Z,
+            digits.astype(jnp.int32), 1)
+        for m, idxs in groups.items():
             mask = np.zeros(Bp, dtype=bool)
             mask[idxs] = True
             mplane = jnp.asarray(
                 mask.reshape(PP.SUB, Bp // PP.SUB)[None, None])
-            masked = PP.PlanePoint(
-                jnp.where(mplane, pk_mul.X, 0), jnp.where(mplane, pk_mul.Y, 0),
-                jnp.where(mplane, pk_mul.Z, 0), 1, Bp)
-            P = PP.pt_reduce_sum(masked)
+            mX = jnp.where(mplane, pX, 0)
+            mY = jnp.where(mplane, pY, 0)
+            mZ = jnp.where(mplane, pZ, 0)
+            pk_reds.append((m, PP._reduce_tree_jit(mX, mY, mZ, 1)))
+    return sig_red, pk_reds
+
+
+def _rlc_finish(state, hash_fn=None) -> bool:
+    """Await the dispatched MSMs (host fold) and run the multi-pairing."""
+    sig_red, pk_reds = state
+    S = PP._host_fold(*sig_red, 2)
+    g1_pts, g2_pts, negs = [], [], []
+    for m, red in pk_reds:
+        P = PP._host_fold(*red, 1)
         if jac_is_infinity(FqOps, P):
             # degenerate pk combination: only consistent with S lacking any
             # contribution from this group — the pairing check below still
@@ -745,3 +880,10 @@ def rlc_verify_batch(pks: list[bytes], msgs: list[bytes], sigs: list[bytes],
     rc = lib.ct_pairing_check(b"".join(g1_pts), b"".join(g2_pts),
                               bytes(negs), len(negs), 0)
     return rc == 1
+
+
+def _rlc_check(sig_plane: PP.PlanePoint, pk_plane: PP.PlanePoint,
+               msgs: list[bytes], hash_fn=None) -> bool:
+    """The RLC core over already-loaded planes: shared-digit MSMs + one
+    native multi-pairing."""
+    return _rlc_finish(_rlc_dispatch(sig_plane, pk_plane, msgs), hash_fn)

@@ -61,6 +61,17 @@ class Implementation(Protocol):
     def verify_batch(self, public_keys: list[PublicKey], datas: list[bytes], signatures: list[Signature]) -> bool: ...
     def threshold_aggregate_batch(self, batches: list[dict[int, Signature]]) -> list[Signature]: ...
 
+    def threshold_aggregate_verify_batch(
+            self, batches: list[dict[int, Signature]],
+            public_keys: list[PublicKey],
+            datas: list[bytes]) -> tuple[list[Signature], bool]:
+        """Fused sigagg hot path: aggregate each batch, then verify every
+        aggregate against (public_key, data). Backends may fuse the two
+        (the TPU backend verifies the freshly computed aggregate plane
+        without a serialize→decompress round trip); the default is the
+        two-call sequence (reference core/sigagg/sigagg.go:144,159)."""
+        ...
+
 
 _lock = threading.Lock()
 _impl: Implementation | None = None
@@ -124,6 +135,13 @@ def verify(public_key: PublicKey, data: bytes, signature: Signature) -> bool:
 
 def verify_batch(public_keys: list[PublicKey], datas: list[bytes], signatures: list[Signature]) -> bool:
     return get_implementation().verify_batch(public_keys, datas, signatures)
+
+
+def threshold_aggregate_verify_batch(
+        batches: list[dict[int, Signature]], public_keys: list[PublicKey],
+        datas: list[bytes]) -> tuple[list[Signature], bool]:
+    return get_implementation().threshold_aggregate_verify_batch(
+        batches, public_keys, datas)
 
 
 def aggregate(sigs: list[Signature]) -> Signature:

@@ -154,6 +154,12 @@ class NativeImpl(FrScalarOps):
     def threshold_aggregate_batch(self, batches: list[dict[int, Signature]]) -> list[Signature]:
         return [self.threshold_aggregate(b) for b in batches]
 
+    def threshold_aggregate_verify_batch(self, batches, public_keys, datas):
+        """Two-call default (reference core/sigagg/sigagg.go:144,159); the
+        TPU backend fuses the pair into one device pass."""
+        sigs = self.threshold_aggregate_batch(batches)
+        return sigs, self.verify_batch(public_keys, datas, sigs)
+
     # -- signing / verification ------------------------------------------------
 
     def sign(self, private_key: PrivateKey, data: bytes) -> Signature:

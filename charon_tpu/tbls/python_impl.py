@@ -178,3 +178,7 @@ class PythonImpl(FrScalarOps):
 
     def threshold_aggregate_batch(self, batches: list[dict[int, Signature]]) -> list[Signature]:
         return [self.threshold_aggregate(b) for b in batches]
+
+    def threshold_aggregate_verify_batch(self, batches, public_keys, datas):
+        sigs = self.threshold_aggregate_batch(batches)
+        return sigs, self.verify_batch(public_keys, datas, sigs)

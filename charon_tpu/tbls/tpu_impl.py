@@ -10,10 +10,11 @@ Routes the duty pipeline's hot calls onto the fused Pallas kernel plane
     serialization (the cross-implementation randomized suite, reference
     tbls/tbls_test.go:210-240, holds across the triple).
   * verify_batch — random-linear-combination batch verification: device
-    G1/G2 MSMs with 128-bit coefficients + one native multi-pairing
+    G1/G2 MSMs with 64-bit coefficients + one native multi-pairing
     (reference hot loops: per-partial tbls.Verify in
     core/parsigex/parsigex.go:61 and the aggregate verify in
-    core/sigagg/sigagg.go:159). Sound to 2⁻¹²⁸; a False means at least one
+    core/sigagg/sigagg.go:159). Sound to 2⁻⁶⁴ per batch (eth2-client
+    batch-verification practice, blst mult-verify); a False means at least one
     bad signature and callers attribute per-item.
 
 Everything else (keygen, split/recover, sign, single verify) delegates to
@@ -79,6 +80,27 @@ class TPUImpl(NativeImpl):
         return plane_agg.rlc_verify_batch(
             [bytes(pk) for pk in public_keys], [bytes(d) for d in datas],
             [bytes(s) for s in signatures])
+
+    def threshold_aggregate_verify_batch(self, batches, public_keys, datas):
+        """Fused device pass: the RLC verification consumes the freshly
+        computed aggregate plane (no serialize→decompress round trip and no
+        redundant subgroup check — aggregates of in-subgroup partials stay
+        in the subgroup)."""
+        n = len(batches)
+        if not (n == len(public_keys) == len(datas)):
+            raise ValueError("length mismatch")
+        if n < self.min_device_batch or not _on_device():
+            return NativeImpl.threshold_aggregate_verify_batch(
+                self, batches, public_keys, datas)
+        for b in batches:
+            if not b:
+                raise ValueError("no partial signatures to aggregate")
+        from ..ops import plane_agg
+
+        raw, ok = plane_agg.threshold_aggregate_and_verify(
+            [{i: bytes(s) for i, s in b.items()} for b in batches],
+            [bytes(pk) for pk in public_keys], [bytes(d) for d in datas])
+        return [Signature(r) for r in raw], ok
 
     def verify_batch_each(self, public_keys: list[PublicKey],
                           datas: list[bytes],

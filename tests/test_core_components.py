@@ -143,3 +143,43 @@ def test_fork_aware_domains():
     # Deposit/builder domains pin the genesis fork regardless of epoch.
     assert signing.get_domain(chain, signing.DOMAIN_DEPOSIT, 10) == \
         signing.get_domain(chain, signing.DOMAIN_DEPOSIT, 0)
+
+
+def test_sigagg_uses_fused_aggregate_verify(monkeypatch):
+    """When every item is eth2-verifiable, SigAgg routes through the FUSED
+    tbls.threshold_aggregate_verify_batch (the TPU backend's one-pass
+    sigagg hot path) instead of separate aggregate + verify calls."""
+
+    async def run():
+        chain = spec.ChainSpec(genesis_time=0)
+        root_secrets, nodes = new_cluster_for_t(3, 2, 2)
+        keys = nodes[0]
+        duty = types.Duty(6, types.DutyType.ATTESTER)
+        parsigs = {}
+        for root_pk in keys.root_pubkeys:
+            parsigs[root_pk] = [
+                _psd(chain, nodes[i].my_share_secrets[root_pk], i + 1)
+                for i in range(2)]
+
+        calls = {"fused": 0, "split": 0}
+        real = tbls.threshold_aggregate_verify_batch
+
+        def spy_fused(batches, pks, datas):
+            calls["fused"] += 1
+            return real(batches, pks, datas)
+
+        def spy_split(batches):
+            calls["split"] += 1
+            raise AssertionError("split aggregate path should not run")
+
+        monkeypatch.setattr(tbls, "threshold_aggregate_verify_batch",
+                            spy_fused)
+        monkeypatch.setattr(tbls, "threshold_aggregate_batch", spy_split)
+        agg = sigagg.SigAgg(keys, chain)
+        out = []
+        agg.subscribe(lambda d, s: _collect(out, d, s))
+        await agg.aggregate(duty, parsigs)
+        assert calls == {"fused": 1, "split": 0}
+        assert len(out) == 1
+
+    asyncio.run(run())

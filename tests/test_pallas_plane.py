@@ -202,3 +202,13 @@ class TestWindowedAndShared:
                             2, self.P.B)
         assert np.asarray(PA._jac_eq_mask(INF, INF)).all()
         assert not np.asarray(PA._jac_eq_mask(INF, self.P)).any()
+
+
+def test_scalars_to_digitplanes_matches_bitplanes():
+    rng = random.Random(21)
+    scalars = [rng.randrange(0, PF.R) for _ in range(100)] + [0, 1, PF.R - 1]
+    bits = PP.scalars_to_bitplanes(scalars, len(scalars))
+    digits = PP.scalars_to_digitplanes(scalars, len(scalars))
+    assert digits.dtype == np.uint8
+    want = np.asarray(PP.bits_to_digits(bits))
+    assert (digits.astype(np.int32) == want).all()

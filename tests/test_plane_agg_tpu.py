@@ -160,3 +160,36 @@ def test_windowed_and_shared_scalar_mul_vs_oracle():
         want = PC.jac_mul(PC.Fq2Ops, pts[i], PF.X_ABS)
         assert PC.to_affine(PC.Fq2Ops, to_int(S, i)) == \
             PC.to_affine(PC.Fq2Ops, want)
+
+
+def test_fused_aggregate_and_verify():
+    from charon_tpu.ops import plane_agg
+    from charon_tpu.tbls.native_impl import NativeImpl
+
+    rng = random.Random(77)
+    native = NativeImpl()
+    msg = b"\x42" * 32
+    V = 96
+    batches, pks, msgs = [], [], []
+    for i in range(V):
+        sk = native.generate_secret_key()
+        pks.append(bytes(native.secret_to_public_key(sk)))
+        shares = native.threshold_split(sk, 6, 4)
+        ids = sorted(rng.sample(range(1, 7), 4))
+        m = msg if i % 2 == 0 else b"\x43" * 32
+        msgs.append(m)
+        batches.append({j: bytes(native.sign(shares[j], m)) for j in ids})
+
+    aggs, ok = plane_agg.threshold_aggregate_and_verify(batches, pks, msgs)
+    assert ok
+    for i in range(0, V, 7):
+        want = native.threshold_aggregate(
+            {j: __import__("charon_tpu.tbls.types", fromlist=["Signature"])
+             .Signature(s) for j, s in batches[i].items()})
+        assert aggs[i] == bytes(want)
+
+    # wrong message must fail the fused verification
+    bad_msgs = list(msgs)
+    bad_msgs[3] = b"\x99" * 32
+    _, ok = plane_agg.threshold_aggregate_and_verify(batches, pks, bad_msgs)
+    assert not ok
