@@ -50,11 +50,10 @@ def bench_sigagg100() -> None:
         assert native.verify(pk, msg, agg)
     t_cpu = time.time() - t0
 
-    tpu.threshold_aggregate_batch(batches)  # warm
-    tpu.verify_batch(pks, [msg] * 100, cpu_aggs)
+    datas = [msg] * 100
+    tpu.threshold_aggregate_verify_batch(batches, pks, datas)  # warm
     t0 = time.time()
-    aggs = tpu.threshold_aggregate_batch(batches)
-    ok = tpu.verify_batch(pks, [msg] * 100, aggs)
+    aggs, ok = tpu.threshold_aggregate_verify_batch(batches, pks, datas)
     t_dev = time.time() - t0
     assert ok and [bytes(a) for a in aggs] == [bytes(a) for a in cpu_aggs]
     _emit("sigagg 100DV 4-of-6 agg+verify", 100 / t_dev, "validators/sec",

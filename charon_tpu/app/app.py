@@ -68,6 +68,7 @@ class Config:
     p2p_fuzz: float = 0.0
     consensus_type: str = "qbft"
     loki_endpoint: str = ""  # push logs to Loki when set (utils/loki.py)
+    otlp_endpoint: str = ""  # export trace spans via OTLP/HTTP (utils/otlp.py)
     test: TestConfig = field(default_factory=TestConfig)
 
 
@@ -149,6 +150,10 @@ class App:
             from ..utils import loki as loki_mod
 
             loki_mod.uninstall()
+        if self.config.otlp_endpoint:
+            from ..utils import otlp as otlp_mod
+
+            otlp_mod.uninstall()
 
 
 async def assemble(config: Config) -> App:
@@ -174,6 +179,12 @@ async def assemble(config: Config) -> App:
 
         loki_mod.install(config.loki_endpoint, dict(
             metrics.default_registry.const_labels))
+    if config.otlp_endpoint:
+        # span export (reference app/tracer Jaeger/OTLP seam, trace.go:40)
+        from ..utils import otlp as otlp_mod
+
+        otlp_mod.install(config.otlp_endpoint,
+                         labels=dict(metrics.default_registry.const_labels))
 
     num_nodes = (len(lock.definition.operators) if lock is not None
                  else keys.num_shares)

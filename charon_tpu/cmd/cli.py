@@ -113,6 +113,9 @@ def build_parser() -> argparse.ArgumentParser:
     run_p.add_argument("--loki-addresses", dest="loki_addresses", default=None,
                        help="comma-separated Loki push endpoints for log "
                             "shipping (reference app/log/loki)")
+    run_p.add_argument("--otlp-address", dest="otlp_address", default=None,
+                       help="OTLP/HTTP collector endpoint for trace export "
+                            "(reference app/tracer Jaeger seam)")
 
     dkg_p = sub.add_parser("dkg", help="participate in a DKG ceremony")
     dkg_p.add_argument("--data-dir", dest="data_dir", default=None,
@@ -219,6 +222,7 @@ def _cmd_run(args: argparse.Namespace) -> int:
         beacon_urls=[u for u in (bn or "").split(",") if u],
         p2p_fuzz=float(resolve(args, "p2p_fuzz", 0.0) or 0.0),
         loki_endpoint=resolve(args, "loki_addresses", "") or "",
+        otlp_endpoint=resolve(args, "otlp_address", "") or "",
         test=test,
     )
     asyncio.run(app_run(config))

@@ -648,13 +648,17 @@ def threshold_aggregate_and_verify(batches: list[dict[int, bytes]],
     if not (len(batches) == len(pks) == len(msgs)):
         raise ValueError("length mismatch")
     RX, RY, RZ, V, Vp = _aggregate_plane(batches)
-    out = _serialize_aggregates(RX, RY, RZ, V)
     sig_plane = PP.PlanePoint(RX, RY, RZ, 2, Vp)
     try:
         pk_plane = _pk_plane_cached(pks, Vp)
     except ValueError:
-        return out, False
-    return out, _rlc_check(sig_plane, pk_plane, msgs, hash_fn)
+        return _serialize_aggregates(RX, RY, RZ, V), False
+    # dispatch the MSM device work FIRST, serialize while it runs, then
+    # finish (host fold + pairing) — the serialization's host loop overlaps
+    # the queued device dispatches
+    state = _rlc_dispatch(sig_plane, pk_plane, msgs)
+    out = _serialize_aggregates(RX, RY, RZ, V)
+    return out, _rlc_finish(state, hash_fn)
 
 
 @jax.jit

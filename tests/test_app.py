@@ -84,7 +84,10 @@ class TestAppShell:
 
                 # inclusion checker: the mock includes each attestation one
                 # slot after submission; wait for the checker to observe it
-                while asyncio.get_running_loop().time() < deadline:
+                # (own deadline — the attestation wait may have consumed most
+                # of the shared one on a loaded box)
+                inc_deadline = asyncio.get_running_loop().time() + 40
+                while asyncio.get_running_loop().time() < inc_deadline:
                     if apps[0].inclusion.included:
                         break
                     await asyncio.sleep(0.1)

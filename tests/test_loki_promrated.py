@@ -123,3 +123,56 @@ class TestPromrated:
 
         assert metrics.default_registry.gather()[
             "promrated_uptime"].value("0xdead") == 0.5
+
+
+class TestOTLPExporter:
+    def test_export_spans_otlp_shape(self):
+        from charon_tpu.utils import tracer
+        from charon_tpu.utils.otlp import OTLPExporter
+
+        srv, url = _serve()
+        _Recorder.received.clear()
+        exp = OTLPExporter(url, service="charon-test",
+                           labels={"cluster_peer": "1"}, interval=0.05)
+        tracer.set_exporter(exp.export)
+        try:
+            tracer.rooted_ctx(42, "attester")
+            with tracer.start_span("sigagg/aggregate", duty="42/attester"):
+                with tracer.start_span("tbls/threshold_aggregate"):
+                    pass
+        finally:
+            tracer.set_exporter(None)
+        assert exp._push_once()
+        path, body = _Recorder.received[-1]
+        assert path == "/v1/traces"
+        rs = body["resourceSpans"][0]
+        names = {a["key"]: a["value"]["stringValue"]
+                 for a in rs["resource"]["attributes"]}
+        assert names["service.name"] == "charon-test"
+        assert names["cluster_peer"] == "1"
+        spans = rs["scopeSpans"][0]["spans"]
+        assert [s["name"] for s in spans] == [
+            "tbls/threshold_aggregate", "sigagg/aggregate"]
+        # deterministic duty-derived trace id: shared by both spans,
+        # child links to parent
+        assert spans[0]["traceId"] == spans[1]["traceId"]
+        assert spans[0]["parentSpanId"] == spans[1]["spanId"]
+        assert exp.pushed_total == 2
+        srv.shutdown()
+
+    def test_failed_push_requeues(self):
+        from charon_tpu.utils.otlp import OTLPExporter
+        from charon_tpu.utils import tracer
+
+        srv, url = _serve()
+        _Recorder.received.clear()
+        _Recorder.fail_next.append(True)
+        exp = OTLPExporter(url, interval=0.05)
+        with tracer.start_span("x"):
+            pass
+        exp.export(tracer.finished_spans()[-1])
+        assert not exp._push_once()
+        assert exp.errors_total == 1
+        assert exp._push_once()
+        assert exp.pushed_total == 1
+        srv.shutdown()

@@ -212,3 +212,32 @@ def test_scalars_to_digitplanes_matches_bitplanes():
     assert digits.dtype == np.uint8
     want = np.asarray(PP.bits_to_digits(bits))
     assert (digits.astype(np.int32) == want).all()
+
+
+def test_fp_limbs_to_be_roundtrip_and_flag_packing():
+    """The device-serializer's numpy back half: limb->byte reassembly is the
+    exact inverse of the loader's byte->limb slicing, and the compressed-G2
+    flag/sign packing matches the host serializer byte-for-byte."""
+    from charon_tpu.ops import plane_agg as PA
+
+    rng = random.Random(23)
+    vals = [rng.randrange(0, F.P_INT) for _ in range(64)] + [0, 1, F.P_INT - 1]
+    be = np.stack([np.frombuffer(v.to_bytes(48, "big"), np.uint8)
+                   for v in vals])
+    limbs = PA._fp_limbs_raw(be)
+    back = PA._fp_limbs_to_be(limbs)
+    assert (back == be).all()
+
+    # flag packing: emulate _g2_serialize_device's byte assembly for known
+    # affine points and compare against the host serializer
+    from charon_tpu.crypto import curve as PC
+    from charon_tpu.crypto import fields as PF
+    from charon_tpu.crypto.serialize import g2_to_bytes
+
+    for i in range(4):
+        pt = PC.jac_mul(PC.Fq2Ops, PC.g2_generator(), rng.randrange(1, PF.R))
+        (x0, x1), y = PC.to_affine(PC.Fq2Ops, pt)
+        sign = PF.fq2_sign(y)
+        b = bytearray(x1.to_bytes(48, "big") + x0.to_bytes(48, "big"))
+        b[0] |= 0x80 | (0x20 if sign else 0)
+        assert bytes(b) == g2_to_bytes(pt)
