@@ -6,11 +6,15 @@ per-validator tbls.ThresholdAggregate + aggregate Verify in
 core/sigagg/sigagg.go:144,159):
 
 threshold_aggregate_batch — per-validator Lagrange combination Σ λⱼ·sigⱼ for
-a whole batch of validators in one device scalar-mul sweep. The T partial
-signatures of each validator live in T lane-blocks of one batch, so the
-256-step double-and-add runs once over T·V points; the per-validator
-combine is then log₂T unified adds. Outputs are bit-identical to the CPU
-oracle (both compute Σ λⱼ·sigⱼ exactly, same ETH serialization).
+a whole batch of validators in one device sweep. The T partial signatures
+of each validator live in T lane-blocks of one batch, so the 4-bit-windowed
+scalar sweep runs once over T·V points; the per-validator combine is then
+log₂T unified adds. Outputs are bit-identical to the CPU oracle (both
+compute Σ λⱼ·sigⱼ exactly, same ETH serialization).
+
+threshold_aggregate_and_verify — the fused sigagg hot path: the RLC
+verification consumes the freshly computed aggregate plane, with the MSMs
+dispatched asynchronously so the device affine serialization overlaps them.
 
 rlc_verify_batch — random-linear-combination batch verification (the same
 trick as blst's mult-verify): sample RLC_BITS-bit rᵢ, compute S = Σ rᵢ·sigᵢ
@@ -20,9 +24,11 @@ multi-pairing (ct_pairing_check). Soundness: a forged batch passes with
 probability ≤ 2^-RLC_BITS over the rᵢ (see RLC_BITS below). On failure the
 caller falls back to per-item verification for attribution.
 
-Host⇄device traffic is kept cheap: point decompression runs in bulk in the
-native C++ library (ct_g{1,2}_uncompress_bulk) and the byte→Montgomery-limb
-conversion is numpy-vectorized — no Python square roots on the hot path.
+Host⇄device traffic is kept lean: on a real device the decompression
+square roots, Montgomery conversion, subgroup checks, and affine output
+conversion all run batched on device (the native C++ bulk decode remains
+the small-batch/interpret path and the test oracle); host work is byte
+slicing plus uint8 digit-plane uploads.
 """
 
 from __future__ import annotations

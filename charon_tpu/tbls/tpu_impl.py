@@ -18,8 +18,9 @@ Routes the duty pipeline's hot calls onto the fused Pallas kernel plane
     bad signature and callers attribute per-item.
 
 Everything else (keygen, split/recover, sign, single verify) delegates to
-the native C++ backend. Small batches stay on the CPU: the device sweep
-has a fixed ~1s latency (a 256-step kernel chain), so it only wins past
+the native C++ backend. Small batches stay on the CPU: a device call has a
+~1s fixed floor (decompression/sqrt power scans + MSM dispatches through
+the remote tunnel) regardless of batch size ≤1024, so it only wins past
 `min_device_batch` items. Feature-gated in app wiring via
 charon_tpu.utils.featureset.TPU_BLS, mirroring how the reference gates
 backends behind tbls.SetImplementation + app/featureset
@@ -45,9 +46,9 @@ class TPUImpl(NativeImpl):
 
     name = "jax-tpu"
 
-    # Below this many items the fixed device-sweep latency loses to the
-    # native per-item path; tuned on v5e (native: ~3.3ms/aggregate,
-    # ~6.7ms/verify; device sweep: ~1s).
+    # Below this many items the fixed device-call floor loses to the native
+    # per-item path; tuned on v5e (native: ~3.4ms/aggregate, ~5.5ms/verify;
+    # device fused call: ~1.1s floor — see bench_scale.py sigagg100).
     min_device_batch = 192
 
     def threshold_aggregate_batch(self, batches: list[dict[int, Signature]]

@@ -1,0 +1,49 @@
+"""Golden-file JSON assertions (reference testutil/golden.go:71).
+
+`require_golden_json(name, obj)` compares ``obj`` against
+``tests/golden/<name>.json``; run pytest with ``UPDATE_GOLDEN=1`` in the
+environment to (re)write the files. Golden files pin the
+serialized shapes that external systems depend on — cluster
+definition/lock JSON, ENR encodings, deposit data — so accidental schema
+drift fails loudly in review instead of silently breaking operators.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from pathlib import Path
+
+GOLDEN_DIR = Path(__file__).resolve().parents[2] / "tests" / "golden"
+
+
+def _should_update() -> bool:
+    return os.environ.get("UPDATE_GOLDEN", "") not in ("", "0")
+
+
+def require_golden_json(name: str, obj, update: bool | None = None) -> None:
+    """Assert obj's canonical JSON equals tests/golden/<name>.json. Strict
+    encoding (no default=): a non-JSON value (e.g. raw bytes leaking from a
+    to_json regression) raises TypeError instead of being silently
+    stringified into the pinned shape."""
+    path = GOLDEN_DIR / f"{name}.json"
+    got = json.dumps(obj, indent=2, sort_keys=True) + "\n"
+    if update if update is not None else _should_update():
+        path.parent.mkdir(parents=True, exist_ok=True)
+        path.write_text(got)
+        return
+    if not path.exists():
+        raise AssertionError(
+            f"golden file {path} missing — run with UPDATE_GOLDEN=1 to create")
+    want = path.read_text()
+    if got != want:
+        # compact diff: first differing line
+        for i, (g, w) in enumerate(zip(got.splitlines(), want.splitlines())):
+            if g != w:
+                raise AssertionError(
+                    f"golden mismatch {name}.json line {i + 1}:\n"
+                    f"  got:  {g}\n  want: {w}\n"
+                    f"(UPDATE_GOLDEN=1 to accept)")
+        raise AssertionError(
+            f"golden mismatch {name}.json: length differs "
+            f"({len(got)} vs {len(want)} chars; UPDATE_GOLDEN=1 to accept)")

@@ -9,30 +9,26 @@ the reference, chosen for this stack:
   * JSON push payload (``{"streams": [{"stream": labels, "values":
     [[ts_ns, line], ...]}]}``) instead of snappy-compressed protobuf — the
     JSON endpoint is part of Loki's stable API and needs no generated code.
-  * A plain daemon thread + stdlib urllib, so the pusher works from both
-    sync and asyncio contexts and adds no dependencies.
+  * A plain daemon thread + stdlib urllib (utils/push.py), so the pusher
+    works from both sync and asyncio contexts and adds no dependencies.
 
-Failure semantics match the reference: the pusher retries with capped
-exponential backoff, drops the oldest lines past the buffer cap (shipping
-logs must never block or OOM the duty pipeline), and is wired as a log
-sink via ``install()``.
+Failure semantics match the reference: capped exponential backoff,
+oldest-line drop past the buffer cap (shipping logs must never block or
+OOM the duty pipeline), wired as a log sink via ``install()``.
 """
 
 from __future__ import annotations
 
 import json
-import threading
 import time
-import urllib.error
-import urllib.request
 
 from . import log as _log
+from .push import BackgroundPusher
 
-_MAX_BUFFER = 10_000
 _PUSH_PATH = "/loki/api/v1/push"
 
 
-class LokiPusher:
+class LokiPusher(BackgroundPusher):
     """Buffers log lines and pushes them to each configured Loki endpoint.
 
     ``endpoint`` may be a single base URL or a comma-separated list (the
@@ -41,90 +37,21 @@ class LokiPusher:
 
     def __init__(self, endpoint: str, labels: dict[str, str] | None = None,
                  interval: float = 2.0, timeout: float = 5.0):
+        super().__init__(interval, timeout)
         self.endpoints = [e.strip().rstrip("/") + _PUSH_PATH
                           for e in endpoint.split(",") if e.strip()]
         self.labels = dict(labels or {})
-        self.interval = interval
-        self.timeout = timeout
-        self._buf: list[tuple[int, str]] = []
-        self._lock = threading.Lock()
-        self._stop = threading.Event()
-        self._thread: threading.Thread | None = None
-        self._backoff = interval
-        self.pushed_total = 0
-        self.dropped_total = 0
-        self.errors_total = 0
-
-    # -- sink interface ----------------------------------------------------
 
     def add(self, line: str, ts: float | None = None) -> None:
         """Queue one formatted log line (thread-safe, never blocks)."""
         ts_ns = int((time.time() if ts is None else ts) * 1e9)
-        with self._lock:
-            self._buf.append((ts_ns, line))
-            if len(self._buf) > _MAX_BUFFER:
-                drop = len(self._buf) - _MAX_BUFFER
-                del self._buf[:drop]
-                self.dropped_total += drop
+        self._enqueue((ts_ns, line))
 
-    # -- lifecycle ---------------------------------------------------------
-
-    def start(self) -> None:
-        if self._thread is not None:
-            return
-        self._stop.clear()  # allow stop() -> start() restart
-        self._thread = threading.Thread(
-            target=self._run, name="loki-pusher", daemon=True)
-        self._thread.start()
-
-    def stop(self, flush: bool = True) -> None:
-        self._stop.set()
-        if self._thread is not None:
-            self._thread.join(timeout=self.timeout + 1)
-            self._thread = None
-        if flush:
-            self._push_once()
-
-    def _run(self) -> None:
-        while not self._stop.wait(self._backoff):
-            if self._push_once():
-                self._backoff = self.interval
-            else:
-                self._backoff = min(self._backoff * 2, 30.0)
-
-    # -- push --------------------------------------------------------------
-
-    def _push_once(self) -> bool:
-        with self._lock:
-            batch, self._buf = self._buf, []
-        if not batch:
-            return True
-        payload = json.dumps({"streams": [{
+    def _payload(self, batch: list) -> bytes:
+        return json.dumps({"streams": [{
             "stream": self.labels,
             "values": [[str(ts), line] for ts, line in batch],
         }]}).encode()
-        ok = bool(self.endpoints)
-        for endpoint in self.endpoints:
-            req = urllib.request.Request(
-                endpoint, data=payload,
-                headers={"Content-Type": "application/json"})
-            try:
-                with urllib.request.urlopen(req,
-                                            timeout=self.timeout) as resp:
-                    ok &= 200 <= resp.status < 300
-            except (urllib.error.URLError, OSError):
-                ok = False
-        if ok:
-            self.pushed_total += len(batch)
-            return True
-        self.errors_total += 1
-        with self._lock:  # requeue at the front, newest-capped
-            self._buf = batch + self._buf
-            if len(self._buf) > _MAX_BUFFER:
-                drop = len(self._buf) - _MAX_BUFFER
-                del self._buf[:drop]
-                self.dropped_total += drop
-        return False
 
 
 _installed: LokiPusher | None = None

@@ -5,22 +5,18 @@ Mirrors the reference's app/tracer exporters (trace.go:40-123 — stdout or
 Jaeger); this stack's tracer (utils/tracer.py) keeps spans in-process and
 exposes an exporter callback, which this module implements against the OTLP
 JSON protocol (``POST <endpoint>/v1/traces``, the stable OTLP/HTTP encoding
-every collector accepts). Same engineering choices as the Loki pusher
-(utils/loki.py): background daemon thread, stdlib urllib, capped buffer,
-exponential backoff, never blocks or breaks the duty pipeline.
+every collector accepts). Shares the background-pusher machinery with the
+Loki client (utils/push.py): daemon thread, capped buffer, exponential
+backoff — never blocks or breaks the duty pipeline.
 """
 
 from __future__ import annotations
 
 import json
-import threading
-import time
-import urllib.error
-import urllib.request
 
 from . import tracer as _tracer
+from .push import BackgroundPusher
 
-_MAX_BUFFER = 10_000
 _PUSH_PATH = "/v1/traces"
 
 
@@ -40,65 +36,22 @@ def _span_to_otlp(span: "_tracer.Span") -> dict:
     }
 
 
-class OTLPExporter:
+class OTLPExporter(BackgroundPusher):
     """Buffers finished spans and POSTs OTLP JSON batches in the
     background. Register with tracer.set_exporter(exporter.export)."""
 
     def __init__(self, endpoint: str, service: str = "charon-tpu",
                  labels: dict[str, str] | None = None,
                  interval: float = 5.0, timeout: float = 5.0):
-        self.endpoint = endpoint.rstrip("/") + _PUSH_PATH
+        super().__init__(interval, timeout)
+        self.endpoints = [endpoint.rstrip("/") + _PUSH_PATH]
         self.service = service
         self.labels = dict(labels or {})
-        self.interval = interval
-        self.timeout = timeout
-        self._buf: list[dict] = []
-        self._lock = threading.Lock()
-        self._stop = threading.Event()
-        self._thread: threading.Thread | None = None
-        self._backoff = interval
-        self.pushed_total = 0
-        self.dropped_total = 0
-        self.errors_total = 0
-
-    # -- tracer callback ---------------------------------------------------
 
     def export(self, span: "_tracer.Span") -> None:
-        with self._lock:
-            self._buf.append(_span_to_otlp(span))
-            if len(self._buf) > _MAX_BUFFER:
-                drop = len(self._buf) - _MAX_BUFFER
-                del self._buf[:drop]
-                self.dropped_total += drop
+        self._enqueue(_span_to_otlp(span))
 
-    # -- lifecycle ---------------------------------------------------------
-
-    def start(self) -> None:
-        if self._thread is not None:
-            return
-        self._stop.clear()
-        self._thread = threading.Thread(
-            target=self._run, name="otlp-exporter", daemon=True)
-        self._thread.start()
-
-    def stop(self, flush: bool = True) -> None:
-        self._stop.set()
-        if self._thread is not None:
-            self._thread.join(timeout=self.timeout + 1)
-            self._thread = None
-        if flush:
-            self._push_once()
-
-    def _run(self) -> None:
-        while not self._stop.wait(self._backoff):
-            if self._push_once():
-                self._backoff = self.interval
-            else:
-                self._backoff = min(self._backoff * 2, 30.0)
-
-    # -- push --------------------------------------------------------------
-
-    def _payload(self, spans: list[dict]) -> bytes:
+    def _payload(self, batch: list) -> bytes:
         attrs = [{"key": "service.name",
                   "value": {"stringValue": self.service}}]
         attrs += [{"key": k, "value": {"stringValue": v}}
@@ -106,33 +59,8 @@ class OTLPExporter:
         return json.dumps({"resourceSpans": [{
             "resource": {"attributes": attrs},
             "scopeSpans": [{"scope": {"name": "charon_tpu"},
-                            "spans": spans}],
+                            "spans": batch}],
         }]}).encode()
-
-    def _push_once(self) -> bool:
-        with self._lock:
-            batch, self._buf = self._buf, []
-        if not batch:
-            return True
-        req = urllib.request.Request(
-            self.endpoint, data=self._payload(batch),
-            headers={"Content-Type": "application/json"})
-        try:
-            with urllib.request.urlopen(req, timeout=self.timeout) as resp:
-                ok = 200 <= resp.status < 300
-        except (urllib.error.URLError, OSError):
-            ok = False
-        if ok:
-            self.pushed_total += len(batch)
-            return True
-        self.errors_total += 1
-        with self._lock:
-            self._buf = batch + self._buf
-            if len(self._buf) > _MAX_BUFFER:
-                drop = len(self._buf) - _MAX_BUFFER
-                del self._buf[:drop]
-                self.dropped_total += drop
-        return False
 
 
 _installed: OTLPExporter | None = None

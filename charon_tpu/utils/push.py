@@ -1,0 +1,107 @@
+"""Shared background HTTP-push machinery for observability shippers.
+
+Both the Loki log pusher (utils/loki.py) and the OTLP span exporter
+(utils/otlp.py) need the same shape: a thread-safe capped buffer, a daemon
+thread that drains it on an interval, capped exponential backoff on
+failure, requeue-with-cap so a collector outage never blocks or OOMs the
+duty pipeline, and delivery counters. This base owns all of that; the
+subclasses provide the payload encoding and the endpoint list.
+"""
+
+from __future__ import annotations
+
+import threading
+import urllib.error
+import urllib.request
+
+_MAX_BUFFER = 10_000
+
+
+class BackgroundPusher:
+    """Buffered background HTTP pusher (subclass: set `endpoints`, implement
+    `_payload(batch) -> bytes`, and enqueue items via `_enqueue`)."""
+
+    content_type = "application/json"
+    endpoints: list[str]
+
+    def __init__(self, interval: float = 2.0, timeout: float = 5.0):
+        self.interval = interval
+        self.timeout = timeout
+        self._buf: list = []
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self._backoff = interval
+        self.pushed_total = 0
+        self.dropped_total = 0
+        self.errors_total = 0
+
+    # -- producer side -----------------------------------------------------
+
+    def _enqueue(self, item) -> None:
+        """Thread-safe, never blocks; drops oldest past the cap."""
+        with self._lock:
+            self._buf.append(item)
+            self._cap_locked()
+
+    def _cap_locked(self) -> None:
+        if len(self._buf) > _MAX_BUFFER:
+            drop = len(self._buf) - _MAX_BUFFER
+            del self._buf[:drop]
+            self.dropped_total += drop
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._stop.clear()  # allow stop() -> start() restart
+        self._thread = threading.Thread(
+            target=self._run, name=type(self).__name__.lower(), daemon=True)
+        self._thread.start()
+
+    def stop(self, flush: bool = True) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=self.timeout + 1)
+            self._thread = None
+        if flush:
+            self._push_once()
+
+    def _run(self) -> None:
+        while not self._stop.wait(self._backoff):
+            if self._push_once():
+                self._backoff = self.interval
+            else:
+                self._backoff = min(self._backoff * 2, 30.0)
+
+    # -- push --------------------------------------------------------------
+
+    def _payload(self, batch: list) -> bytes:
+        raise NotImplementedError
+
+    def _push_once(self) -> bool:
+        with self._lock:
+            batch, self._buf = self._buf, []
+        if not batch:
+            return True
+        payload = self._payload(batch)
+        ok = bool(self.endpoints)
+        for endpoint in self.endpoints:
+            req = urllib.request.Request(
+                endpoint, data=payload,
+                headers={"Content-Type": self.content_type})
+            try:
+                with urllib.request.urlopen(req,
+                                            timeout=self.timeout) as resp:
+                    ok &= 200 <= resp.status < 300
+            except (urllib.error.URLError, OSError):
+                ok = False
+        if ok:
+            self.pushed_total += len(batch)
+            return True
+        self.errors_total += 1
+        with self._lock:  # requeue at the front, newest-capped
+            self._buf = batch + self._buf
+            self._cap_locked()
+        return False

@@ -95,8 +95,10 @@ class TestAppShell:
                 assert apps[0].inclusion.included[0][1] >= 1  # delay in slots
 
                 # infosync: versions/protocols agreed cluster-wide via the
-                # priority protocol at the epoch head
-                while asyncio.get_running_loop().time() < deadline:
+                # priority protocol at the epoch head (own deadline — the
+                # earlier waits may have consumed the shared one)
+                info_deadline = asyncio.get_running_loop().time() + 40
+                while asyncio.get_running_loop().time() < info_deadline:
                     if all(a.infosync.agreed_version() for a in apps):
                         break
                     await asyncio.sleep(0.1)

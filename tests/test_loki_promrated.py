@@ -79,17 +79,17 @@ class TestLokiPusher:
 
     def test_buffer_cap_drops_oldest(self):
         p = LokiPusher("http://127.0.0.1:1")  # nothing listening
-        from charon_tpu.utils import loki as loki_mod
+        from charon_tpu.utils import push as push_mod
 
-        old = loki_mod._MAX_BUFFER
-        loki_mod._MAX_BUFFER = 5
+        old = push_mod._MAX_BUFFER
+        push_mod._MAX_BUFFER = 5
         try:
             for i in range(8):
                 p.add(f"l{i}")
             assert p.dropped_total == 3
             assert [v for _, v in p._buf] == [f"l{i}" for i in range(3, 8)]
         finally:
-            loki_mod._MAX_BUFFER = old
+            push_mod._MAX_BUFFER = old
 
     def test_log_sink_wiring(self):
         got = []

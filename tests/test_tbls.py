@@ -128,8 +128,14 @@ def test_verify_batch(impl):
 
 
 def test_facade_delegates(impl):
+    prev = tbls.get_implementation()
     tbls.set_implementation(impl)
-    sk = tbls.generate_secret_key()
-    pk = tbls.secret_to_public_key(sk)
-    sig = tbls.sign(sk, b"x")
-    assert tbls.verify(pk, b"x", sig)
+    try:
+        sk = tbls.generate_secret_key()
+        pk = tbls.secret_to_public_key(sk)
+        sig = tbls.sign(sk, b"x")
+        assert tbls.verify(pk, b"x", sig)
+    finally:
+        # restore the process-default backend — leaking a slow (pure-Python)
+        # backend into later tests starved their pipeline deadlines
+        tbls.set_implementation(prev)
