@@ -138,6 +138,23 @@ def build_parser() -> argparse.ArgumentParser:
     ce = create_sub.add_parser("enr", help="generate identity key + ENR")
     ce.add_argument("--data-dir", dest="data_dir", default=None,
                        help="node data directory (default .charon)")
+    cd = create_sub.add_parser(
+        "dkg", help="create a cluster-definition for a DKG ceremony")
+    cd.add_argument("--name", default="charon-tpu-cluster")
+    cd.add_argument("--operator-enrs", dest="operator_enrs", required=True,
+                    help="comma-separated operator ENRs")
+    cd.add_argument("--num-validators", dest="num_validators", type=int,
+                    default=1)
+    cd.add_argument("--threshold", type=int, default=None,
+                    help="default ceil(2n/3)")
+    cd.add_argument("--fork-version", dest="fork_version",
+                    default="0x00000000")
+    cd.add_argument("--dkg-algorithm", dest="dkg_algorithm", default="frost",
+                    choices=["frost", "keycast"])
+    cd.add_argument("--withdrawal-address", dest="withdrawal_address",
+                    default="0x" + "00" * 20)
+    cd.add_argument("--output-path", dest="output_path",
+                    default="cluster-definition.json")
 
     enr_p = sub.add_parser("enr", help="print this node's ENR")
     enr_p.add_argument("--data-dir", dest="data_dir", default=None,
@@ -152,6 +169,11 @@ def build_parser() -> argparse.ArgumentParser:
     comb_p.add_argument("--node-dirs", dest="node_dirs", required=True,
                         help="comma-separated node data/keystore directories")
     comb_p.add_argument("--output-dir", dest="output_dir", default="recovered-keys")
+
+    view_p = sub.add_parser("view-cluster-manifest",
+                            help="print the materialised cluster state")
+    view_p.add_argument("--data-dir", dest="data_dir", default=None,
+                        help="node data directory (default .charon)")
 
     sub.add_parser("version", help="print version")
     return p
@@ -182,6 +204,8 @@ def _dispatch(args: argparse.Namespace) -> int:
         return _cmd_relay(args)
     if args.command == "combine":
         return _cmd_combine(args)
+    if args.command == "view-cluster-manifest":
+        return _cmd_view_manifest(args)
     raise AssertionError(f"unhandled command {args.command}")
 
 
@@ -280,7 +304,74 @@ def _cmd_create(args: argparse.Namespace) -> int:
         key_path.chmod(0o600)
         print(enr_mod.new(key).encode())
         return 0
+    if args.create_command == "dkg":
+        # a cluster-definition.json for a later `charon dkg` ceremony
+        # (reference cmd/createdkg.go): operators are identified by their
+        # ENRs; no key material is generated here.
+        import time as time_mod
+
+        from ..cluster.definition import Definition, Operator, save
+        from ..eth2 import enr as enr_mod
+
+        enrs = [e.strip() for e in (args.operator_enrs or "").split(",")
+                if e.strip()]
+        if len(enrs) < 3:
+            print("need at least 3 --operator-enrs", file=sys.stderr)
+            return 1
+        for e in enrs:
+            try:
+                if not enr_mod.parse(e).verify():
+                    raise enr_mod.ENRError("bad ENR signature")
+            except (enr_mod.ENRError, ValueError) as err:
+                print(f"invalid operator ENR {e[:24]}…: {err}",
+                      file=sys.stderr)
+                return 1
+        threshold = args.threshold
+        if threshold is None:
+            threshold = (len(enrs) * 2 + 2) // 3
+        elif not 1 <= threshold <= len(enrs):
+            print(f"--threshold must be in [1, {len(enrs)}]", file=sys.stderr)
+            return 1
+        d = Definition(
+            name=args.name, num_validators=args.num_validators,
+            threshold=threshold,
+            operators=[Operator(enr=e) for e in enrs],
+            fork_version=bytes.fromhex(args.fork_version.removeprefix("0x")),
+            dkg_algorithm=args.dkg_algorithm,
+            timestamp=time_mod.strftime("%Y-%m-%dT%H:%M:%SZ",
+                                        time_mod.gmtime()),
+            withdrawal_address=args.withdrawal_address,
+        )
+        save(d, args.output_path)
+        print(f"wrote {args.output_path}: {len(enrs)} operators, "
+              f"{args.num_validators} validators, threshold {threshold}, "
+              f"config hash 0x{d.config_hash().hex()}")
+        return 0
     raise AssertionError
+
+
+def _cmd_view_manifest(args: argparse.Namespace) -> int:
+    """Print the materialised cluster state from a node's manifest/lock
+    (reference cmd view-cluster-manifest)."""
+    import json as json_mod
+
+    from ..cluster.manifest import load_cluster
+
+    cluster = load_cluster(resolve(args, "data_dir", ".charon"))
+    d = cluster.lock.definition
+    out = {
+        "name": d.name,
+        "lock_hash": "0x" + cluster.lock.lock_hash().hex(),
+        "threshold": d.threshold,
+        "operators": [op.enr for op in d.operators],
+        "validators": [
+            {"public_key": "0x" + v.public_key.hex(),
+             "public_shares": ["0x" + s.hex() for s in v.public_shares]}
+            for v in cluster.validators
+        ],
+    }
+    print(json_mod.dumps(out, indent=2))
+    return 0
 
 
 def _cmd_enr(args: argparse.Namespace) -> int:

@@ -228,3 +228,53 @@ class TestCLI:
         args = build_parser().parse_args(
             ["run", "--data-dir", str(tmp_path), "--monitoring-address", "3.3.3.3:9"])
         assert resolve(args, "monitoring_address") == "3.3.3.3:9"
+
+    def test_create_dkg_and_view_manifest(self, tmp_path, capsys):
+        # create dkg: a definition for a later ceremony from operator ENRs
+        enrs = []
+        for i in range(3):
+            assert cli_main(["create", "enr",
+                             "--data-dir", str(tmp_path / f"id{i}")]) == 0
+            enrs.append(capsys.readouterr().out.strip())
+        out_path = tmp_path / "cluster-definition.json"
+        assert cli_main(["create", "dkg", "--operator-enrs", ",".join(enrs),
+                         "--num-validators", "2",
+                         "--output-path", str(out_path)]) == 0
+        assert "config hash" in capsys.readouterr().out
+        import json as json_mod
+
+        from charon_tpu.cluster.definition import Definition
+
+        d = Definition.from_json(json_mod.loads(out_path.read_text()))
+        assert len(d.operators) == 3 and d.num_validators == 2
+        assert d.threshold == 2  # ceil(2n/3) default
+
+        # view-cluster-manifest over a created cluster's node dir
+        cluster_dir = tmp_path / "cluster"
+        assert cli_main(["create", "cluster", "--nodes", "3",
+                         "--threshold", "2", "--num-validators", "1",
+                         "--cluster-dir", str(cluster_dir)]) == 0
+        capsys.readouterr()
+        assert cli_main(["view-cluster-manifest",
+                         "--data-dir", str(cluster_dir / "node0")]) == 0
+        view = json_mod.loads(capsys.readouterr().out)
+        assert view["threshold"] == 2
+        assert len(view["validators"]) == 1
+        assert view["lock_hash"].startswith("0x")
+
+    def test_create_dkg_rejects_bad_inputs(self, tmp_path, capsys):
+        enrs = []
+        for i in range(3):
+            assert cli_main(["create", "enr",
+                             "--data-dir", str(tmp_path / f"v{i}")]) == 0
+            enrs.append(capsys.readouterr().out.strip())
+        out_path = str(tmp_path / "d.json")
+        # garbage ENR rejected
+        assert cli_main(["create", "dkg", "--operator-enrs", "a,b,c",
+                         "--output-path", out_path]) == 1
+        # threshold out of range rejected
+        assert cli_main(["create", "dkg", "--operator-enrs", ",".join(enrs),
+                         "--threshold", "7", "--output-path", out_path]) == 1
+        assert cli_main(["create", "dkg", "--operator-enrs", ",".join(enrs),
+                         "--threshold", "0", "--output-path", out_path]) == 1
+        capsys.readouterr()
