@@ -73,12 +73,17 @@ def main() -> None:
     # slot budget must fit.
     datas = [msg] * N_VALIDATORS
     tpu.threshold_aggregate_verify_batch(batches, pubkeys, datas)  # warm
-    t0 = time.time()
-    aggs, ok = tpu.threshold_aggregate_verify_batch(batches, pubkeys, datas)
-    t_total = time.time() - t0
-    print(f"# device aggregate+verify (fused): {t_total:.2f}s for "
-          f"{len(batches)}, ok={ok}", file=sys.stderr)
-    assert ok, "device verification failed on valid aggregates"
+    times = []
+    for _ in range(3):  # median of 3: the remote-tunnel jitter is ±20%
+        t0 = time.time()
+        aggs, ok = tpu.threshold_aggregate_verify_batch(
+            batches, pubkeys, datas)
+        times.append(time.time() - t0)
+        assert ok, "device verification failed on valid aggregates"
+    t_total = sorted(times)[1]
+    print(f"# device aggregate+verify (fused): runs "
+          f"{[round(t, 2) for t in times]}s -> median {t_total:.2f}s "
+          f"(p50 sigagg slot latency) for {len(batches)}", file=sys.stderr)
 
     # Bit-identity spot check vs the native oracle.
     for i in range(CPU_SAMPLE):
