@@ -13,12 +13,22 @@ TPU path: fused Pallas double-and-add sweep for the Lagrange aggregation
 (ops/plane_agg.threshold_aggregate_batch — bit-identical outputs) + RLC
 batch verification (device G1/G2 MSMs + one native multi-pairing).
 
+Resilience (round-2 postmortem: the driver's official run died on a
+transient TPU `FAILED_PRECONDITION` inside the warm-up call, leaving the
+round with no recorded number): the default invocation is a WRAPPER that
+re-execs the measurement in a fresh subprocess — a new process is the only
+reliable way to tear down and re-create a wedged JAX runtime client — and
+retries on any failure. If the device never comes back it falls back to an
+honestly-labelled CPU-only measurement so the run always exits 0 with a
+parseable JSON line.
+
 Run on real TPU hardware (do NOT set JAX_PLATFORMS=cpu here).
 """
 
 from __future__ import annotations
 
 import json
+import subprocess
 import sys
 import time
 
@@ -27,11 +37,17 @@ THRESHOLD = 4
 NUM_SHARES = 6
 CPU_SAMPLE = 50  # validators measured on the CPU baseline
 
+DEVICE_ATTEMPTS = 3       # fresh subprocess each; first may pay a cold compile
+CPU_FALLBACK_ATTEMPTS = 2
+ATTEMPT_TIMEOUT = 2400    # s; cold-cache compile through the tunnel is 10-25 min
+RETRY_PAUSE = 15          # s; let a flaky tunnel/backend settle between attempts
 
-def main() -> None:
+REQUIRED_KEYS = {"metric", "value", "unit", "vs_baseline"}
+
+
+def _measure(cpu_only: bool) -> None:
     from charon_tpu.tbls.native_impl import NativeImpl
     from charon_tpu.tbls.tpu_impl import TPUImpl
-    from charon_tpu.tbls.types import PublicKey, Signature
 
     native = NativeImpl()
     tpu = TPUImpl()
@@ -62,6 +78,20 @@ def main() -> None:
     print(f"# native CPU: agg {cpu_agg_per*1e3:.2f} ms/op, "
           f"verify {cpu_verify_per*1e3:.2f} ms/op -> "
           f"{cpu_throughput:.1f} validators/s", file=sys.stderr)
+
+    if cpu_only:
+        # Device unavailable after retries: record the native number under an
+        # honest label rather than crashing the round (vs_baseline is 1.0 by
+        # construction — this IS the baseline path).
+        print(json.dumps({
+            "metric": "partial-sig verify+aggregate throughput "
+                      "(1k validators, 4-of-6) [CPU FALLBACK: device "
+                      "unavailable after retries]",
+            "value": round(cpu_throughput, 2),
+            "unit": "validators/sec",
+            "vs_baseline": 1.0,
+        }))
+        return
 
     # --- device: fused aggregate + RLC verify ------------------------------
     # The production sigagg hot path (core/sigagg.py) is the FUSED
@@ -96,6 +126,67 @@ def main() -> None:
         "value": round(device_throughput, 2),
         "unit": "validators/sec",
         "vs_baseline": round(device_throughput / cpu_throughput, 2),
+    }))
+
+
+def _attempt(extra_args: list[str]) -> str | None:
+    """Run one measurement subprocess; return its JSON line or None."""
+    cmd = [sys.executable, __file__, "--inner", *extra_args]
+    try:
+        proc = subprocess.run(cmd, stdout=subprocess.PIPE, stderr=None,
+                              timeout=ATTEMPT_TIMEOUT, text=True)
+    except subprocess.TimeoutExpired:
+        print(f"# bench attempt timed out after {ATTEMPT_TIMEOUT}s",
+              file=sys.stderr)
+        return None
+    if proc.returncode != 0:
+        print(f"# bench attempt exited rc={proc.returncode}", file=sys.stderr)
+        return None
+    for line in reversed((proc.stdout or "").strip().splitlines()):
+        line = line.strip()
+        if not line.startswith("{"):
+            continue
+        try:
+            obj = json.loads(line)
+        except json.JSONDecodeError:
+            continue
+        if REQUIRED_KEYS <= set(obj):
+            return json.dumps(obj)
+    print("# bench attempt produced no valid JSON line", file=sys.stderr)
+    return None
+
+
+def main() -> None:
+    if "--inner" in sys.argv:
+        _measure(cpu_only="--cpu-only" in sys.argv)
+        return
+
+    for i in range(DEVICE_ATTEMPTS):
+        if i:
+            time.sleep(RETRY_PAUSE)
+        print(f"# bench device attempt {i + 1}/{DEVICE_ATTEMPTS}",
+              file=sys.stderr)
+        line = _attempt([])
+        if line is not None:
+            print(line)
+            return
+    for i in range(CPU_FALLBACK_ATTEMPTS):
+        if i:
+            time.sleep(RETRY_PAUSE)
+        print(f"# bench CPU-fallback attempt {i + 1}/{CPU_FALLBACK_ATTEMPTS}",
+              file=sys.stderr)
+        line = _attempt(["--cpu-only"])
+        if line is not None:
+            print(line)
+            return
+    # Absolute last resort: still exit 0 with a parseable, honest record.
+    print(json.dumps({
+        "metric": "partial-sig verify+aggregate throughput "
+                  "(1k validators, 4-of-6) [BENCH FAILED: all attempts "
+                  "crashed]",
+        "value": 0.0,
+        "unit": "validators/sec",
+        "vs_baseline": 0.0,
     }))
 
 

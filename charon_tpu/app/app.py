@@ -64,6 +64,12 @@ class Config:
     monitoring_host: str = "127.0.0.1"
     monitoring_port: int = 0
     beacon_urls: list[str] = field(default_factory=list)
+    # feature rollout (reference --feature-set flags, app/featureset/config.go);
+    # None leaves the process-global featureset untouched (test harnesses may
+    # have pre-seeded overrides via featureset.enable_for_t)
+    feature_set: str | None = None
+    feature_set_enable: list[str] = field(default_factory=list)
+    feature_set_disable: list[str] = field(default_factory=list)
     synthetic_proposals: bool = False
     p2p_fuzz: float = 0.0
     consensus_type: str = "qbft"
@@ -156,8 +162,44 @@ class App:
             otlp_mod.uninstall()
 
 
+def _select_tbls_backend(config: Config) -> None:
+    """Apply featureset config and pick the tbls backend (reference
+    app/app.go:132 featureset.Init + tbls/tbls.go:72 SetImplementation).
+
+    The TPU_BLS feature routes batched tbls calls (sigagg aggregate+verify,
+    parsigex bulk verify) onto the JAX device via TPUImpl; per-call fallback
+    inside TPUImpl keeps small batches and device-less hosts on the native
+    C++ backend, so enabling the flag is always safe."""
+    from ..utils import featureset
+
+    if (config.feature_set is not None or config.feature_set_enable
+            or config.feature_set_disable):
+        featureset.init(config.feature_set or "stable",
+                        enabled=config.feature_set_enable,
+                        disabled=config.feature_set_disable)
+    if not featureset.enabled(featureset.TPU_BLS):
+        return
+    from .. import tbls as tbls_mod
+    from ..tbls.tpu_impl import TPUImpl, _on_device
+
+    impl = TPUImpl()
+    tbls_mod.set_implementation(impl)
+    err = None
+    try:
+        on_dev = _on_device()
+    except Exception as exc:  # jax missing/broken: TPUImpl falls back per call
+        on_dev, err = False, exc
+    if on_dev:
+        _log.info("tbls backend: jax-tpu (feature tpu_bls enabled)",
+                  min_device_batch=impl.min_device_batch)
+    else:
+        _log.info("tbls backend: jax-tpu enabled but no accelerator present; "
+                  "batched calls stay on the native CPU path", err=err)
+
+
 async def assemble(config: Config) -> App:
     """Build (but do not start) a node from config + disk state."""
+    _select_tbls_backend(config)
     test = config.test
     privkey_lock = None
     if test.identity is not None:

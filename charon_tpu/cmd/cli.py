@@ -110,6 +110,17 @@ def build_parser() -> argparse.ArgumentParser:
                        help="use the in-process beacon mock (dev/simnet)")
     run_p.add_argument("--simnet-validator-mock", dest="simnet_validator_mock",
                        action="store_true", default=None)
+    run_p.add_argument("--feature-set", dest="feature_set", default=None,
+                       choices=["alpha", "beta", "stable"],
+                       help="minimum feature maturity to enable "
+                            "(reference --feature-set)")
+    run_p.add_argument("--feature-set-enable", dest="feature_set_enable",
+                       default=None,
+                       help="comma-separated features to force-enable "
+                            "(e.g. tpu_bls for the JAX/TPU tbls backend)")
+    run_p.add_argument("--feature-set-disable", dest="feature_set_disable",
+                       default=None,
+                       help="comma-separated features to force-disable")
     run_p.add_argument("--loki-addresses", dest="loki_addresses", default=None,
                        help="comma-separated Loki push endpoints for log "
                             "shipping (reference app/log/loki)")
@@ -237,6 +248,11 @@ def _cmd_run(args: argparse.Namespace) -> int:
         _, lock, _ = cluster_mod.load_node(resolve(args, "data_dir", ".charon"))
         test.beacon = BeaconMock([v.public_key for v in lock.validators])
     bn = resolve(args, "beacon_node_endpoints", "")
+
+    def _csv(name):
+        return [f.strip() for f in (resolve(args, name, "") or "").split(",")
+                if f.strip()]
+
     config = Config(
         data_dir=resolve(args, "data_dir", ".charon"),
         p2p_host=p2p_host, p2p_port=p2p_port,
@@ -244,6 +260,9 @@ def _cmd_run(args: argparse.Namespace) -> int:
         vapi_host=vapi_host, vapi_port=vapi_port,
         monitoring_host=mon_host, monitoring_port=mon_port,
         beacon_urls=[u for u in (bn or "").split(",") if u],
+        feature_set=resolve(args, "feature_set"),
+        feature_set_enable=_csv("feature_set_enable"),
+        feature_set_disable=_csv("feature_set_disable"),
         p2p_fuzz=float(resolve(args, "p2p_fuzz", 0.0) or 0.0),
         loki_endpoint=resolve(args, "loki_addresses", "") or "",
         otlp_endpoint=resolve(args, "otlp_address", "") or "",

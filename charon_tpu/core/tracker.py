@@ -36,16 +36,80 @@ _participation_counter = metrics.counter(
     ("peer_share_idx",))
 _unexpected_counter = metrics.counter(
     "core_tracker_unexpected_events_total", "Events for unknown duties")
+_reason_counter = metrics.counter(
+    "core_tracker_failed_duty_reasons_total", "Failed duties by root cause",
+    ("reason",))
+_inconsistent_counter = metrics.counter(
+    "core_tracker_inconsistent_parsigs_total",
+    "Partials diverging from the cluster-majority message root",
+    ("peer_share_idx",))
 _inclusion_delay_gauge = metrics.gauge(
     "core_tracker_inclusion_delay", "Blocks until attestation inclusion")
 _inclusion_missed_counter = metrics.counter(
     "core_tracker_inclusion_missed_total", "Submitted duties never included")
 
 
+@dataclass(frozen=True)
+class Reason:
+    """Structured root cause of a failed duty (reference
+    core/tracker/reason.go): a stable machine-readable code plus the
+    operator-facing explanation of why a duty stalled at its failed step."""
+
+    code: str
+    description: str
+
+
+REASON_UNKNOWN = Reason(
+    "unknown", "unexpected failure")
+REASON_NOT_SCHEDULED = Reason(
+    "not_scheduled",
+    "duty never scheduled (validator inactive or BN duty resolution failed)")
+REASON_FETCH_ERROR = Reason(
+    "fetch_error", "failed fetching unsigned duty data from the beacon node")
+REASON_NO_CONSENSUS = Reason(
+    "no_consensus", "cluster did not reach consensus on the duty data")
+REASON_DUTYDB_ERROR = Reason(
+    "dutydb_error", "failed storing/serving the agreed unsigned data")
+REASON_VC_NOT_SUBMITTED = Reason(
+    "vc_not_submitted",
+    "own validator client did not submit a partial signature")
+REASON_PARSIGS_NOT_EXCHANGED = Reason(
+    "parsigs_not_exchanged",
+    "partial signatures were not exchanged with peers")
+REASON_INSUFFICIENT_PARSIGS = Reason(
+    "insufficient_parsigs",
+    "fewer than threshold matching partial signatures were received")
+REASON_INCONSISTENT_PARSIGS = Reason(
+    "inconsistent_parsigs",
+    "peers signed divergent data for the same duty "
+    "(equivocation or misconfigured validator client)")
+REASON_AGG_FAILED = Reason(
+    "aggregation_failed",
+    "threshold aggregation or aggregate-signature verification failed")
+REASON_BCAST_FAILED = Reason(
+    "bcast_failed", "failed broadcasting the aggregate to the beacon node")
+
+# failed step -> default root cause when no more specific signal exists
+_STEP_REASONS = {
+    "scheduler": REASON_NOT_SCHEDULED,
+    "fetcher": REASON_FETCH_ERROR,
+    "consensus": REASON_NO_CONSENSUS,
+    "dutydb": REASON_DUTYDB_ERROR,
+    "parsigdb_internal": REASON_VC_NOT_SUBMITTED,
+    "parsigex": REASON_PARSIGS_NOT_EXCHANGED,
+    "parsigdb_external": REASON_INSUFFICIENT_PARSIGS,
+    "sigagg": REASON_AGG_FAILED,
+    "aggsigdb": REASON_AGG_FAILED,
+    "bcast": REASON_BCAST_FAILED,
+}
+
+
 @dataclass
 class _DutyEvents:
     events: list[tuple[str, object, BaseException | None]] = field(default_factory=list)
     share_indices: set[int] = field(default_factory=set)
+    # pubkey -> {share_idx: partial message root} for divergence analysis
+    parsig_roots: dict = field(default_factory=dict)
 
 
 @dataclass
@@ -55,6 +119,9 @@ class FailureReport:
     failed_step: str | None = None
     reason: str | None = None
     participation: set[int] = field(default_factory=set)
+    reason_code: str | None = None
+    # share indices whose partials diverged from the cluster-majority root
+    inconsistent: set[int] = field(default_factory=set)
 
 
 class Tracker:
@@ -84,10 +151,18 @@ class Tracker:
         rec = self._duties[duty]
         rec.events.append((component, data, err))
         if component in ("parsigdb_internal", "parsigdb_external") and isinstance(data, dict):
-            for psd in data.values():
+            for pubkey, psd in data.items():
                 idx = getattr(psd, "share_idx", None)
-                if idx is not None:
-                    rec.share_indices.add(idx)
+                if idx is None:
+                    continue
+                rec.share_indices.add(idx)
+                # record the partial's message root for divergence analysis
+                # (reference extractParSigs tracker.go:422)
+                try:
+                    root = psd.message_root()
+                except Exception:  # noqa: BLE001 — unrooted test doubles
+                    continue
+                rec.parsig_roots.setdefault(pubkey, {})[idx] = root
 
     async def run(self) -> None:
         """Analyse each duty as its deadline expires (reference tracker.go:128
@@ -111,7 +186,6 @@ class Tracker:
         tracker.go:223): find the furthest step reached; the duty succeeded
         iff a bcast event without error exists."""
         furthest = -1
-        furthest_err: BaseException | None = None
         errs_by_step: dict[str, BaseException] = {}
         for component, _data, err in rec.events:
             idx = _STEP_INDEX[component]
@@ -121,9 +195,11 @@ class Tracker:
                 furthest = idx
         success = any(c == "bcast" and e is None for c, _d, e in rec.events)
         self._report_participation(duty, rec, success)
+        inconsistent, any_divergence = self._analyse_inconsistent(duty, rec)
         if success:
             _success_counter.inc(str(duty.type))
-            return FailureReport(duty, True, participation=set(rec.share_indices))
+            return FailureReport(duty, True, participation=set(rec.share_indices),
+                                 inconsistent=inconsistent)
         # root cause: the first step AFTER the furthest successful one; prefer
         # a recorded error at or after that step (reference reason.go mapping)
         failed_idx = min(furthest + 1, len(STEPS) - 1)
@@ -134,12 +210,52 @@ class Tracker:
                 failed_step = step
                 reason = str(errs_by_step[step])
                 break
+        cause = _STEP_REASONS.get(failed_step, REASON_UNKNOWN)
+        if any_divergence and failed_step in ("parsigdb_external", "sigagg"):
+            # divergent partials are the likeliest reason a threshold of
+            # MATCHING roots never formed (the DVT equivocation signal)
+            cause = REASON_INCONSISTENT_PARSIGS
         if reason is None:
-            reason = f"no events from step {failed_step!r} before deadline"
+            reason = cause.description
         _failed_counter.inc(failed_step)
-        _log.warn("duty failed", duty=str(duty), step=failed_step, reason=reason)
+        _reason_counter.inc(cause.code)
+        _log.warn("duty failed", duty=str(duty), step=failed_step,
+                  reason=reason, reason_code=cause.code)
         return FailureReport(duty, False, failed_step, reason,
-                             set(rec.share_indices))
+                             set(rec.share_indices), reason_code=cause.code,
+                             inconsistent=inconsistent)
+
+    def _analyse_inconsistent(self, duty: Duty,
+                              rec: _DutyEvents) -> tuple[set[int], bool]:
+        """Flag peers whose partials diverge from the per-validator majority
+        message root (reference extractParSigs tracker.go:422) — the DVT
+        signal for an equivocating or misconfigured peer. Individual peers
+        are only blamed when a STRICT majority root exists; on an even split
+        the divergence is reported without naming peers (either side is
+        equally plausible)."""
+        divergent: set[int] = set()
+        any_divergence = False
+        for pubkey, roots_by_idx in rec.parsig_roots.items():
+            if len(set(roots_by_idx.values())) <= 1:
+                continue
+            any_divergence = True
+            counts: dict[bytes, int] = defaultdict(int)
+            for root in roots_by_idx.values():
+                counts[root] += 1
+            top = max(counts.values())
+            if top * 2 <= len(roots_by_idx):
+                _log.warn("inconsistent partial signatures (no majority root)",
+                          duty=str(duty), pubkey=str(pubkey)[:18],
+                          roots=len(counts))
+                continue
+            majority = next(r for r, c in counts.items() if c == top)
+            bad = {idx for idx, root in roots_by_idx.items() if root != majority}
+            divergent |= bad
+            _log.warn("inconsistent partial signatures", duty=str(duty),
+                      pubkey=str(pubkey)[:18], divergent_peers=sorted(bad))
+        for idx in divergent:
+            _inconsistent_counter.inc(str(idx))
+        return divergent, any_divergence
 
     def _report_participation(self, duty: Duty, rec: _DutyEvents, success: bool) -> None:
         """Per-peer participation (reference analyseParticipation

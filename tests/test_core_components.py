@@ -183,3 +183,43 @@ def test_sigagg_uses_fused_aggregate_verify(monkeypatch):
         assert len(out) == 1
 
     asyncio.run(run())
+
+
+def test_tracker_flags_inconsistent_parsigs():
+    """A peer whose partial signs DIFFERENT data than the cluster majority
+    is named in the failure report with the inconsistent_parsigs root cause
+    (reference extractParSigs tracker.go:422 + reason.go taxonomy)."""
+
+    async def run():
+        from charon_tpu.core import tracker as tracker_mod
+
+        chain = spec.ChainSpec(genesis_time=0)
+        _, nodes = new_cluster_for_t(1, 3, 4)
+        keys = nodes[0]
+        root = keys.root_pubkeys[0]
+
+        class StubDeadliner:
+            def add(self, duty):
+                return True
+
+        tr = tracker_mod.Tracker(StubDeadliner(), num_shares=4)
+        duty = types.Duty(5, types.DutyType.ATTESTER)
+        # peers 1,2 sign the majority data; peer 3 equivocates (other slot)
+        await tr.report_event(
+            "parsigdb_internal", duty,
+            {root: _psd(chain, nodes[0].my_share_secrets[root], 1)}, None)
+        await tr.report_event(
+            "parsigdb_external", duty,
+            {root: _psd(chain, nodes[1].my_share_secrets[root], 2)}, None)
+        divergent = _psd(chain, nodes[2].my_share_secrets[root], 3,
+                         _att_data(slot=6))
+        await tr.report_event("parsigdb_external", duty, {root: divergent},
+                              None)
+
+        report = tr._analyse(duty, tr._duties.pop(duty))
+        assert not report.success
+        assert report.inconsistent == {3}, report
+        assert report.reason_code == "inconsistent_parsigs", report
+        assert report.participation == {1, 2, 3}
+
+    asyncio.run(run())
