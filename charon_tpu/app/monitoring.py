@@ -86,15 +86,16 @@ class MonitoringAPI:
         return web.Response(text=READY_OK)
 
     async def _qbft(self, request: web.Request) -> web.Response:
+        """Full sniffed instances, gzipped (reference app/qbftdebug.go:22).
+        Each entry round-trips through consensus.SniffedInstance.from_json
+        for offline replay via consensus.replay_sniffed."""
+        import gzip
+
         if self._sniffer is None:
-            return web.json_response([])
-        instances = getattr(self._sniffer, "instances", [])
-        out = []
-        for inst in instances:
-            out.append({
-                "duty": str(getattr(inst, "duty", "")),
-                "nodes": getattr(inst, "nodes", 0),
-                "peer_idx": getattr(inst, "peer_idx", -1),
-                "msgs": list(getattr(inst, "msgs", [])),
-            })
-        return web.json_response(out, dumps=lambda o: json.dumps(o, default=str))
+            body = b"[]"
+        else:
+            body = json.dumps(self._sniffer.to_json(),
+                              default=str).encode()
+        return web.Response(body=gzip.compress(body),
+                            content_type="application/json",
+                            headers={"Content-Encoding": "gzip"})

@@ -255,13 +255,57 @@ def _check_sig(m: qbft.Msg, sig: bytes, pubkeys: dict[int, bytes]) -> None:
 # ---------------------------------------------------------------------------
 
 
+MAX_SNIFFED_MSGS = 512  # per-instance recording bound
+
+
 @dataclass
 class SniffedInstance:
+    """One recorded consensus instance: the FULL inbound/outbound wire
+    message stream plus rule firings — enough to re-run the algorithm
+    offline (reference component.go:449 sniffer + sniffed_internal_test.go
+    replay tests)."""
+
     duty: Duty
     nodes: int
     peer_idx: int
     started_at: float
     msgs: list[dict] = field(default_factory=list)
+    proposal_hash: str = ""  # this node's proposed value hash (hex)
+    decided_hash: str = ""   # the decided value hash (hex)
+    dropped: int = 0         # messages beyond the recording bound
+    # value payloads deduplicated across the message stream (hash hex ->
+    # encoded value) — every wire referencing a hash would otherwise carry
+    # its own full copy of the payload
+    values: dict = field(default_factory=dict)
+
+    def add_msg(self, event: dict) -> None:
+        if len(self.msgs) >= MAX_SNIFFED_MSGS:
+            self.dropped += 1
+            return
+        wire = event.get("wire")
+        if wire is not None and "values" in wire:
+            wire = dict(wire)
+            self.values.update(wire.pop("values") or {})
+            event = dict(event, wire=wire)
+        self.msgs.append(event)
+
+    def to_json(self) -> dict:
+        return {
+            "duty": {"slot": self.duty.slot, "type": int(self.duty.type)},
+            "nodes": self.nodes, "peer_idx": self.peer_idx,
+            "started_at": self.started_at, "proposal_hash": self.proposal_hash,
+            "decided_hash": self.decided_hash, "dropped": self.dropped,
+            "values": self.values, "msgs": self.msgs,
+        }
+
+    @staticmethod
+    def from_json(obj: dict) -> "SniffedInstance":
+        duty = Duty(int(obj["duty"]["slot"]), DutyType(int(obj["duty"]["type"])))
+        return SniffedInstance(
+            duty, int(obj["nodes"]), int(obj["peer_idx"]),
+            float(obj.get("started_at", 0.0)), list(obj.get("msgs", [])),
+            obj.get("proposal_hash", ""), obj.get("decided_hash", ""),
+            int(obj.get("dropped", 0)), dict(obj.get("values", {})))
 
 
 class Sniffer:
@@ -279,10 +323,77 @@ class Sniffer:
         return inst
 
     def to_json(self) -> list[dict]:
-        return [{
-            "duty": str(i.duty), "nodes": i.nodes, "peer_idx": i.peer_idx,
-            "started_at": i.started_at, "msgs": i.msgs,
-        } for i in self.instances]
+        return [i.to_json() for i in self.instances]
+
+
+def decode_wire_unverified(obj: dict) -> tuple[qbft.Msg, dict[bytes, dict]]:
+    """Decode a recorded wire message WITHOUT signature verification — for
+    offline replay of sniffed instances, where the identity keys of the
+    original cluster need not be available. Value payloads are still checked
+    against their hashes."""
+    just_msgs = [_decode_qbft_msg(j)[0] for j in obj.get("justification", ())]
+    m, _sig = _decode_qbft_msg(obj.get("msg") or {}, tuple(just_msgs))
+    values = {bytes.fromhex(h): v for h, v in (obj.get("values") or {}).items()}
+    for h, v in values.items():
+        if hash_value(v) != h:
+            raise errors.new("value hash mismatch in sniffed wire")
+    return m, values
+
+
+async def replay_sniffed(sniffed: SniffedInstance,
+                         timeout: float = 5.0) -> bytes | None:
+    """Re-run the QBFT algorithm over a sniffed instance's recorded inbound
+    wire stream (+ this node's recorded proposal) and return the decided
+    value hash, or None if no decision is reached. A disputed production
+    instance downloaded from /debug/qbft replays to the same decision
+    (reference core/consensus/sniffed_internal_test.go). Only faithful when
+    sniffed.dropped == 0 — a non-zero count means the record is missing
+    messages (recording bound or receive-buffer overflow)."""
+    loop = asyncio.get_running_loop()
+    recv: asyncio.Queue = asyncio.Queue()
+    for ev in sniffed.msgs:
+        if ev.get("event") != "recv":
+            continue
+        m, _values = decode_wire_unverified(ev["wire"])
+        recv.put_nowait(m)
+
+    decided: asyncio.Future = loop.create_future()
+
+    def decide(_instance, value_hash, _qcommit) -> None:
+        if not decided.done():
+            decided.set_result(value_hash)
+
+    timer = IncreasingRoundTimer()
+    definition = qbft.Definition(
+        is_leader=lambda i, r, p: leader(i, r, sniffed.nodes) == p,
+        new_timer=timer.new_timer,
+        decide=decide,
+        nodes=sniffed.nodes)
+
+    async def rebroadcast(m: qbft.Msg) -> None:
+        recv.put_nowait(m)  # self-delivery only; the original peers are gone
+
+    hash_fut: asyncio.Future = loop.create_future()
+    if sniffed.proposal_hash:
+        hash_fut.set_result(bytes.fromhex(sniffed.proposal_hash))
+    task = aio.spawn(
+        qbft.run(definition, qbft.Transport(rebroadcast, recv), sniffed.duty,
+                 sniffed.peer_idx, hash_fut),
+        name=f"qbft-replay-{sniffed.duty}")
+    try:
+        done, _pending = await asyncio.wait(
+            {task, decided}, timeout=timeout,
+            return_when=asyncio.FIRST_COMPLETED)
+        if decided in done:
+            return decided.result()
+        if task in done and task.exception() is not None:
+            # a corrupt record must be diagnosable, not a silent None
+            raise errors.wrap(task.exception(), "sniffed replay failed",
+                              duty=str(sniffed.duty))
+        return None
+    finally:
+        task.cancel()
+        decided.cancel()
 
 
 # ---------------------------------------------------------------------------
@@ -310,6 +421,7 @@ class _InstanceIO:
         self.decided_at: float | None = None
         self.qbft_task: asyncio.Task | None = None
         self.sig_cache: dict[qbft.Msg, bytes] = {}
+        self.sniffed: SniffedInstance | None = None
 
     def mark_participated(self) -> None:
         if self.participated:
@@ -403,6 +515,8 @@ class Component:
         inst = self._instance(duty)
         inst.mark_proposed()
         inst.values[h] = value_json
+        if inst.sniffed is not None:
+            inst.sniffed.proposal_hash = h.hex()
         if not inst.hash_fut.done():
             inst.hash_fut.set_result(h)
         proposed_at = time_mod.monotonic()
@@ -435,6 +549,10 @@ class Component:
         inst = self._instances.get(duty)
         if inst is None:
             inst = self._instances[duty] = _InstanceIO()
+            # recording starts at instance creation so inbound messages that
+            # arrive before our Propose/Participate are captured too
+            inst.sniffed = self._sniffer.new_instance(
+                duty, self._nodes, self._peer_idx)
         return inst
 
     async def _run_instance(self, duty: Duty, inst: _InstanceIO) -> None:
@@ -446,10 +564,11 @@ class Component:
                 inst.done_fut.set_result("failed")
             return
         timer = self._timer_func(duty)
-        sniffed = self._sniffer.new_instance(duty, self._nodes, self._peer_idx)
+        sniffed = inst.sniffed
 
         def decide(instance, value_hash, qcommit) -> None:
             inst.decided_at = time_mod.monotonic()
+            sniffed.decided_hash = value_hash.hex()
             _decided_rounds.set(qcommit[0].round, str(duty.type), timer.type)
             value_json = inst.values.get(value_hash)
             if value_json is None:
@@ -468,15 +587,16 @@ class Component:
             new_timer=timer.new_timer,
             decide=decide,
             nodes=self._nodes,
-            log_upon_rule=lambda *a: sniffed.msgs.append(
+            log_upon_rule=lambda *a: sniffed.add_msg(
                 {"event": "rule", "rule": str(a[-1]), "t": time_mod.time()}),
         )
 
         async def broadcast(m: qbft.Msg) -> None:
             wire = encode_wire(m, self._privkey, self._peer_idx, inst.values,
                                inst.sig_cache)
-            sniffed.msgs.append({"event": "send", "type": int(m.type),
-                                 "round": m.round, "t": time_mod.time()})
+            sniffed.add_msg({"event": "send", "type": int(m.type),
+                             "round": m.round, "t": time_mod.time(),
+                             "wire": wire})
             # Deliver to self directly (the algorithm expects its own
             # messages back) and to all peers via the transport.
             inst.recv.put_nowait(m)
@@ -540,11 +660,20 @@ class Component:
         inst.sig_cache.update(sig_cache)
         inst.values.update(values)
         # DoS cap on peer traffic (reference recvBuffer component.go:29);
-        # self-delivered messages bypass this inside the instance.
+        # self-delivered messages bypass this inside the instance. Dropped
+        # messages are NOT recorded as "recv": the sniffed stream must
+        # mirror exactly what the live algorithm consumed so a replay
+        # processes the same inputs.
         if inst.recv.qsize() >= RECV_BUFFER:
             _log.warn("consensus receive buffer full; dropping",
                       duty=str(m.instance), source=m.source)
+            if inst.sniffed is not None:
+                inst.sniffed.dropped += 1
             return
+        if inst.sniffed is not None:
+            inst.sniffed.add_msg({"event": "recv", "type": int(m.type),
+                                  "round": m.round, "source": m.source,
+                                  "t": time_mod.time(), "wire": wire})
         inst.recv.put_nowait(m)
         # A peer started consensus before us: start our instance eagerly so
         # we participate even before our Propose (reference handle starts

@@ -1,0 +1,88 @@
+"""Validator-scale tests with pass/fail teeth (round-2 VERDICT item 7).
+
+The reference runs multi-hundred-validator integration tiers: simnet tests
+over full app instances (testutil/integration/simnet_test.go:48) and a
+40-validator DKG nightly (testutil/integration/nightly_dkg_test.go). These
+are the equivalents, with explicit success-rate assertions rather than
+bench prose: a 250-DV cluster must complete ≥99% of an epoch's attester
+duties, and a 40-validator 6-operator FROST ceremony must produce
+identical, verified locks on every node.
+
+Attester duties are epoch-distributed (one slot per validator per epoch,
+the production committee shape) — the all-validators-every-slot density is
+a throughput bench (bench_scale.py config 5), not a correctness bar.
+"""
+
+import asyncio
+import time
+
+import pytest
+
+from charon_tpu.testutil.simnet import new_simnet
+
+NUM_DVS = 250
+NUM_NODES = 4
+THRESHOLD = 3
+SLOTS_PER_EPOCH = 8
+SECONDS_PER_SLOT = 4.0
+
+
+def _run(coro, timeout):
+    async def wrapped():
+        return await asyncio.wait_for(coro, timeout=timeout)
+
+    return asyncio.run(wrapped())
+
+
+@pytest.mark.scale
+def test_250_validator_epoch_duty_success_rate():
+    """All 4 nodes broadcast aggregates for ≥99% of one epoch's 250
+    attester duties (success = NUM_DVS × NUM_NODES submissions at the
+    beacon, each one a verified threshold aggregate — sigagg verifies every
+    aggregate against the DV root key before bcast)."""
+
+    async def run():
+        cluster = new_simnet(
+            num_validators=NUM_DVS, threshold=THRESHOLD, num_nodes=NUM_NODES,
+            seconds_per_slot=SECONDS_PER_SLOT,
+            slots_per_epoch=SLOTS_PER_EPOCH, genesis_delay=2.0,
+            attest_all_every_slot=False)
+        expected = NUM_DVS * NUM_NODES  # one duty per DV per epoch, per node
+        need = int(expected * 0.99)
+        await cluster.start()
+        try:
+            # one epoch of slots + deadline slack for the tail duties
+            deadline = time.monotonic() + SLOTS_PER_EPOCH * SECONDS_PER_SLOT + 40
+            while time.monotonic() < deadline:
+                if len(cluster.beacon.attestations) >= expected:
+                    break
+                await asyncio.sleep(0.5)
+        finally:
+            await cluster.stop()
+        got = len(cluster.beacon.attestations)
+        assert got >= need, (
+            f"duty success below 99%: {got}/{expected} aggregates broadcast")
+
+    _run(run(), timeout=180)
+
+
+@pytest.mark.scale
+@pytest.mark.nightly
+def test_40_validator_dkg(tmp_path):
+    """6-operator FROST ceremony for 40 validators: every node derives the
+    identical lock and all locks verify (reference nightly_dkg_test.go)."""
+    from test_dkg import _ceremony_setup
+
+    from charon_tpu.dkg import run_dkg
+
+    configs = _ceremony_setup(6, 40, 4, "frost", tmp_path)
+
+    async def run():
+        return await asyncio.gather(*(run_dkg(c) for c in configs))
+
+    locks = _run(run(), timeout=240)
+    h0 = locks[0].lock_hash()
+    assert all(lk.lock_hash() == h0 for lk in locks)
+    for lk in locks:
+        lk.verify()
+    assert len(locks[0].validators) == 40
