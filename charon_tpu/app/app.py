@@ -18,7 +18,8 @@ from dataclasses import dataclass, field
 from pathlib import Path
 
 from .. import cluster as cluster_mod
-from ..core import aggsigdb, bcast as bcast_mod, consensus as consensus_mod
+from ..core import aggsigdb, bcast as bcast_mod, coalesce as coalesce_mod
+from ..core import consensus as consensus_mod
 from ..core import dutydb, fetcher as fetcher_mod, parsigdb, parsigex as parsigex_mod
 from ..core import scheduler as scheduler_mod, sigagg as sigagg_mod, tracker as tracker_mod
 from ..core import validatorapi as vapi_mod
@@ -287,10 +288,17 @@ async def assemble(config: Config) -> App:
         privkey=identity, peer_pubkeys=peer_pubkeys,
         deadliner=Deadliner(deadline_fn), gater=new_duty_gater(chain))
     vapi = vapi_mod.Component(beacon, duty_db, aggsig_db, keys, chain)
+    # Cross-duty batching window: concurrent duties (attestation +
+    # sync-committee the same slot, adjacent slots) share one fused device
+    # dispatch so sub-threshold batches still reach the TPU (SURVEY §2.4;
+    # core/coalesce.py). Benefits the native RLC batch verifier too, so it
+    # is on regardless of the tpu_bls feature.
+    coalescer = coalesce_mod.TblsCoalescer()
     psigex = parsigex_mod.ParSigEx(
         ParSigExTCPTransport(node), my_idx, new_duty_gater(chain),
-        parsigex_mod.new_batch_eth2_verifier(chain, keys))
-    agg = sigagg_mod.SigAgg(keys, chain)
+        parsigex_mod.new_batch_eth2_verifier(chain, keys,
+                                             coalescer=coalescer))
+    agg = sigagg_mod.SigAgg(keys, chain, coalescer=coalescer)
     caster = bcast_mod.Broadcaster(beacon, chain)
     fetch.register_agg_sig_db(aggsig_db.await_)
     fetch.register_await_attestation_data(duty_db.await_attestation)

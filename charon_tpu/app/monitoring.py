@@ -89,13 +89,19 @@ class MonitoringAPI:
         """Full sniffed instances, gzipped (reference app/qbftdebug.go:22).
         Each entry round-trips through consensus.SniffedInstance.from_json
         for offline replay via consensus.replay_sniffed."""
+        import asyncio
         import gzip
 
         if self._sniffer is None:
-            body = b"[]"
+            payload = gzip.compress(b"[]")
         else:
-            body = json.dumps(self._sniffer.to_json(),
-                              default=str).encode()
-        return web.Response(body=gzip.compress(body),
+            # snapshot on the loop (cheap), but serialize+compress the
+            # multi-MB wire streams OFF the event loop — this is the loop
+            # running live consensus
+            snap = self._sniffer.to_json()
+            payload = await asyncio.get_running_loop().run_in_executor(
+                None, lambda: gzip.compress(
+                    json.dumps(snap, default=str).encode()))
+        return web.Response(body=payload,
                             content_type="application/json",
                             headers={"Content-Encoding": "gzip"})

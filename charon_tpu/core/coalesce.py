@@ -1,0 +1,180 @@
+"""Cross-duty batching window for device crypto dispatches.
+
+The TPU plane has a fixed per-dispatch floor (decompression scans + MSM
+dispatches, ~1s behind the remote tunnel), so a single duty of a small
+cluster (e.g. 100 validators) never wins on the device — TPUImpl routes
+sub-`min_device_batch` work to the CPU and the chip sits idle at exactly
+the cluster sizes most deployments run (round-2 verdict: 0.74x CPU at
+100 DVs).
+
+This window closes that gap by COALESCING concurrent submissions — the
+attestation duty, the sync-committee duty landing the same slot, adjacent
+slots' stragglers, parsigex inbound sets from several peers — into ONE
+fused device call. Submissions queue for at most `window` seconds (one
+device-dispatch latency is ~40x that, so the added latency is noise within
+the 12 s slot budget) or until `flush_at` items are pending, whichever
+comes first; the fused call runs in a worker thread so the event loop —
+and with it the NEXT duty's submission path — stays live. That last part
+is the structural fix: the previous synchronous tbls calls serialized
+duties behind the device, so no batch could ever form.
+
+SURVEY §2.4 names this batching window as the design lever; the reference
+buffers partials per duty (reference core/parsigdb/memory.go:100-122) and
+dispatches per duty to herumi — a per-duty CPU design reimagined here for
+a device with batch economics.
+"""
+
+from __future__ import annotations
+
+import asyncio
+
+from .. import tbls
+from ..utils import log, metrics
+
+_log = log.with_topic("coalesce")
+
+_flush_hist = metrics.histogram(
+    "core_coalesce_flush_items", "Items per coalesced device flush",
+    ("kind",), buckets=(64, 128, 192, 256, 512, 1024, 2048, 4096))
+_wait_hist = metrics.histogram(
+    "core_coalesce_wait_seconds", "Submission wait inside the window",
+    ("kind",))
+
+
+class _Window:
+    """One batching window: queues (size, payload, future) submissions and
+    flushes them through `dispatch` when `flush_at` items are pending or
+    `window` seconds after the first submission. `dispatch(reqs)` runs in
+    an asyncio task and must resolve every request's future itself."""
+
+    def __init__(self, kind: str, window: float, flush_at: int, dispatch):
+        self.kind = kind
+        self.window = window
+        self.flush_at = flush_at
+        self._dispatch = dispatch
+        self._q: list[tuple[int, object, asyncio.Future]] = []
+        self._timer: asyncio.TimerHandle | None = None
+
+    async def submit(self, size: int, payload):
+        loop = asyncio.get_running_loop()
+        fut = loop.create_future()
+        self._q.append((size, payload, fut))
+        if sum(s for s, _, _ in self._q) >= self.flush_at:
+            self._flush()
+        elif self._timer is None:
+            self._timer = loop.call_later(self.window, self._flush)
+        t0 = loop.time()
+        try:
+            return await fut
+        finally:
+            _wait_hist.observe(loop.time() - t0, self.kind)
+
+    def _flush(self) -> None:
+        if self._timer is not None:
+            self._timer.cancel()
+            self._timer = None
+        reqs, self._q = self._q, []
+        if reqs:
+            asyncio.ensure_future(self._run(reqs))
+
+    async def _run(self, reqs) -> None:
+        _flush_hist.observe(sum(s for s, _, _ in reqs), self.kind)
+        futs = [f for _, _, f in reqs]
+        try:
+            await self._dispatch([p for _, p, _ in reqs], futs)
+        except Exception as exc:  # noqa: BLE001 — propagate to every waiter
+            for f in futs:
+                _resolve(f, exc=exc)
+
+
+def _resolve(fut: asyncio.Future, result=None, exc=None) -> None:
+    """Set a waiter's outcome, tolerating waiters that went away (deadline
+    cancellation cancels the awaited future) — one dead waiter must never
+    strand the other requests in the flush."""
+    if fut.done():
+        return
+    if exc is not None:
+        fut.set_exception(exc)
+    else:
+        fut.set_result(result)
+
+
+class TblsCoalescer:
+    """Batches aggregate+verify and bulk-verify submissions across
+    concurrent duties into single fused tbls dispatches (module doc)."""
+
+    def __init__(self, window: float = 0.025, flush_at: int | None = None):
+        impl = tbls.get_implementation()
+        if flush_at is None:
+            flush_at = getattr(impl, "min_device_batch", 192)
+        self._agg = _Window("agg", window, flush_at, self._dispatch_agg)
+        self._ver = _Window("verify", window, flush_at, self._dispatch_ver)
+        self.flushes = 0
+        self.coalesced_flushes = 0
+
+    # ---- public API ------------------------------------------------------
+
+    async def aggregate_verify(self, batches, pks, roots):
+        """Queue one duty's (batches, pks, signing roots); resolves to
+        (agg_sigs, ok) for exactly this submission once a window flushes.
+        ok=False means at least one of THIS submission's aggregates failed
+        (per-request re-verify attributes fused-batch failures)."""
+        return await self._agg.submit(
+            len(batches), (list(batches), list(pks), list(roots)))
+
+    async def verify(self, pks, roots, sigs) -> bool:
+        """Queue one bulk verify (the parsigex inbound path); resolves to
+        the validity of exactly this submission's set."""
+        return await self._ver.submit(
+            len(sigs), (list(pks), list(roots), list(sigs)))
+
+    # ---- fused dispatches ------------------------------------------------
+
+    def _note_flush(self, n_reqs: int) -> None:
+        self.flushes += 1
+        if n_reqs > 1:
+            self.coalesced_flushes += 1
+
+    async def _dispatch_agg(self, payloads, futs) -> None:
+        loop = asyncio.get_running_loop()
+        self._note_flush(len(payloads))
+        batches = [b for p in payloads for b in p[0]]
+        pks = [k for p in payloads for k in p[1]]
+        roots = [r for p in payloads for r in p[2]]
+        sigs, ok = await loop.run_in_executor(
+            None, tbls.threshold_aggregate_verify_batch, batches, pks, roots)
+        off = 0
+        slices = []
+        for p in payloads:
+            n = len(p[0])
+            slices.append(sigs[off:off + n])
+            off += n
+        if ok:
+            for f, s in zip(futs, slices):
+                _resolve(f, (s, True))
+            return
+        # attribution: the fused batch failed somewhere — re-verify each
+        # request's slice so only the offending request(s) see ok=False
+        _log.debug("coalesced aggregate batch failed; attributing",
+                   requests=len(payloads), items=len(batches))
+        for p, f, s in zip(payloads, futs, slices):
+            r_ok = await loop.run_in_executor(
+                None, tbls.verify_batch, p[1], p[2], s)
+            _resolve(f, (s, bool(r_ok)))
+
+    async def _dispatch_ver(self, payloads, futs) -> None:
+        loop = asyncio.get_running_loop()
+        self._note_flush(len(payloads))
+        pks = [k for p in payloads for k in p[0]]
+        roots = [r for p in payloads for r in p[1]]
+        sigs = [s for p in payloads for s in p[2]]
+        ok = await loop.run_in_executor(
+            None, tbls.verify_batch, pks, roots, sigs)
+        if ok:
+            for f in futs:
+                _resolve(f, True)
+            return
+        for p, f in zip(payloads, futs):
+            r_ok = await loop.run_in_executor(
+                None, tbls.verify_batch, p[0], p[1], p[2])
+            _resolve(f, bool(r_ok))

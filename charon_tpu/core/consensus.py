@@ -290,12 +290,15 @@ class SniffedInstance:
         self.msgs.append(event)
 
     def to_json(self) -> dict:
+        # shallow-copy the live containers: consumers (the /debug/qbft
+        # handler serializes OFF the event loop) must not race add_msg.
+        # Entries are never mutated after insertion, so shallow is enough.
         return {
             "duty": {"slot": self.duty.slot, "type": int(self.duty.type)},
             "nodes": self.nodes, "peer_idx": self.peer_idx,
             "started_at": self.started_at, "proposal_hash": self.proposal_hash,
             "decided_hash": self.decided_hash, "dropped": self.dropped,
-            "values": self.values, "msgs": self.msgs,
+            "values": dict(self.values), "msgs": list(self.msgs),
         }
 
     @staticmethod

@@ -48,10 +48,13 @@ def new_eth2_verifier(chain: ChainSpec, keys: KeyShares) -> VerifyFunc:
     return verify
 
 
-def new_batch_eth2_verifier(chain: ChainSpec, keys: KeyShares):
+def new_batch_eth2_verifier(chain: ChainSpec, keys: KeyShares,
+                            coalescer=None):
     """Batched variant: verify a whole inbound set in one tbls.verify_batch
     call (the TPU fast path); falls back to per-sig verify to identify
-    culprits on failure (north-star parsigex batching)."""
+    culprits on failure (north-star parsigex batching). With a coalescer
+    (core/coalesce.py), inbound sets from several peers landing within the
+    batching window share one fused device dispatch."""
 
     async def verify_set(duty: Duty, parsigs: ParSignedDataSet) -> None:
         pks: list[tbls.PublicKey] = []
@@ -65,7 +68,10 @@ def new_batch_eth2_verifier(chain: ChainSpec, keys: KeyShares):
             pks.append(keys.share_pubkey(pubkey, psd.share_idx))
             roots.append(data.signing_root(chain))
             sigs.append(psd.signature())
-        if tbls.verify_batch(pks, roots, sigs):
+        if coalescer is not None:
+            if await coalescer.verify(pks, roots, sigs):
+                return
+        elif tbls.verify_batch(pks, roots, sigs):
             return
         # Batch failed: identify culprit(s) individually.
         for (pubkey, psd), pk, root, sig in zip(parsigs.items(), pks, roots, sigs):

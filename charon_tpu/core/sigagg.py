@@ -27,10 +27,15 @@ _agg_hist = metrics.histogram(
 class SigAgg:
     """reference sigagg.New / Aggregate (sigagg.go:48)."""
 
-    def __init__(self, keys: KeyShares, chain: ChainSpec, verify: bool = True):
+    def __init__(self, keys: KeyShares, chain: ChainSpec, verify: bool = True,
+                 coalescer=None):
         self._keys = keys
         self._chain = chain
         self._verify = verify
+        # optional cross-duty batching window (core/coalesce.py): routes the
+        # fused aggregate+verify through a shared dispatch so concurrent
+        # duties of a small cluster still reach the device batch threshold
+        self._coalescer = coalescer
         self._subs = []
 
     def subscribe(self, fn) -> None:
@@ -63,12 +68,23 @@ class SigAgg:
             isinstance(t.data, _Eth2Signed) for t in templates)
 
         if all_eth2:
-            with _agg_hist.time(str(duty.type)), \
-                    tracer.start_span("sigagg/aggregate+verify",
-                                      duty=str(duty), batch=len(batches)):
-                agg_sigs, ok = tbls.threshold_aggregate_verify_batch(
-                    batches, [pubkey_to_bytes(pk) for pk in pubkeys],
-                    [t.data.signing_root(self._chain) for t in templates])
+            pk_bytes = [pubkey_to_bytes(pk) for pk in pubkeys]
+            roots = [t.data.signing_root(self._chain) for t in templates]
+            if self._coalescer is not None:
+                # the coalescer records its own window-wait and fused-flush
+                # metrics (core_coalesce_*); timing the shared multi-duty
+                # dispatch under THIS duty's histogram label would corrupt
+                # the per-duty latency series
+                with tracer.start_span("sigagg/aggregate+verify",
+                                       duty=str(duty), batch=len(batches)):
+                    agg_sigs, ok = await self._coalescer.aggregate_verify(
+                        batches, pk_bytes, roots)
+            else:
+                with _agg_hist.time(str(duty.type)), \
+                        tracer.start_span("sigagg/aggregate+verify",
+                                          duty=str(duty), batch=len(batches)):
+                    agg_sigs, ok = tbls.threshold_aggregate_verify_batch(
+                        batches, pk_bytes, roots)
         else:
             with _agg_hist.time(str(duty.type)), \
                     tracer.start_span("sigagg/aggregate", duty=str(duty),

@@ -1,0 +1,28 @@
+"""Shared random-linear-combination (batch-verification) parameters.
+
+Both batch-verify backends — the native C++ ct_verify_batch path
+(tbls/native_impl.py) and the TPU RLC plane path (ops/plane_agg.py) —
+draw their randomizers from here so the security level is consistent and
+auditable in one place.
+
+A forged batch passes RLC verification with probability ≤ 2^-RLC_BITS
+over the randomizers (per submitted batch). 64-bit randomizers match the
+batch-verification practice of production eth2 clients (blst mult-verify
+as wired by Prysm/Lighthouse); raise to 128 for a 2^-128 bound at ~2× the
+MSM cost on both backends. The reference delegates per-signature
+verification to herumi (tbls/herumi.go) and does not batch at all, so this
+constant has no upstream counterpart to match.
+"""
+
+from __future__ import annotations
+
+import secrets
+
+# Width (bits) of each RLC randomizer. Shared by tbls/native_impl.py
+# (ct_verify_batch coefficients) and ops/plane_agg.py (device MSM digits).
+RLC_BITS = 64
+
+
+def sample_randomizer() -> int:
+    """One nonzero RLC_BITS-bit randomizer (low bit forced so none is 0)."""
+    return secrets.randbits(RLC_BITS) | 1

@@ -62,10 +62,39 @@ _interpret_cache: list = []
 
 def _interpret() -> bool:
     """Mosaic kernels need a real TPU; anywhere else (CPU CI, the virtual
-    8-device mesh) run the kernels in pallas interpret mode."""
+    8-device mesh) the kernel bodies run as plain traced jax ops (see
+    _run_kernel) — NOT pallas interpret mode, which evaluates the body
+    eagerly op-by-op and is ~1000x slower on the CI hosts."""
     if not _interpret_cache:
         _interpret_cache.append(jax.default_backend() == "cpu")
     return _interpret_cache[0]
+
+
+class _OutRef:
+    """Stand-in for a pallas output Ref when the kernel body is evaluated
+    as traced ops (CPU path): the bodies only ever write `ref[:] = value`."""
+
+    __slots__ = ("val",)
+
+    def __init__(self):
+        self.val = None
+
+    def __setitem__(self, idx, value):
+        self.val = value
+
+
+def _run_kernel(kern, ins, n_out):
+    """CPU execution of a pallas kernel body: call it once over the FULL
+    plane with plain arrays (reads are `x[:]`, which is the identity on a
+    jax array) and _OutRef writes. The bodies are elementwise along the
+    lane-block axis, so one full-width evaluation matches the gridded
+    pallas_call block-by-block results bit-for-bit — but it traces into
+    the enclosing jit and XLA-compiles instead of interpreting eagerly."""
+    outs = [_OutRef() for _ in range(n_out)]
+    kern(jnp.asarray(_P_NP), *ins, *outs)
+    if n_out == 1:
+        return outs[0].val
+    return tuple(o.val for o in outs)
 
 
 def _enable_compile_cache() -> None:
@@ -166,10 +195,15 @@ def _fq_sub(a, b):
 
 def _mont_many(planes):
     """Stacked Montgomery products: the pairs are pre-concatenated along the
-    lane axis into (a, b) of shape (LIMBS, 8, total_w); ONE fully-unrolled
-    32-iteration CIOS loop computes every product. Inputs canonical 12-bit
-    limbs; output canonical in [0, p). Same lazy-accumulation bound proof as
-    ops/field.py fq_mont_mul (products ≤ 2^24, columns ≤ 33·2^25 < 2^31)."""
+    lane axis into (a, b) of shape (LIMBS, 8, total_w); ONE 32-iteration
+    CIOS loop computes every product. Inputs canonical 12-bit limbs; output
+    canonical in [0, p). Same lazy-accumulation bound proof as ops/field.py
+    fq_mont_mul (products ≤ 2^24, columns ≤ 33·2^25 < 2^31).
+
+    The loop is fully unrolled with rotation-based limb iteration (Mosaic
+    does not lower dynamic indexing; a lax.scan variant was measured 5x
+    SLOWER to compile and 1000x slower to run under XLA CPU, so the CPU
+    path shares the unrolled body)."""
     a, b = planes
     p_rows = [_PCOL[0][j] for j in range(LIMBS)]
     b_rows = [b[j] for j in range(LIMBS)]
@@ -182,6 +216,8 @@ def _mont_many(planes):
         carry0 = t[0] >> LIMB_BITS
         t = [t[1] + carry0] + t[2:] + [t[0] * 0]
     return _cond_sub_p(_carry_canon(jnp.stack(t, axis=0), passes=3))
+
+
 
 
 # ---------------------------------------------------------------------------
@@ -347,9 +383,10 @@ def _double_call(X, Y, Z, E):
         rx, ry, rz = _pt_double((x[:], y[:], z[:]))
         ox[:], oy[:], oz[:] = rx, ry, rz
 
+    if _interpret():
+        return _run_kernel(kern, (X, Y, Z), 3)
     return pl.pallas_call(
         kern,
-        interpret=_interpret(),
         grid=(W // tw,),
         in_specs=[_pspec()] + [_espec(E, S, tw)] * 3,
         out_specs=[_espec(E, S, tw)] * 3,
@@ -368,9 +405,10 @@ def _add_call(X1, Y1, Z1, X2, Y2, Z2, E):
                                      (x2[:], y2[:], z2[:]))
         ox[:], oy[:], oz[:] = rx, ry, rz
 
+    if _interpret():
+        return _run_kernel(kern, (X1, Y1, Z1, X2, Y2, Z2), 3)
     return pl.pallas_call(
         kern,
-        interpret=_interpret(),
         grid=(W // tw,),
         in_specs=[_pspec()] + [_espec(E, S, tw)] * 6,
         out_specs=[_espec(E, S, tw)] * 3,
@@ -388,9 +426,10 @@ def _sub_call(A, B, E):
         _PCOL[0] = pref[:]
         o[:] = _unpack(_fq_sub(_pack(a[:]), _pack(b[:])), E)
 
+    if _interpret():
+        return _run_kernel(kern, (A, B), 1)
     return pl.pallas_call(
         kern,
-        interpret=_interpret(),
         grid=(W // tw,),
         in_specs=[_pspec()] + [_espec(E, S, tw)] * 2,
         out_specs=_espec(E, S, tw),
@@ -416,9 +455,10 @@ def _addp_call(A, B, E):
         _PCOL[0] = pref[:]
         o[:] = _unpack(_fq_add(_pack(a[:]), _pack(b[:])), E)
 
+    if _interpret():
+        return _run_kernel(kern, (A, B), 1)
     return pl.pallas_call(
         kern,
-        interpret=_interpret(),
         grid=(W // tw,),
         in_specs=[_pspec()] + [_espec(E, S, tw)] * 2,
         out_specs=_espec(E, S, tw),
@@ -493,9 +533,10 @@ def _mul_call(A, B, E):
         _PCOL[0] = pref[:]
         o[:] = _e_mul_many([(a[:], b[:])])[0]
 
+    if _interpret():
+        return _run_kernel(kern, (A, B), 1)
     return pl.pallas_call(
         kern,
-        interpret=_interpret(),
         grid=(W // tw,),
         in_specs=[_pspec()] + [_espec(E, S, tw)] * 2,
         out_specs=_espec(E, S, tw),

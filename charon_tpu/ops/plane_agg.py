@@ -35,7 +35,6 @@ from __future__ import annotations
 
 import ctypes
 import functools
-import secrets
 
 import jax
 import jax.numpy as jnp
@@ -43,15 +42,10 @@ import numpy as np
 
 from ..crypto import fields as PF
 from ..crypto.curve import g1_generator, jac_is_infinity, FqOps, Fq2Ops
+from ..crypto.rlc import RLC_BITS, sample_randomizer
 from ..crypto.serialize import g1_to_bytes, g2_to_bytes
 from . import field as F
 from . import pallas_plane as PP
-
-# Random-linear-combination coefficient width. 64-bit randomizers (forgery
-# probability ≤ 2⁻⁶⁴ per submitted batch) match the batch-verification
-# practice of production eth2 clients (blst's mult-verify as used by
-# Prysm/Lighthouse); raise to 128 for 2⁻¹²⁸ at ~2× the MSM cost.
-RLC_BITS = 64
 
 _MONT_ONE = F.fq_from_int(1)
 
@@ -72,6 +66,16 @@ def _native_lib():
     from ..tbls.native_impl import load_library
 
     return load_library()
+
+
+def _device_path(n: int = 1 << 30) -> bool:
+    """Whether the batched DEVICE decoders/serializer should run (vs the
+    native bulk path). On a real chip: yes for non-trivial batches. In
+    interpret mode the native path is the default, but tests force this
+    True to exercise the full device pipeline on the CPU CI mesh
+    (tests/test_plane_agg_interp.py) — the exact code the driver benches
+    must never be green-in-CI yet crash-at-bench."""
+    return not PP._interpret() and n >= 64
 
 
 # ---------------------------------------------------------------------------
@@ -129,7 +133,7 @@ def g2_plane_from_compressed(sigs: list[bytes], Bp: int,
     (_g2_plane_device); the native bulk decode remains the interpret-mode /
     small-batch path and the oracle the device decoder is tested against."""
     n = len(sigs)
-    if not PP._interpret() and n >= 64:
+    if _device_path(n):
         plane = _g2_plane_device(sigs, Bp, reject_infinity)
         if check_subgroup and not g2_subgroup_ok(plane):
             raise ValueError("G2 point not in subgroup")
@@ -162,7 +166,7 @@ def g1_plane_from_compressed(pks: list[bytes], Bp: int,
                              check_subgroup: bool = False,
                              reject_infinity: bool = False) -> PP.PlanePoint:
     n = len(pks)
-    if not PP._interpret() and n >= 64:
+    if _device_path(n):
         plane = _g1_plane_device(pks, Bp, reject_infinity)
         if check_subgroup and not g1_subgroup_ok(plane):
             raise ValueError("G1 point not in subgroup")
@@ -615,7 +619,7 @@ def _aggregate_plane(batches: list[dict[int, bytes]]):
 
 
 def _serialize_aggregates(RX, RY, RZ, V: int) -> list[bytes]:
-    if not PP._interpret():
+    if _device_path():
         # affine conversion + standard form on device; host only slices
         # bytes (the per-point host fq2 inversions/muls were ~0.4s/1000)
         return _g2_serialize_device(RX, RY, RZ, V)
@@ -709,9 +713,15 @@ def _fp_limbs_to_be(limbs: np.ndarray) -> np.ndarray:
 
 def _g2_serialize_device(RX, RY, RZ, V: int) -> list[bytes]:
     xs, sign, inf = _g2_affine_std_jit(RX, RY, RZ)
-    x_np = np.asarray(xs)
-    sign_np = np.asarray(sign).reshape(-1)[:V]
-    inf_np = np.asarray(inf).reshape(-1)[:V]
+    return _g2_emit_bytes(np.asarray(xs), np.asarray(sign).reshape(-1),
+                          np.asarray(inf).reshape(-1), V)
+
+
+def _g2_emit_bytes(x_np: np.ndarray, sign_np: np.ndarray,
+                   inf_np: np.ndarray, V: int) -> list[bytes]:
+    """Standard-form affine x planes + sign/infinity masks -> compressed
+    bytes (host byte slicing only; shared with the sharded plane)."""
+    sign_np, inf_np = sign_np[:V], inf_np[:V]
     x0 = _fp_limbs_to_be(PP.from_plane(x_np[0][None], V))
     x1 = _fp_limbs_to_be(PP.from_plane(x_np[1][None], V))
     inf_bytes = b"\xc0" + bytes(95)
@@ -762,6 +772,9 @@ def _g2_jacs_to_bytes(jacs: list) -> list[bytes]:
 
 
 _PK_PLANE_CACHE: dict[tuple, PP.PlanePoint] = {}
+# sized to cover num_peers share-pubkey sets (parsigex, one per peer) plus
+# the sigagg root-pubkey set for the largest supported cluster (10 peers)
+_PK_PLANE_CACHE_MAX = 12
 
 
 def _pk_plane_cached(pks: list[bytes], Bp: int) -> PP.PlanePoint:
@@ -781,9 +794,14 @@ def _pk_plane_cached(pks: list[bytes], Bp: int) -> PP.PlanePoint:
         plane = g1_plane_from_compressed(pks, Bp, reject_infinity=True)
         if not g1_subgroup_ok(plane):
             raise ValueError("G1 pubkey not in subgroup")
-        if len(_PK_PLANE_CACHE) >= 8:
+        if len(_PK_PLANE_CACHE) >= _PK_PLANE_CACHE_MAX:
             _PK_PLANE_CACHE.pop(next(iter(_PK_PLANE_CACHE)))
-        _PK_PLANE_CACHE[key] = plane
+    else:
+        # true LRU: refresh on hit so a working set larger than insertion
+        # order suggests (per-peer share-pubkey lists + the sigagg root set)
+        # doesn't evict its hottest entry
+        _PK_PLANE_CACHE.pop(key)
+    _PK_PLANE_CACHE[key] = plane
     return plane
 
 
@@ -823,7 +841,7 @@ def _rlc_dispatch(sig_plane: PP.PlanePoint, pk_plane: PP.PlanePoint,
     zero randomizers (∞ contributions)."""
     n = len(msgs)
     Bp = sig_plane.B
-    rs = [secrets.randbits(RLC_BITS) | 1 for _ in range(n)]
+    rs = [sample_randomizer() for _ in range(n)]
     # one uint8 digit transfer shared by the sig and pk MSM dispatches
     digits = jnp.asarray(
         PP.scalars_to_digitplanes(rs, Bp, nbits=RLC_BITS))

@@ -69,7 +69,16 @@ class Implementation(Protocol):
         aggregate against (public_key, data). Backends may fuse the two
         (the TPU backend verifies the freshly computed aggregate plane
         without a serialize→decompress round trip); the default is the
-        two-call sequence (reference core/sigagg/sigagg.go:144,159)."""
+        two-call sequence (reference core/sigagg/sigagg.go:144,159).
+
+        PRECONDITION: every partial signature in `batches` must already be
+        individually verified (and therefore subgroup-checked) — parsigex /
+        validatorapi do this on receipt, matching the reference's trust
+        boundary. Fused backends rely on it: they skip subgroup checks on
+        the partials and the aggregates (aggregates of in-subgroup points
+        stay in the subgroup), so feeding UNVERIFIED partials here would
+        silently void the RLC soundness bound. For unverified inputs use
+        verify_batch / verify per item first."""
         ...
 
 

@@ -209,8 +209,13 @@ class NativeImpl(FrScalarOps):
             offs[i] = pos
             pos += len(d)
         offs[n] = pos
-        # fresh CSPRNG coefficients (low bit forced to 1 so none is zero)
-        coefs = b"".join((int.from_bytes(os.urandom(16), "big") | 1).to_bytes(16, "big") for _ in range(n))
+        # fresh CSPRNG coefficients, RLC_BITS wide (shared security level
+        # with the TPU backend — crypto/rlc.py), left-padded to the 16-byte
+        # slots ct_verify_batch consumes
+        from ..crypto.rlc import sample_randomizer
+
+        coefs = b"".join(sample_randomizer().to_bytes(16, "big")
+                         for _ in range(n))
         return self._lib.ct_verify_batch(pks, msgcat, offs, sigs, coefs, n) == 1
 
 

@@ -32,6 +32,18 @@ class BackgroundPusher:
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
         self._backoff = interval
+        # per-endpoint undelivered (seq, item) lists: retries only target
+        # the endpoints that actually failed, so a flaky endpoint can't
+        # duplicate lines on the healthy ones. _remaining[seq] counts the
+        # endpoints an item still has to reach; pushed_total counts an item
+        # once, when it has reached all of them.
+        self._pending: dict[str, list] = {}
+        self._remaining: dict[int, int] = {}
+        self._seq = 0
+        # serializes _push_once bodies: stop(flush=True) can race a
+        # still-running background push when the join times out, and the
+        # per-endpoint state must not be mutated from two threads
+        self._push_lock = threading.Lock()
         self.pushed_total = 0
         self.dropped_total = 0
         self.errors_total = 0
@@ -81,27 +93,67 @@ class BackgroundPusher:
         raise NotImplementedError
 
     def _push_once(self) -> bool:
+        with self._push_lock:
+            return self._push_once_locked()
+
+    def _push_once_locked(self) -> bool:
         with self._lock:
             batch, self._buf = self._buf, []
-        if not batch:
-            return True
-        payload = self._payload(batch)
-        ok = bool(self.endpoints)
-        for endpoint in self.endpoints:
+        endpoints = list(self.endpoints)
+        if not endpoints:
+            if not batch:
+                return True
+            with self._lock:  # nowhere to send: requeue like a failure
+                self._buf = batch + self._buf
+                self._cap_locked()
+            self.errors_total += 1
+            return False
+        if batch:
+            tagged = []
+            for item in batch:
+                self._remaining[self._seq] = len(endpoints)
+                tagged.append((self._seq, item))
+                self._seq += 1
+            for endpoint in endpoints:
+                pend = self._pending.setdefault(endpoint, [])
+                pend.extend(tagged)
+                overflow = len(pend) - _MAX_BUFFER
+                if overflow > 0:  # cap per endpoint, oldest dropped
+                    for seq, _ in pend[:overflow]:
+                        # count a logical item dropped ONCE, on its first
+                        # drop anywhere (it can no longer reach all
+                        # endpoints, so it will never count as pushed)
+                        if self._remaining.pop(seq, None) is not None:
+                            self.dropped_total += 1
+                    del pend[:overflow]
+        ok = True
+        for endpoint in endpoints:
+            pend = self._pending.get(endpoint)
+            if not pend:
+                continue
             req = urllib.request.Request(
-                endpoint, data=payload,
+                endpoint, data=self._payload([it for _, it in pend]),
                 headers={"Content-Type": self.content_type})
+            delivered = False
             try:
                 with urllib.request.urlopen(req,
                                             timeout=self.timeout) as resp:
-                    ok &= 200 <= resp.status < 300
+                    delivered = 200 <= resp.status < 300
             except (urllib.error.URLError, OSError):
+                delivered = False
+            if delivered:
+                for seq, _ in pend:
+                    left = self._remaining.get(seq)
+                    if left is None:
+                        continue
+                    if left <= 1:
+                        del self._remaining[seq]
+                        self.pushed_total += 1
+                    else:
+                        self._remaining[seq] = left - 1
+                self._pending[endpoint] = []
+            else:
                 ok = False
-        if ok:
-            self.pushed_total += len(batch)
-            return True
-        self.errors_total += 1
-        with self._lock:  # requeue at the front, newest-capped
-            self._buf = batch + self._buf
-            self._cap_locked()
-        return False
+        if not ok:
+            self.errors_total += 1
+        return ok

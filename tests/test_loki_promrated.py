@@ -77,6 +77,56 @@ class TestLokiPusher:
         assert p.pushed_total == 1
         srv.shutdown()
 
+    def test_multi_endpoint_retry_targets_only_failed(self):
+        """One endpoint 500s the first batch: the retry must re-send ONLY to
+        it — the healthy endpoint gets each line exactly once."""
+        class _A(BaseHTTPRequestHandler):
+            received: list = []
+
+            def do_POST(self):
+                body = self.rfile.read(int(self.headers["Content-Length"]))
+                type(self).received.append(json.loads(body))
+                self.send_response(204)
+                self.end_headers()
+
+            def log_message(self, *a):
+                pass
+
+        class _B(_A):
+            received = []
+            fail_next = [True]
+
+            def do_POST(self):
+                if _B.fail_next:
+                    _B.fail_next.pop()
+                    self.rfile.read(int(self.headers["Content-Length"]))
+                    self.send_response(500)
+                    self.end_headers()
+                    return
+                super().do_POST()
+
+        srv_a = HTTPServer(("127.0.0.1", 0), _A)
+        srv_b = HTTPServer(("127.0.0.1", 0), _B)
+        for s in (srv_a, srv_b):
+            threading.Thread(target=s.serve_forever, daemon=True).start()
+        url = (f"http://127.0.0.1:{srv_a.server_port},"
+               f"http://127.0.0.1:{srv_b.server_port}")
+        p = LokiPusher(url, interval=0.05)
+        p.add("only once", ts=1.0)
+        assert not p._push_once()      # B 500s; A accepted
+        assert p.errors_total == 1
+        assert p.pushed_total == 0     # not yet delivered everywhere
+        assert p._push_once()          # retry reaches only B
+        assert p.pushed_total == 1
+        lines_a = [v[1] for b in _A.received for s in b["streams"]
+                   for v in s["values"]]
+        lines_b = [v[1] for b in _B.received for s in b["streams"]
+                   for v in s["values"]]
+        assert lines_a == ["only once"]   # no duplicate on the healthy one
+        assert lines_b == ["only once"]
+        srv_a.shutdown()
+        srv_b.shutdown()
+
     def test_buffer_cap_drops_oldest(self):
         p = LokiPusher("http://127.0.0.1:1")  # nothing listening
         from charon_tpu.utils import push as push_mod

@@ -149,3 +149,24 @@ def test_bulk_uncompress_roundtrip_and_subgroup_flag():
     for i in range(4):
         assert F.fq_to_int(flat1[i]) == to_affine(
             FqOps, g1_from_bytes(bytes(pks[i])))[0]
+
+
+def test_pk_plane_cache_is_lru(monkeypatch):
+    """A hot pubkey set refreshed on every hit must survive more distinct
+    working-set keys than the cache holds (parsigex per-peer share sets +
+    the sigagg root set) — insertion-order eviction would drop it."""
+    from charon_tpu.ops import plane_agg
+
+    monkeypatch.setattr(plane_agg, "_PK_PLANE_CACHE", {})
+    monkeypatch.setattr(plane_agg, "_PK_PLANE_CACHE_MAX", 3)
+    loads = []
+    monkeypatch.setattr(plane_agg, "g1_plane_from_compressed",
+                        lambda pks, Bp, **kw: loads.append(bytes(pks[0])) or object())
+    monkeypatch.setattr(plane_agg, "g1_subgroup_ok", lambda plane: True)
+
+    hot = [b"\xaa" * 48]
+    plane_agg._pk_plane_cached(hot, 1024)
+    for i in range(6):
+        plane_agg._pk_plane_cached([bytes([i]) * 48], 1024)
+        plane_agg._pk_plane_cached(hot, 1024)  # hit -> must refresh recency
+    assert loads.count(b"\xaa" * 48) == 1, "hot entry was evicted and reloaded"
