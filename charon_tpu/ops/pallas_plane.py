@@ -62,39 +62,46 @@ _interpret_cache: list = []
 
 def _interpret() -> bool:
     """Mosaic kernels need a real TPU; anywhere else (CPU CI, the virtual
-    8-device mesh) the kernel bodies run as plain traced jax ops (see
-    _run_kernel) — NOT pallas interpret mode, which evaluates the body
-    eagerly op-by-op and is ~1000x slower on the CI hosts."""
+    8-device mesh) the wrappers delegate to the XLA-op-level plane
+    (_cpu_point_op / ops/field.py) — NOT pallas interpret mode, which
+    evaluates the body eagerly op-by-op and is ~1000x slower."""
     if not _interpret_cache:
         _interpret_cache.append(jax.default_backend() == "cpu")
     return _interpret_cache[0]
 
 
-class _OutRef:
-    """Stand-in for a pallas output Ref when the kernel body is evaluated
-    as traced ops (CPU path): the bodies only ever write `ref[:] = value`."""
+# ---------------------------------------------------------------------------
+# CPU execution path: the kernel wrappers delegate to the XLA-op-level field
+# plane (ops/field.py, ops/curve.py). Rationale: inlining the unrolled
+# in-kernel CIOS bodies into the surrounding jit produces multi-million-op
+# HLO that XLA CPU takes tens of minutes to compile (pallas interpret mode
+# is slower still — it evaluates the body eagerly), while ops/field's
+# scan-based CIOS traces ~20x smaller. The formulas are the same
+# (dbl-2009-l, branchless unified add, identical CIOS math), and both paths
+# return CANONICAL mod-p limbs, so outputs are bit-identical — the
+# test_pallas_plane oracle suite pins this equivalence in CI.
+# ---------------------------------------------------------------------------
 
-    __slots__ = ("val",)
 
-    def __init__(self):
-        self.val = None
-
-    def __setitem__(self, idx, value):
-        self.val = value
+def _plane_to_rows(a, E):
+    """(E, LIMBS, 8, W) kernel plane -> (8, W, [2,] LIMBS) ops/field rows."""
+    r = jnp.transpose(a, (2, 3, 0, 1))
+    return r[..., 0, :] if E == 1 else r
 
 
-def _run_kernel(kern, ins, n_out):
-    """CPU execution of a pallas kernel body: call it once over the FULL
-    plane with plain arrays (reads are `x[:]`, which is the identity on a
-    jax array) and _OutRef writes. The bodies are elementwise along the
-    lane-block axis, so one full-width evaluation matches the gridded
-    pallas_call block-by-block results bit-for-bit — but it traces into
-    the enclosing jit and XLA-compiles instead of interpreting eagerly."""
-    outs = [_OutRef() for _ in range(n_out)]
-    kern(jnp.asarray(_P_NP), *ins, *outs)
-    if n_out == 1:
-        return outs[0].val
-    return tuple(o.val for o in outs)
+def _rows_to_plane(r, E):
+    if E == 1:
+        r = r[..., None, :]
+    return jnp.transpose(r, (2, 3, 0, 1))
+
+
+def _cpu_point_op(fn, planes, E):
+    from . import curve as DC
+
+    ops = DC.FQ_OPS if E == 1 else DC.FQ2_OPS
+    pts = [tuple(_plane_to_rows(c, E) for c in p) for p in planes]
+    out = fn(ops, *pts)
+    return tuple(_rows_to_plane(c, E) for c in out)
 
 
 def _enable_compile_cache() -> None:
@@ -384,7 +391,9 @@ def _double_call(X, Y, Z, E):
         ox[:], oy[:], oz[:] = rx, ry, rz
 
     if _interpret():
-        return _run_kernel(kern, (X, Y, Z), 3)
+        from . import curve as DC
+
+        return _cpu_point_op(DC.double, [(X, Y, Z)], E)
     return pl.pallas_call(
         kern,
         grid=(W // tw,),
@@ -406,7 +415,10 @@ def _add_call(X1, Y1, Z1, X2, Y2, Z2, E):
         ox[:], oy[:], oz[:] = rx, ry, rz
 
     if _interpret():
-        return _run_kernel(kern, (X1, Y1, Z1, X2, Y2, Z2), 3)
+        from . import curve as DC
+
+        return _cpu_point_op(DC.add_unified,
+                             [(X1, Y1, Z1), (X2, Y2, Z2)], E)
     return pl.pallas_call(
         kern,
         grid=(W // tw,),
@@ -427,7 +439,8 @@ def _sub_call(A, B, E):
         o[:] = _unpack(_fq_sub(_pack(a[:]), _pack(b[:])), E)
 
     if _interpret():
-        return _run_kernel(kern, (A, B), 1)
+        return _rows_to_plane(F.fq_sub(_plane_to_rows(A, E),
+                                       _plane_to_rows(B, E)), E)
     return pl.pallas_call(
         kern,
         grid=(W // tw,),
@@ -456,7 +469,8 @@ def _addp_call(A, B, E):
         o[:] = _unpack(_fq_add(_pack(a[:]), _pack(b[:])), E)
 
     if _interpret():
-        return _run_kernel(kern, (A, B), 1)
+        return _rows_to_plane(F.fq_add(_plane_to_rows(A, E),
+                                       _plane_to_rows(B, E)), E)
     return pl.pallas_call(
         kern,
         grid=(W // tw,),
@@ -534,7 +548,9 @@ def _mul_call(A, B, E):
         o[:] = _e_mul_many([(a[:], b[:])])[0]
 
     if _interpret():
-        return _run_kernel(kern, (A, B), 1)
+        ra, rb = _plane_to_rows(A, E), _plane_to_rows(B, E)
+        out = F.fq_mont_mul(ra, rb) if E == 1 else F.fq2_mul(ra, rb)
+        return _rows_to_plane(out, E)
     return pl.pallas_call(
         kern,
         grid=(W // tw,),

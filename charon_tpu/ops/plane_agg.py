@@ -207,6 +207,7 @@ def g1_plane_from_compressed(pks: list[bytes], Bp: int,
 
 _EXP_SQRT = None  # (p+1)/4 window digits, lazily built
 _EXP_INV = None   # p-2 window digits
+_EXP_34 = None    # (p-3)/4 window digits
 
 
 def _sqrt_inv_bits():
@@ -215,6 +216,15 @@ def _sqrt_inv_bits():
         _EXP_SQRT = PP.exp_digits((PF.P + 1) // 4)
         _EXP_INV = PP.exp_digits(PF.P - 2)
     return _EXP_SQRT, _EXP_INV
+
+
+def _e34_bits():
+    """(p−3)/4 window digits: a^((p-3)/4) gives root = s·a and, for a QR,
+    1/root = root·s² in the same scan (p ≡ 3 mod 4)."""
+    global _EXP_34
+    if _EXP_34 is None:
+        _EXP_34 = PP.exp_digits((PF.P - 3) // 4)
+    return _EXP_34
 
 
 _P_BE = np.frombuffer(PF.P.to_bytes(48, "big"), np.uint8).astype(np.int16)
@@ -328,7 +338,10 @@ def _g1_decompress_jit(Xr, splane, lmask):
     """Raw-limb x plane + sign/loaded masks -> (X, Y, Z, okmask), all in ONE
     compiled dispatch (eager per-op dispatches dominate behind the remote
     TPU tunnel)."""
+    return _g1_decompress_core(Xr, splane, lmask)
 
+
+def _g1_decompress_core(Xr, splane, lmask):
     from ..crypto.curve import B_G1
 
     X = _to_mont_on_device(Xr, 1)
@@ -366,9 +379,15 @@ def _g2_decompress_jit(X0r, X1r, splane, lmask):
     in ONE compiled dispatch. The Fq2 square root follows fields.fq2_sqrt's
     complex method, branchless over the plane: alpha = sqrt(c0² + c1²),
     delta± = (c0 ± alpha)/2, y0 = sqrt(delta), y1 = c1/(2·y0), with the
-    fallback candidate (0, sqrt(−c0)) for c1 == 0; sqrt/inverse are blind
-    square-and-multiply scans by fixed exponents."""
+    fallback candidate (0, sqrt(−c0)) for c1 == 0. sqrt runs as a blind
+    square-and-multiply scan by the fixed exponent (p−3)/4: s = a^((p-3)/4)
+    yields BOTH the root candidate y0 = s·a and, when a is a QR (s²·a = 1),
+    the inverse 1/y0 = y0·s² — so the separate 1/y0 inversion scan of the
+    naive method disappears (two scans per decompression, not three)."""
+    return _g2_decompress_core(X0r, X1r, splane, lmask)
 
+
+def _g2_decompress_core(X0r, X1r, splane, lmask):
     from ..crypto.curve import B_G2
 
     X0 = _to_mont_on_device(X0r, 1)
@@ -381,7 +400,6 @@ def _g2_decompress_jit(X0r, X1r, splane, lmask):
     y2 = PP.fe_add(Xcb, _const_plane(B_G2, 2, S, W), 2)
     c0, c1 = y2[0][None], y2[1][None]
 
-    sqrt_bits, inv_bits = _sqrt_inv_bits()
     norm = PP.fe_add(PP._mul_call(c0, c0, 1), PP._mul_call(c1, c1, 1), 1)
     alpha, _ = _fq_sqrt_device(norm)
     inv2 = _const_plane(((PF.P + 1) // 2,), 1, S, W)
@@ -389,11 +407,17 @@ def _g2_decompress_jit(X0r, X1r, splane, lmask):
     delta_m = PP._mul_call(PP.fe_sub(c0, alpha, 1), inv2, 1)
     neg_c0 = PP.fe_neg(c0, 1)
     packed = jnp.concatenate([delta_p, delta_m, neg_c0], axis=-1)
-    roots = PP._pow_scan(packed, jnp.asarray(sqrt_bits))
+    # ONE (p−3)/4 scan serves all three candidates: root = s·a and, for the
+    # QR that gets selected, 1/root = root·s² (s²·a == 1) — no separate
+    # inversion scan (see _g2_decompress_jit docstring)
+    s34 = PP._pow_scan(packed, jnp.asarray(_e34_bits()))
+    roots = PP._mul_call(s34, packed, 1)
     x0p, x0m, s2c = (roots[..., :W], roots[..., W:2 * W], roots[..., 2 * W:])
+    s_p, s_m = s34[..., :W], s34[..., W:2 * W]
     okp = jnp.all(PP._mul_call(x0p, x0p, 1) == delta_p, axis=(0, 1))
     y0 = jnp.where(okp[None, None], x0p, x0m)
-    y0inv = PP._pow_scan(y0, jnp.asarray(inv_bits))
+    s_sel = jnp.where(okp[None, None], s_p, s_m)
+    y0inv = PP._mul_call(y0, PP._mul_call(s_sel, s_sel, 1), 1)
     y1 = PP._mul_call(PP._mul_call(c1, inv2, 1), y0inv, 1)
 
     # validity: candidate (y0, y1)² == (c0, c1), else fallback (0, s2c)
@@ -514,6 +538,10 @@ def _jac_eq_mask(p: PP.PlanePoint, q: PP.PlanePoint):
 
 @jax.jit
 def _g2_subgroup_jit(X, Y, Z):
+    return _g2_subgroup_core(X, Y, Z)
+
+
+def _g2_subgroup_core(X, Y, Z):
     S, W = X.shape[-2:]
     cx, cy = _psi_consts()
     B = X.shape[-2] * X.shape[-1]
@@ -570,6 +598,10 @@ def _sweep_combine_jit(X, Y, Z, digits_u8, T, Wv):
     """Windowed Lagrange sweep + per-validator combine (pairwise-add of the
     T lane blocks, log₂T rounds) as ONE compiled dispatch. digits_u8:
     (64, 8, W) uint8 window digits (4× leaner transfer than bit planes)."""
+    return _sweep_combine_core(X, Y, Z, digits_u8, T, Wv)
+
+
+def _sweep_combine_core(X, Y, Z, digits_u8, T, Wv):
     pX, pY, pZ = PP._scalar_mul_windowed(
         X, Y, Z, digits_u8.astype(jnp.int32), 2)
     parts = [(pX[..., j * Wv:(j + 1) * Wv], pY[..., j * Wv:(j + 1) * Wv],
@@ -584,21 +616,24 @@ def _sweep_combine_jit(X, Y, Z, digits_u8, T, Wv):
     return parts[0]
 
 
-def _aggregate_plane(batches: list[dict[int, bytes]]):
-    """Common front half of the aggregation paths: combined permuted load +
-    windowed Lagrange sweep + per-validator combine. Returns the aggregate
-    Jacobian plane (RX, RY, RZ) holding V results in a Vp-element plane."""
+def _layout_slots(batches: list[dict[int, bytes]], Vp: int | None = None,
+                  T: int | None = None):
+    """Permuted slot layout for ONE combined load of all T·Vp points (a
+    single device decompression dispatch instead of T): slot j lands on the
+    lane block [j·Wv, (j+1)·Wv) of every sublane — the same layout the
+    per-slot concatenate produced, so the combine slices lanes unchanged.
+
+    Vp/T may be forced (the sharded plane lays out per-device chunks with
+    globally-fixed plane dimensions); by default they derive from batches."""
     V = len(batches)
-    T = max(len(b) for b in batches)
+    if T is None:
+        T = max(len(b) for b in batches)
     if T == 0:
         raise ValueError("empty partial signature set")
-    Vp = _bucket(V)
+    if Vp is None:
+        Vp = _bucket(V)
     zero96 = b"\xc0" + bytes(95)  # compressed infinity
 
-    # ONE combined load for all T·Vp points (a single device decompression
-    # dispatch instead of T), permuted so slot j lands on the lane block
-    # [j·Wv, (j+1)·Wv) of every sublane — the same layout the per-slot
-    # concatenate produced, so the combine below slices lanes unchanged.
     Wv = Vp // PP.SUB
     W4 = (Vp * T) // PP.SUB
     sigs_all = [zero96] * (Vp * T)
@@ -611,6 +646,14 @@ def _aggregate_plane(batches: list[dict[int, bytes]]):
             flat = base + j * Wv
             sigs_all[flat] = bytes(batch[ids[j]])
             scalars_all[flat] = lam[j]
+    return sigs_all, scalars_all, V, Vp, T, Wv
+
+
+def _aggregate_plane(batches: list[dict[int, bytes]], layout=None):
+    """Common front half of the aggregation paths: combined permuted load +
+    windowed Lagrange sweep + per-validator combine. Returns the aggregate
+    Jacobian plane (RX, RY, RZ) holding V results in a Vp-element plane."""
+    sigs_all, scalars_all, V, Vp, T, Wv = layout or _layout_slots(batches)
     plane = g2_plane_from_compressed(sigs_all, Vp * T)
     digits = PP.scalars_to_digitplanes(scalars_all, Vp * T)
     RX, RY, RZ = _sweep_combine_jit(
@@ -652,23 +695,55 @@ def threshold_aggregate_and_verify(batches: list[dict[int, bytes]],
     trip, and no per-aggregate subgroup check (aggregates of in-subgroup
     partials stay in the subgroup; partials are subgroup-checked on receipt
     by parsigex/validatorapi, matching the reference's trust boundary).
-    Returns (compressed aggregates, all_valid)."""
+
+    On a device this is ONE jitted dispatch + ONE blocking transfer
+    (_fused_slot_jit); each extra sync through the remote TPU tunnel costs
+    ~0.1s, which used to dominate the slot. Returns (compressed
+    aggregates, all_valid)."""
     if not batches:
         return [], True
     if not (len(batches) == len(pks) == len(msgs)):
         raise ValueError("length mismatch")
-    RX, RY, RZ, V, Vp = _aggregate_plane(batches)
-    sig_plane = PP.PlanePoint(RX, RY, RZ, 2, Vp)
+    layout = _layout_slots(batches)
+    sigs_all, scalars_all, V, Vp, T, Wv = layout
+    if not _device_path(len(sigs_all)):
+        RX, RY, RZ, V, Vp = _aggregate_plane(batches, layout)
+        sig_plane = PP.PlanePoint(RX, RY, RZ, 2, Vp)
+        try:
+            pk_plane = _pk_plane_cached(pks, Vp)
+        except ValueError:
+            return _serialize_aggregates(RX, RY, RZ, V), False
+        # dispatch the MSM device work FIRST, serialize while it runs —
+        # the serialization's host loop overlaps the queued dispatches
+        state = _rlc_dispatch(sig_plane, pk_plane, msgs)
+        out = _serialize_aggregates(RX, RY, RZ, V)
+        return out, _rlc_finish(state, hash_fn)
+
+    body, _fin, sgn, loaded = _parse_compressed(
+        sigs_all, 96, "G2", False, Vp * T)
+    X0r = jnp.asarray(_raw_to_plane(body[:, 48:], Vp * T))
+    X1r = jnp.asarray(_raw_to_plane(body[:, :48], Vp * T))
+    ldigits = jnp.asarray(PP.scalars_to_digitplanes(scalars_all, Vp * T))
     try:
-        pk_plane = _pk_plane_cached(pks, Vp)
+        pk_plane = _pk_plane_cached(pks, Vp)  # device; sync on miss only
     except ValueError:
-        return _serialize_aggregates(RX, RY, RZ, V), False
-    # dispatch the MSM device work FIRST, serialize while it runs, then
-    # finish (host fold + pairing) — the serialization's host loop overlaps
-    # the queued device dispatches
-    state = _rlc_dispatch(sig_plane, pk_plane, msgs)
-    out = _serialize_aggregates(RX, RY, RZ, V)
-    return out, _rlc_finish(state, hash_fn)
+        aggs = threshold_aggregate_batch(batches)
+        return aggs, False
+    rs = [sample_randomizer() for _ in range(V)]
+    rdig = jnp.asarray(PP.scalars_to_digitplanes(rs, Vp, nbits=RLC_BITS))
+    group_msgs, gmask = _group_masks(msgs, V, Vp)
+    outs = _fused_slot_jit(
+        X0r, X1r, jnp.asarray(sgn), jnp.asarray(loaded), ldigits, rdig,
+        pk_plane.X, pk_plane.Y, pk_plane.Z, jnp.asarray(gmask),
+        T=T, Wv=Wv, G=len(group_msgs))
+    ok, xs, sign, inf, sig_red, pk_reds = jax.device_get(outs)
+    if not ok.all():
+        _raise_bad(ok, "G2")
+    out = _g2_emit_bytes(xs, sign.reshape(-1), inf.reshape(-1), V)
+    S = PP._host_fold(*sig_red, 2)
+    pts = [(m, _unembed_g1(PP._host_fold(*pk_reds[g], 2)))
+           for g, m in enumerate(group_msgs)]
+    return out, _pairing_finish(S, pts, hash_fn)
 
 
 @jax.jit
@@ -677,6 +752,10 @@ def _g2_affine_std_jit(X, Y, Z):
     and infinity masks, ONE compiled dispatch. The field inversion is a
     batched fixed-exponent power scan (Fq2 inverse via conj/norm), so no
     host bigint inversions remain on the aggregate output path."""
+    return _g2_affine_std_core(X, Y, Z)
+
+
+def _g2_affine_std_core(X, Y, Z):
     z0, z1 = Z[0][None], Z[1][None]
     norm = PP.fe_add(PP._mul_call(z0, z0, 1), PP._mul_call(z1, z1, 1), 1)
     _, inv_bits = _sqrt_inv_bits()
@@ -823,14 +902,41 @@ def rlc_verify_batch(pks: list[bytes], msgs: list[bytes], sigs: list[bytes],
         raise ValueError("length mismatch")
     Bp = _bucket(n)
 
+    if not _device_path(n):
+        try:
+            sig_plane = g2_plane_from_compressed(sigs, Bp,
+                                                 reject_infinity=True)
+            pk_plane = _pk_plane_cached(pks, Bp)
+        except ValueError:
+            return False
+        if not g2_subgroup_ok(sig_plane):
+            return False
+        return _rlc_check(sig_plane, pk_plane, msgs, hash_fn)
+
+    # device: decompression + subgroup + combined MSMs as ONE dispatch and
+    # one transfer (_verify_slot_jit)
     try:
-        sig_plane = g2_plane_from_compressed(sigs, Bp, reject_infinity=True)
+        body, _fin, sgn, loaded = _parse_compressed(
+            sigs, 96, "G2", True, Bp)
         pk_plane = _pk_plane_cached(pks, Bp)
     except ValueError:
         return False
-    if not g2_subgroup_ok(sig_plane):
+    X0r = jnp.asarray(_raw_to_plane(body[:, 48:], Bp))
+    X1r = jnp.asarray(_raw_to_plane(body[:, :48], Bp))
+    rs = [sample_randomizer() for _ in range(n)]
+    rdig = jnp.asarray(PP.scalars_to_digitplanes(rs, Bp, nbits=RLC_BITS))
+    group_msgs, gmask = _group_masks(msgs, n, Bp)
+    outs = _verify_slot_jit(
+        X0r, X1r, jnp.asarray(sgn), jnp.asarray(loaded), rdig,
+        pk_plane.X, pk_plane.Y, pk_plane.Z, jnp.asarray(gmask),
+        G=len(group_msgs))
+    ok, sub_ok, sig_red, pk_reds = jax.device_get(outs)
+    if not (ok.all() and sub_ok):
         return False
-    return _rlc_check(sig_plane, pk_plane, msgs, hash_fn)
+    S = PP._host_fold(*sig_red, 2)
+    pts = [(m, _unembed_g1(PP._host_fold(*pk_reds[g], 2)))
+           for g, m in enumerate(group_msgs)]
+    return _pairing_finish(S, pts, hash_fn)
 
 
 def _rlc_dispatch(sig_plane: PP.PlanePoint, pk_plane: PP.PlanePoint,
@@ -874,13 +980,104 @@ def _rlc_dispatch(sig_plane: PP.PlanePoint, pk_plane: PP.PlanePoint,
     return sig_red, pk_reds
 
 
+def _combined_msm(SIGX, SIGY, SIGZ, pkX, pkY, pkZ, rdig, gmask, G):
+    """Sig-G2 MSM and pk-G1 MSM through ONE windowed sweep: the G1 plane is
+    embedded into Fq2 with zero c1 (the Jacobian add/double formulas are
+    curve- and field-extension-agnostic, and (a,0)x(b,0)=(ab,0), so the
+    embedded lanes compute exact G1 arithmetic) and concatenated onto the
+    lane axis. Narrow MSMs are launch-latency-bound, so halving the number
+    of kernel launches ~halves the MSM wall time. Returns the reduced sig
+    plane and G per-group reduced (embedded) pk planes."""
+    W = SIGX.shape[-1]
+    pk2 = [jnp.concatenate([c, c * 0], axis=0) for c in (pkX, pkY, pkZ)]
+    CX = jnp.concatenate([SIGX, pk2[0]], axis=-1)
+    CY = jnp.concatenate([SIGY, pk2[1]], axis=-1)
+    CZ = jnp.concatenate([SIGZ, pk2[2]], axis=-1)
+    cdig = jnp.concatenate([rdig, rdig], axis=-1).astype(jnp.int32)
+    mX, mY, mZ = PP._scalar_mul_windowed(CX, CY, CZ, cdig, 2)
+    sig_red = PP._reduce_tree_jit(mX[..., :W], mY[..., :W], mZ[..., :W], 2)
+    pmX, pmY, pmZ = mX[..., W:], mY[..., W:], mZ[..., W:]
+    pk_reds = []
+    for g in range(G):
+        sel = gmask[g][None, None]
+        pk_reds.append(PP._reduce_tree_jit(
+            jnp.where(sel, pmX, 0), jnp.where(sel, pmY, 0),
+            jnp.where(sel, pmZ, 0), 2))
+    return sig_red, pk_reds
+
+
+@functools.partial(jax.jit, static_argnames=("T", "Wv", "G"))
+def _fused_slot_jit(X0r, X1r, sgn, lmask, ldigits, rdig, pkX, pkY, pkZ,
+                    gmask, *, T, Wv, G):
+    """The WHOLE fused sigagg slot as one dispatch: G2 decompression ->
+    windowed Lagrange sweep + combine -> affine serialization front-half,
+    plus the combined sig+pk RLC MSMs — so the host pays ONE dispatch and
+    ONE blocking transfer per slot instead of four or five (each sync
+    through the remote TPU tunnel costs ~0.1s, which dominated the fused
+    path before this: BASELINE.md round-3 stage profile)."""
+    X, Y, Z, ok = _g2_decompress_core(X0r, X1r, sgn, lmask)
+    RX, RY, RZ = _sweep_combine_core(X, Y, Z, ldigits, T, Wv)
+    xs, sign, inf = _g2_affine_std_core(RX, RY, RZ)
+    sig_red, pk_reds = _combined_msm(RX, RY, RZ, pkX, pkY, pkZ,
+                                     rdig, gmask, G)
+    return ok, xs, sign, inf, sig_red, pk_reds
+
+
+@functools.partial(jax.jit, static_argnames=("G",))
+def _verify_slot_jit(X0r, X1r, sgn, lmask, rdig, pkX, pkY, pkZ, gmask, *, G):
+    """rlc_verify_batch as one dispatch: G2 decompression + batched
+    endomorphism subgroup check + combined sig+pk MSMs, one transfer."""
+    X, Y, Z, ok = _g2_decompress_core(X0r, X1r, sgn, lmask)
+    sub_ok = _g2_subgroup_core(X, Y, Z)
+    sig_red, pk_reds = _combined_msm(X, Y, Z, pkX, pkY, pkZ, rdig, gmask, G)
+    return ok, sub_ok, sig_red, pk_reds
+
+
+def _group_masks(msgs, n: int, Bp: int):
+    """Distinct-message groups + (G, 8, W) lane masks (padding lanes are in
+    no group). G is padded up to a power of two with EMPTY groups so the
+    jitted slot graphs specialize on O(log) distinct G values instead of
+    recompiling per slot (a tunnel compile costs minutes; an all-false mask
+    yields an infinity pk sum, which the pairing finish soundly skips —
+    the same rule that handles degenerate real groups)."""
+    groups: dict[bytes, list[int]] = {}
+    for i, m in enumerate(msgs):
+        groups.setdefault(bytes(m), []).append(i)
+    G = 1
+    while G < len(groups):
+        G *= 2
+    W = Bp // PP.SUB
+    gmask = np.zeros((G, PP.SUB, W), bool)
+    for g, idxs in enumerate(groups.values()):
+        for i in idxs:
+            gmask[g, i // W, i % W] = True
+    keys = list(groups.keys()) + [b""] * (G - len(groups))
+    return keys, gmask
+
+
+def _unembed_g1(jac2):
+    """Fq2-embedded G1 Jacobian (host ints) -> G1 Jacobian; the c1
+    components of an embedded-lane computation are identically zero."""
+    (x0, x1), (y0, y1), (z0, z1) = jac2
+    assert x1 == 0 and y1 == 0 and z1 == 0, "embedded G1 left the base field"
+    return (x0, y0, z0)
+
+
 def _rlc_finish(state, hash_fn=None) -> bool:
     """Await the dispatched MSMs (host fold) and run the multi-pairing."""
     sig_red, pk_reds = state
     S = PP._host_fold(*sig_red, 2)
-    g1_pts, g2_pts, negs = [], [], []
+    pts = []
     for m, red in pk_reds:
-        P = PP._host_fold(*red, 1)
+        pts.append((m, PP._host_fold(*red, 1)))
+    return _pairing_finish(S, pts, hash_fn)
+
+
+def _pairing_finish(S, group_points, hash_fn=None) -> bool:
+    """Multi-pairing over host Jacobians: S = Σ rᵢ·sigᵢ (G2) and per
+    distinct message m its P_m = Σ rᵢ·pkᵢ (G1)."""
+    g1_pts, g2_pts, negs = [], [], []
+    for m, P in group_points:
         if jac_is_infinity(FqOps, P):
             # degenerate pk combination: only consistent with S lacking any
             # contribution from this group — the pairing check below still
