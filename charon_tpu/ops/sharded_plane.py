@@ -143,26 +143,21 @@ def threshold_aggregate_and_verify_sharded(
                 RX[None], RY[None], RZ[None], pX[None], pY[None], pZ[None])
 
     def _local_msm(RX, RY, RZ, pX, pY, pZ, rdig, gmask):
-        # RLC sig MSM over the local aggregate plane
-        sX, sY, sZ = PP._msm_reduce_jit(RX[0], RY[0], RZ[0], rdig[0], 2)
-        gsX = jax.lax.all_gather(sX, "data")
-        gsY = jax.lax.all_gather(sY, "data")
-        gsZ = jax.lax.all_gather(sZ, "data")
-        SX, SY, SZ = _fold_gathered(gsX, gsY, gsZ, 2)
-
-        # RLC pk MSM: windowed mul once, per-group masked reduce
-        mX, mY, mZ = PP._scalar_mul_windowed(
-            pX[0], pY[0], pZ[0], rdig[0].astype(jnp.int32), 1)
+        # sig-G2 + pk-G1 MSMs through ONE windowed sweep (the same Fq2
+        # embedding the single-chip _combined_msm uses), then the RLC
+        # combine across chips: all_gather + unified-EC-add fold per sum
+        sig_red, pk_local = PA._combined_msm(
+            RX[0], RY[0], RZ[0], pX[0], pY[0], pZ[0], rdig[0], gmask[0], G)
+        SX, SY, SZ = _fold_gathered(
+            jax.lax.all_gather(sig_red[0], "data"),
+            jax.lax.all_gather(sig_red[1], "data"),
+            jax.lax.all_gather(sig_red[2], "data"), 2)
         pk_sums = []
         for g in range(G):
-            sel = gmask[0, g][None, None]
-            rX, rY, rZ = PP._reduce_tree_jit(
-                jnp.where(sel, mX, 0), jnp.where(sel, mY, 0),
-                jnp.where(sel, mZ, 0), 1)
-            gX = jax.lax.all_gather(rX, "data")
-            gY = jax.lax.all_gather(rY, "data")
-            gZ = jax.lax.all_gather(rZ, "data")
-            pk_sums.append(_fold_gathered(gX, gY, gZ, 1))
+            pk_sums.append(_fold_gathered(
+                jax.lax.all_gather(pk_local[g][0], "data"),
+                jax.lax.all_gather(pk_local[g][1], "data"),
+                jax.lax.all_gather(pk_local[g][2], "data"), 2))
         PX = jnp.stack([s[0] for s in pk_sums])
         PY = jnp.stack([s[1] for s in pk_sums])
         PZ = jnp.stack([s[2] for s in pk_sums])
@@ -205,7 +200,7 @@ def threshold_aggregate_and_verify_sharded(
                 n_local))
 
     # ---- host: fold the replicated RLC sums + multi-pairing --------------
-    pk_reds = [(m, (PX[g], PY[g], PZ[g]))
-               for g, m in enumerate(group_keys)]
-    ok_rlc = PA._rlc_finish(((SX, SY, SZ), pk_reds), hash_fn)
-    return out, ok_rlc
+    S = PP._host_fold(SX, SY, SZ, 2)
+    pts = [(m, PA._unembed_g1(PP._host_fold(PX[g], PY[g], PZ[g], 2)))
+           for g, m in enumerate(group_keys)]
+    return out, PA._pairing_finish(S, pts, hash_fn)
