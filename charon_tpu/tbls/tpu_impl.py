@@ -47,9 +47,14 @@ class TPUImpl(NativeImpl):
     name = "jax-tpu"
 
     # Below this many items the fixed device-call floor loses to the native
-    # per-item path; tuned on v5e (native: ~3.4ms/aggregate, ~5.5ms/verify;
-    # device fused call: ~1.1s floor — see bench_scale.py sigagg100).
-    min_device_batch = 192
+    # per-item path; tuned on v5e with the round-3 single-dispatch path
+    # (bench_scale.py: fused aggregate+verify floor ~0.36s vs native
+    # ~9.3ms/validator -> breakeven ~40; bulk verify floor ~0.20s vs native
+    # ~1.9ms/sig -> breakeven ~107; both with safety margin for tunnel
+    # jitter). The coalescer (core/coalesce.py) batches sub-threshold
+    # duties up to these sizes.
+    min_device_batch = 64     # threshold_aggregate paths
+    min_device_verify = 128   # verify_batch
 
     def threshold_aggregate_batch(self, batches: list[dict[int, Signature]]
                                   ) -> list[Signature]:
@@ -69,7 +74,7 @@ class TPUImpl(NativeImpl):
         if not (len(public_keys) == len(datas) == len(signatures)):
             raise ValueError("length mismatch")
         n = len(public_keys)
-        if n < self.min_device_batch or not _on_device():
+        if n < self.min_device_verify or not _on_device():
             return NativeImpl.verify_batch(self, public_keys, datas,
                                            signatures)
         # Curve membership + infinity rejection run in rlc_verify_batch's

@@ -37,7 +37,7 @@ def test_device_branch_dispatch(monkeypatch):
     from charon_tpu.tbls import tpu_impl as tpu_mod
 
     impl = TPUImpl()
-    impl.min_device_batch = 2
+    impl.min_device_verify = 2
     monkeypatch.setattr(tpu_mod, "_on_device", lambda: True)
 
     calls = {}
@@ -57,7 +57,7 @@ def test_device_branch_dispatch(monkeypatch):
 
     # below the threshold the native path runs instead (no stub call)
     calls.clear()
-    impl.min_device_batch = 64
+    impl.min_device_verify = 64
     assert impl.verify_batch(pks, [msg] * 3, sigs)
     assert not calls
 

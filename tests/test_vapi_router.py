@@ -160,6 +160,73 @@ class TestProxy:
         _run(run())
 
 
+class TestProxyEdges:
+    def test_dead_upstream_maps_to_502(self):
+        """An unreachable BN must surface as a beacon-API 502 error body,
+        not a hang or a raw exception (reference router.go proxy error)."""
+
+        async def run():
+            sim = new_simnet(num_validators=1, threshold=2, num_nodes=3,
+                             use_vmock=False, genesis_delay=30.0)
+            router = VapiRouter(sim.nodes[0].vapi,
+                                bn_base_url="http://127.0.0.1:1")  # nothing
+            await router.start()
+            client = HTTPValidatorClient(router.base_url)
+            try:
+                with pytest.raises(VapiHTTPError) as exc_info:
+                    await client.raw("GET", "/eth/v1/node/syncing")
+                assert exc_info.value.status == 502
+                assert "unreachable" in str(exc_info.value)
+            finally:
+                await client.close()
+                await router.stop()
+
+        _run(run())
+
+    def test_post_passthrough_preserves_body_and_status(self):
+        """POST bodies and non-200 upstream statuses pass through verbatim
+        (the VC must see exactly what the BN said)."""
+
+        async def run():
+            seen = {}
+
+            async def subscribe(request):
+                seen["body"] = await request.json()
+                return web.json_response({"failures": []}, status=503)
+
+            upstream = web.Application()
+            upstream.router.add_post(
+                "/eth/v1/validator/beacon_committee_subscriptions", subscribe)
+            runner = web.AppRunner(upstream)
+            await runner.setup()
+            site = web.TCPSite(runner, "127.0.0.1", 0)
+            await site.start()
+            bn_port = site._server.sockets[0].getsockname()[1]
+
+            sim = new_simnet(num_validators=1, threshold=2, num_nodes=3,
+                             use_vmock=False, genesis_delay=30.0)
+            router = VapiRouter(sim.nodes[0].vapi,
+                                bn_base_url=f"http://127.0.0.1:{bn_port}")
+            await router.start()
+            client = HTTPValidatorClient(router.base_url)
+            try:
+                payload = [{"validator_index": "3", "committee_index": "1",
+                            "slot": "9", "is_aggregator": True}]
+                with pytest.raises(VapiHTTPError) as exc_info:
+                    await client.raw(
+                        "POST",
+                        "/eth/v1/validator/beacon_committee_subscriptions",
+                        json_body=payload)
+                assert exc_info.value.status == 503
+                assert seen["body"] == payload  # body reached the BN intact
+            finally:
+                await client.close()
+                await router.stop()
+                await runner.cleanup()
+
+        _run(run())
+
+
 class TestErrorMapping:
     def test_bad_request_is_beacon_api_error(self):
         async def run():
@@ -174,6 +241,34 @@ class TestErrorMapping:
                     await client.raw("POST", "/eth/v1/beacon/pool/attestations",
                                      json_body=[{"nonsense": True}])
                 assert exc_info.value.status in (400, 500)
+            finally:
+                await client.close()
+                await router.stop()
+
+        _run(run())
+
+    def test_missing_query_params_are_400(self):
+        """Spec'd required query params: their absence is a 400 beacon-API
+        error (middleware maps KeyError/ValueError), never a 500."""
+
+        async def run():
+            sim = new_simnet(num_validators=1, threshold=2, num_nodes=3,
+                             use_vmock=False, genesis_delay=30.0)
+            router = VapiRouter(sim.nodes[0].vapi)
+            await router.start()
+            client = HTTPValidatorClient(router.base_url)
+            try:
+                for method, path, body in (
+                        ("GET", "/eth/v1/validator/attestation_data", None),
+                        ("GET", "/eth/v1/validator/aggregate_attestation",
+                         None),
+                        ("GET", "/eth/v2/validator/blocks/notanint", None),
+                        ("POST", "/eth/v1/validator/duties/attester/0",
+                         [{"bad": "entry"}]),
+                ):
+                    with pytest.raises(VapiHTTPError) as exc_info:
+                        await client.raw(method, path, json_body=body)
+                    assert exc_info.value.status == 400, path
             finally:
                 await client.close()
                 await router.stop()
