@@ -719,6 +719,16 @@ def threshold_aggregate_and_verify(batches: list[dict[int, bytes]],
         out = _serialize_aggregates(RX, RY, RZ, V)
         return out, _rlc_finish(state, hash_fn)
 
+    state = _fused_dispatch(layout, pks, msgs)
+    return _fused_finish(state, hash_fn)
+
+
+def _fused_dispatch(layout, pks, msgs):
+    """Host parse + async device dispatch of one fused slot; returns the
+    pending state for _fused_finish. Callers overlap the NEXT slot's host
+    parse with this slot's device execution (the jax dispatch is async —
+    nothing blocks until _fused_finish's device_get)."""
+    sigs_all, scalars_all, V, Vp, T, Wv = layout
     body, _fin, sgn, loaded = _parse_compressed(
         sigs_all, 96, "G2", False, Vp * T)
     X0r = jnp.asarray(_raw_to_plane(body[:, 48:], Vp * T))
@@ -727,8 +737,7 @@ def threshold_aggregate_and_verify(batches: list[dict[int, bytes]],
     try:
         pk_plane = _pk_plane_cached(pks, Vp)  # device; sync on miss only
     except ValueError:
-        aggs = threshold_aggregate_batch(batches)
-        return aggs, False
+        return ("bad_pk", layout)
     rs = [sample_randomizer() for _ in range(V)]
     rdig = jnp.asarray(PP.scalars_to_digitplanes(rs, Vp, nbits=RLC_BITS))
     group_msgs, gmask = _group_masks(msgs, V, Vp)
@@ -736,6 +745,18 @@ def threshold_aggregate_and_verify(batches: list[dict[int, bytes]],
         X0r, X1r, jnp.asarray(sgn), jnp.asarray(loaded), ldigits, rdig,
         pk_plane.X, pk_plane.Y, pk_plane.Z, jnp.asarray(gmask),
         T=T, Wv=Wv, G=len(group_msgs))
+    return ("pending", V, group_msgs, outs)
+
+
+def _fused_finish(state, hash_fn=None):
+    """Block on the slot's single device transfer, emit the aggregate
+    bytes, fold the RLC sums and run the multi-pairing."""
+    if state[0] == "bad_pk":
+        _tag, layout = state
+        sigs_all, scalars_all, V, Vp, T, Wv = layout
+        RX, RY, RZ, V, Vp = _aggregate_plane(None, layout)
+        return _serialize_aggregates(RX, RY, RZ, V), False
+    _tag, V, group_msgs, outs = state
     ok, xs, sign, inf, sig_red, pk_reds = jax.device_get(outs)
     if not ok.all():
         _raise_bad(ok, "G2")
