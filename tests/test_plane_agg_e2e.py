@@ -123,6 +123,15 @@ def run_pipeline_drive() -> None:
     else:
         raise AssertionError("off-curve partial did not raise")
 
+    # invalid pubkey set: the fused path must fall back to aggregate-only
+    # and report not-verified (infinity pubkeys are rejected on load)
+    bad_pks = list(pks)
+    bad_pks[0] = b"\xc0" + bytes(47)
+    aggs3, ok3 = plane_agg.threshold_aggregate_and_verify(
+        batches, bad_pks, msgs)
+    assert ok3 is False
+    assert aggs3 == oracle  # aggregates still produced, bit-identical
+
     # rlc_verify_batch over the device decoders + subgroup checks
     assert plane_agg.rlc_verify_batch(pks, msgs, oracle) is True
     swapped = [oracle[1], oracle[0]] + oracle[2:]
