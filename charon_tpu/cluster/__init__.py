@@ -81,27 +81,8 @@ def create_cluster(name: str, num_validators: int, num_nodes: int, threshold: in
     for i, key in enumerate(identity_keys):
         definition = definition.sign_operator(i, key)
 
-    validators: list[DistValidator] = []
-    node_share_secrets: list[list[tbls.PrivateKey]] = [[] for _ in range(num_nodes)]
-    for _ in range(num_validators):
-        root_secret = tbls.generate_secret_key()
-        root_pub = tbls.secret_to_public_key(root_secret)
-        shares = tbls.threshold_split(root_secret, num_nodes, threshold)
-        for i in range(num_nodes):
-            node_share_secrets[i].append(shares[i + 1])
-        msg = deposit_mod.new_message(root_pub, withdrawal_addr20)
-        dep_sig = tbls.sign(tbls.PrivateKey(root_secret),
-                            deposit_mod.signing_root(msg, fork_version))
-        dep_data = deposit_mod.DepositData(bytes(root_pub),
-                                           msg.withdrawal_credentials,
-                                           msg.amount, bytes(dep_sig))
-        validators.append(DistValidator(
-            public_key=bytes(root_pub),
-            public_shares=[bytes(tbls.secret_to_public_key(shares[i + 1]))
-                           for i in range(num_nodes)],
-            deposit_data_root=deposit_mod.data_root(dep_data),
-            deposit_signature=bytes(dep_sig),
-        ))
+    validators, node_share_secrets = _deal_validators(
+        num_validators, num_nodes, threshold, withdrawal_addr20, fork_version)
 
     lock = Lock(definition=definition, validators=validators)
     h = lock.lock_hash()
@@ -122,6 +103,45 @@ def create_cluster(name: str, num_validators: int, num_nodes: int, threshold: in
         save_lock(lock, str(node_dir / "cluster-lock.json"))
         keystore.store_keys(node_share_secrets[i], node_dir / "validator_keys",
                             insecure=insecure_keys)
+    _write_deposit_file(out_dir / "deposit-data.json", validators,
+                        withdrawal_addr20, fork_version)
+    return lock
+
+
+def _deal_validators(num_validators: int, num_nodes: int, threshold: int,
+                     withdrawal_addr20: bytes, fork_version: bytes):
+    """Trusted-dealer generation of distributed validators: root secret →
+    t-of-n split + threshold-signed deposit data. Returns (validators,
+    node_share_secrets) with node_share_secrets[i] holding OPERATOR i's
+    share (share index i+1) per validator. Shared by create_cluster and
+    add_validators_solo."""
+    validators: list[DistValidator] = []
+    node_share_secrets: list[list[tbls.PrivateKey]] = [
+        [] for _ in range(num_nodes)]
+    for _ in range(num_validators):
+        root_secret = tbls.generate_secret_key()
+        root_pub = tbls.secret_to_public_key(root_secret)
+        shares = tbls.threshold_split(root_secret, num_nodes, threshold)
+        for i in range(num_nodes):
+            node_share_secrets[i].append(shares[i + 1])
+        msg = deposit_mod.new_message(root_pub, withdrawal_addr20)
+        dep_sig = tbls.sign(tbls.PrivateKey(root_secret),
+                            deposit_mod.signing_root(msg, fork_version))
+        dep_data = deposit_mod.DepositData(bytes(root_pub),
+                                           msg.withdrawal_credentials,
+                                           msg.amount, bytes(dep_sig))
+        validators.append(DistValidator(
+            public_key=bytes(root_pub),
+            public_shares=[bytes(tbls.secret_to_public_key(shares[i + 1]))
+                           for i in range(num_nodes)],
+            deposit_data_root=deposit_mod.data_root(dep_data),
+            deposit_signature=bytes(dep_sig),
+        ))
+    return validators, node_share_secrets
+
+
+def _write_deposit_file(path: Path, validators: list[DistValidator],
+                        withdrawal_addr20: bytes, fork_version: bytes) -> None:
     deposits = [{
         "pubkey": v.public_key.hex(),
         "withdrawal_credentials": deposit_mod.withdrawal_credentials_from_address(
@@ -131,8 +151,78 @@ def create_cluster(name: str, num_validators: int, num_nodes: int, threshold: in
         "deposit_data_root": v.deposit_data_root.hex(),
         "fork_version": fork_version.hex(),
     } for v in validators]
-    (out_dir / "deposit-data.json").write_text(json.dumps(deposits, indent=2))
-    return lock
+    Path(path).write_text(json.dumps(deposits, indent=2))
+
+
+def add_validators_solo(cluster_dir: str | Path, num_validators: int, *,
+                        withdrawal_addr20: bytes = b"\x11" * 20,
+                        insecure_keys: bool = True) -> list[DistValidator]:
+    """The `charon alpha add-validators-solo` flow (reference
+    cmd/addvalidators.go): for a SOLO cluster — one operator holding every
+    node directory under `cluster_dir` — generate new distributed
+    validators centrally (trusted dealer, like create_cluster), append an
+    add_validators manifest mutation approved by every node identity key,
+    and write the updated cluster-manifest.json plus the new key shares to
+    each node's validator_keys/ (keystore numbering continues past the
+    existing stores, the order load_node expects)."""
+    cluster_dir = Path(cluster_dir)
+    node_dirs = sorted(d for d in cluster_dir.glob("node*") if d.is_dir())
+    if not node_dirs:
+        raise errors.new("no node directories found", dir=str(cluster_dir))
+    identity_keys = []
+    for nd in node_dirs:
+        key_path = nd / "charon-enr-private-key"
+        if not key_path.exists():
+            raise errors.new("missing identity key", dir=str(nd))
+        identity_keys.append(bytes.fromhex(key_path.read_text().strip()))
+
+    cluster = manifest.load_cluster(node_dirs[0])
+    lock = cluster.lock
+    num_nodes = len(lock.definition.operators)
+    if num_nodes != len(node_dirs):
+        raise errors.new("node dirs != cluster operators (not a solo "
+                         "cluster directory?)", dirs=len(node_dirs),
+                         operators=num_nodes)
+    # map each node dir to ITS operator index via the identity pubkey —
+    # directory sort order is lexicographic (node10 < node2) and must not
+    # decide share indices
+    op_index = {enr_mod.parse(op.enr).pubkey: i
+                for i, op in enumerate(lock.definition.operators)}
+    node_ops: list[int] = []
+    for nd, key in zip(node_dirs, identity_keys):
+        idx = op_index.get(k1util.public_key(key))
+        if idx is None:
+            raise errors.new("identity keys do not match cluster operators",
+                             dir=str(nd))
+        node_ops.append(idx)
+    if len(set(node_ops)) != num_nodes:
+        raise errors.new("identity keys do not match cluster operators")
+    threshold = lock.definition.threshold
+    fork_version = lock.definition.fork_version
+
+    new_validators, node_share_secrets = _deal_validators(
+        num_validators, num_nodes, threshold, withdrawal_addr20, fork_version)
+
+    log_path = node_dirs[0] / "cluster-manifest.json"
+    log = (manifest.load(log_path) if log_path.exists()
+           else manifest.new_log_from_lock(lock))
+    log = manifest.add_validators(log, new_validators, identity_keys)
+    manifest.materialise(log)  # verify chain + approvals before writing
+
+    # keystores FIRST, manifests LAST: the manifest is the source of truth,
+    # and load_node tolerates trailing orphan keystores — so a crash
+    # mid-write leaves every node loadable, and re-running the command
+    # overwrites the orphans at the same offsets (fresh secrets; the
+    # partial batch was never committed to a manifest anywhere)
+    existing = len(cluster.validators)
+    for nd, op in zip(node_dirs, node_ops):
+        keystore.store_keys(node_share_secrets[op], nd / "validator_keys",
+                            insecure=insecure_keys, offset=existing)
+    for nd in node_dirs:
+        manifest.save(log, nd / "cluster-manifest.json")
+    _write_deposit_file(cluster_dir / f"deposit-data-added-{existing}.json",
+                        new_validators, withdrawal_addr20, fork_version)
+    return new_validators
 
 
 def load_node(node_dir: str | Path) -> tuple[bytes, Lock, KeyShares]:
@@ -158,9 +248,21 @@ def load_node(node_dir: str | Path) -> tuple[bytes, Lock, KeyShares]:
     # all validators: lock genesis set + manifest-added ones; keystores are
     # stored in the same order (lock validators first, then additions)
     validators = cluster.validators
-    if len(secrets) != len(validators):
-        raise errors.new("keystore count != cluster validator count",
+    if len(secrets) < len(validators):
+        raise errors.new("keystore count < cluster validator count",
                          keystores=len(secrets), validators=len(validators))
+    if len(secrets) > len(validators):
+        # trailing orphans from an interrupted add-validators run: the
+        # manifest is the source of truth; the orphan shares were never
+        # committed to any manifest, so they are ignored (re-running the
+        # add command overwrites them at the same offsets)
+        _log_orphans = len(secrets) - len(validators)
+        from ..utils import log as log_mod
+
+        log_mod.with_topic("cluster").warn(
+            "ignoring orphan keystores beyond cluster validator count",
+            orphans=_log_orphans)
+        secrets = secrets[:len(validators)]
     keys = keyshares_from_validators(validators, lock.definition.threshold,
                                      node_index, secrets)
     return identity, lock, keys

@@ -186,6 +186,21 @@ def build_parser() -> argparse.ArgumentParser:
     view_p.add_argument("--data-dir", dest="data_dir", default=None,
                         help="node data directory (default .charon)")
 
+    alpha_p = sub.add_parser(
+        "alpha", help="alpha-maturity commands (reference cmd/cmd.go:55)")
+    alpha_sub = alpha_p.add_subparsers(dest="alpha_command", required=True)
+    avs_p = alpha_sub.add_parser(
+        "add-validators-solo",
+        help="append validators to a solo cluster (all node dirs local)")
+    avs_p.add_argument("--cluster-dir", dest="cluster_dir", default=".",
+                       help="directory containing the node*/ data dirs")
+    avs_p.add_argument("--num-validators", dest="num_validators", type=int,
+                       required=True)
+    avs_p.add_argument("--withdrawal-address", dest="withdrawal_address",
+                       default="0x" + "11" * 20)
+    avs_p.add_argument("--insecure-keys", dest="insecure_keys",
+                       action="store_true", default=False)
+
     sub.add_parser("version", help="print version")
     return p
 
@@ -217,7 +232,25 @@ def _dispatch(args: argparse.Namespace) -> int:
         return _cmd_combine(args)
     if args.command == "view-cluster-manifest":
         return _cmd_view_manifest(args)
+    if args.command == "alpha":
+        return _cmd_alpha(args)
     raise AssertionError(f"unhandled command {args.command}")
+
+
+def _cmd_alpha(args: argparse.Namespace) -> int:
+    if args.alpha_command == "add-validators-solo":
+        from .. import cluster as cluster_mod
+
+        addr = args.withdrawal_address
+        added = cluster_mod.add_validators_solo(
+            args.cluster_dir, args.num_validators,
+            withdrawal_addr20=bytes.fromhex(addr[2:] if addr.startswith("0x")
+                                            else addr),
+            insecure_keys=args.insecure_keys)
+        for v in added:
+            print("added validator 0x" + v.public_key.hex())
+        return 0
+    raise AssertionError(f"unhandled alpha command {args.alpha_command}")
 
 
 def _split_addr(addr: str, default_port: int) -> tuple[str, int]:

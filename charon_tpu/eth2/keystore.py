@@ -81,12 +81,14 @@ def decrypt(store: dict, password: str) -> tbls.PrivateKey:
 
 
 def store_keys(secrets: list[tbls.PrivateKey], directory: str | Path, *,
-               password: str | None = None, insecure: bool = False) -> None:
+               password: str | None = None, insecure: bool = False,
+               offset: int = 0) -> None:
     """Write keystore-%d.json + keystore-%d.txt password files
-    (reference keystore.go:57 StoreKeys layout)."""
+    (reference keystore.go:57 StoreKeys layout). `offset` starts the
+    numbering past existing stores (the add-validators flows append)."""
     directory = Path(directory)
     directory.mkdir(parents=True, exist_ok=True)
-    for i, secret in enumerate(secrets):
+    for i, secret in enumerate(secrets, start=offset):
         pw = password if password is not None else os.urandom(16).hex()
         store = encrypt(secret, pw, insecure=insecure)
         (directory / f"keystore-{i}.json").write_text(json.dumps(store, indent=2))

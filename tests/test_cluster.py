@@ -277,3 +277,81 @@ class TestPrivKeyLock:
             PrivKeyLock(path).acquire()
         lk.release()
         PrivKeyLock(path).acquire().release()  # released -> acquirable
+
+
+class TestAddValidatorsSolo:
+    def test_cli_flow_grows_every_node(self, tmp_path):
+        """`alpha add-validators-solo` appends validators + keystores to
+        every node dir; each node restarts with the grown set and usable
+        new shares (reference cmd/addvalidators.go)."""
+        from charon_tpu.cmd.cli import main as cli_main
+
+        create_cluster("solo", num_validators=1, num_nodes=3, threshold=2,
+                       out_dir=tmp_path)
+        before = set(load_node(tmp_path / "node0")[2].root_pubkeys)
+        rc = cli_main(["alpha", "add-validators-solo",
+                       "--cluster-dir", str(tmp_path),
+                       "--num-validators", "2", "--insecure-keys"])
+        assert rc == 0
+        roots = None
+        for i in range(3):
+            _, _, keys = load_node(tmp_path / f"node{i}")
+            assert len(keys.root_pubkeys) == 3
+            if roots is None:
+                roots = set(keys.root_pubkeys)
+            else:  # every node materialises the SAME grown validator set
+                assert set(keys.root_pubkeys) == roots
+        # the deposit file for the ADDED validators exists
+        assert (tmp_path / "deposit-data-added-1.json").exists()
+
+        # the new shares actually sign: threshold-aggregate one of the
+        # ADDED validators (not the genesis one) across nodes and verify
+        # against its root key
+        all_keys = [load_node(tmp_path / f"node{i}")[2] for i in range(3)]
+        new_root = next(iter(roots - before))
+        msg = b"\x77" * 32
+        partials = {}
+        for i in range(3):
+            share = all_keys[i].my_share_secrets[new_root]
+            partials[i + 1] = tbls.sign(share, msg)
+        agg = tbls.threshold_aggregate({k: partials[k] for k in (1, 2)})
+        from charon_tpu.core.types import pubkey_to_bytes
+
+        assert tbls.verify(tbls.PublicKey(pubkey_to_bytes(new_root)), msg, agg)
+
+    def test_rejects_foreign_node_dirs(self, tmp_path):
+        """Node dirs from a DIFFERENT cluster must be refused (the flow is
+        solo-only: every operator key must match the lock)."""
+        from charon_tpu.cluster import add_validators_solo
+
+        create_cluster("solo-a", num_validators=1, num_nodes=2, threshold=2,
+                       out_dir=tmp_path / "a")
+        create_cluster("solo-b", num_validators=1, num_nodes=2, threshold=2,
+                       out_dir=tmp_path / "b")
+        # graft node1 from cluster b into cluster a's directory
+        import shutil
+
+        shutil.rmtree(tmp_path / "a" / "node1")
+        shutil.copytree(tmp_path / "b" / "node1", tmp_path / "a" / "node1")
+        with pytest.raises(Exception, match="identity keys"):
+            add_validators_solo(tmp_path / "a", 1)
+
+    def test_orphan_keystores_are_tolerated_and_healed(self, tmp_path):
+        """A crash between keystore and manifest writes leaves orphan
+        trailing keystores; the node must still load (manifest is truth)
+        and re-running the add command heals at the same offsets."""
+        from charon_tpu.cluster import add_validators_solo
+
+        create_cluster("heal", num_validators=1, num_nodes=2, threshold=2,
+                       out_dir=tmp_path)
+        # simulate the crash artifact: one orphan keystore, no manifest
+        orphan = tbls.generate_secret_key()
+        keystore.store_keys([orphan], tmp_path / "node0" / "validator_keys",
+                            insecure=True, offset=1)
+        _, _, keys = load_node(tmp_path / "node0")   # still loads
+        assert len(keys.root_pubkeys) == 1
+        added = add_validators_solo(tmp_path, 1, insecure_keys=True)
+        assert len(added) == 1
+        for i in range(2):
+            _, _, keys = load_node(tmp_path / f"node{i}")
+            assert len(keys.root_pubkeys) == 2
