@@ -18,10 +18,13 @@ Routes the duty pipeline's hot calls onto the fused Pallas kernel plane
     bad signature and callers attribute per-item.
 
 Everything else (keygen, split/recover, sign, single verify) delegates to
-the native C++ backend. Small batches stay on the CPU: a device call has a
-~1s fixed floor (decompression/sqrt power scans + MSM dispatches through
-the remote tunnel) regardless of batch size ≤1024, so it only wins past
-`min_device_batch` items. Feature-gated in app wiring via
+the native C++ backend. Small batches stay on the CPU: a fused device
+call has a fixed floor (~0.36 s aggregate+verify, ~0.20 s bulk verify —
+one dispatch + one transfer, round-3 single-dispatch design) regardless
+of batch size ≤1024, so it only wins past `min_device_batch` /
+`min_device_verify` items; the cross-duty batching window
+(core/coalesce.py) gathers sub-threshold duties up to these sizes.
+Feature-gated in app wiring via
 charon_tpu.utils.featureset.TPU_BLS, mirroring how the reference gates
 backends behind tbls.SetImplementation + app/featureset
 (reference tbls/tbls.go:72, featureset.go:10-75).

@@ -15,7 +15,8 @@ import time
 from dataclasses import dataclass, field
 
 from .. import tbls
-from ..core import aggsigdb, bcast, consensus as consensus_mod, dutydb
+from ..core import aggsigdb, bcast, coalesce as coalesce_mod
+from ..core import consensus as consensus_mod, dutydb
 from ..core import fetcher, interfaces, leadercast
 from ..core import parsigdb, parsigex, scheduler, sigagg, validatorapi
 from ..core.deadline import Deadliner, new_duty_deadline_func
@@ -172,11 +173,15 @@ def _build_node(idx: int, keys: KeyShares, beacon: BeaconMock, chain,
     else:
         raise ValueError(f"unknown consensus type {consensus_type!r}")
     vapi = validatorapi.Component(beacon, duty_db, aggsig_db, keys, chain)
-    verify_set = (parsigex.new_batch_eth2_verifier(chain, keys)
+    # the same cross-duty batching window production wiring uses
+    # (app/app.py assemble) — simnet pipelines continuously exercise it
+    coalescer = coalesce_mod.TblsCoalescer(window=0.005)
+    verify_set = (parsigex.new_batch_eth2_verifier(chain, keys,
+                                                   coalescer=coalescer)
                   if verify_peer_partials else None)
     psigex = parsigex.ParSigEx(parsig_transport, idx,
                                new_duty_gater(chain), verify_set)
-    agg = sigagg.SigAgg(keys, chain)
+    agg = sigagg.SigAgg(keys, chain, coalescer=coalescer)
     caster = bcast.Broadcaster(beacon, chain)
 
     fetch.register_agg_sig_db(aggsig_db.await_)
