@@ -54,6 +54,16 @@ def main() -> None:
     aggs = PA.threshold_aggregate_batch(batches)
     assert PA.rlc_verify_batch(pubkeys, [msg] * N, aggs)
 
+    # ---- the production single-dispatch fused slot ------------------------
+    datas = [msg] * N
+    PA.threshold_aggregate_and_verify(batches, pubkeys, datas)  # warm
+    t0 = time.time()
+    _aggs_f, ok_f = PA.threshold_aggregate_and_verify(batches, pubkeys,
+                                                      datas)
+    stages["fused.slot"] = tick("fused.slot (ONE dispatch + ONE transfer)",
+                                t0)
+    assert ok_f
+
     # ---- aggregate: end-to-end, then each internal dispatch ---------------
     t0 = time.time()
     aggs = PA.threshold_aggregate_batch(batches)
