@@ -223,3 +223,161 @@ def test_tracker_flags_inconsistent_parsigs():
         assert report.participation == {1, 2, 3}
 
     asyncio.run(run())
+
+
+class TestRecaster:
+    """reference core/bcast/recast.go: builder registrations are replayed
+    at every epoch head for as long as the node runs."""
+
+    class _Beacon:
+        def __init__(self):
+            self.submissions: list[list] = []
+            self.fail_next = 0
+
+        async def submit_validator_registrations(self, regs):
+            if self.fail_next:
+                self.fail_next -= 1
+                raise RuntimeError("bn down")
+            self.submissions.append(list(regs))
+
+    def _signed_reg(self, pubkey=b"\xaa" * 48):
+        from charon_tpu.core.signeddata import SignedRegistration
+
+        reg = spec.ValidatorRegistration(b"\x01" * 20, 30_000_000, 1234,
+                                         pubkey)
+        return SignedRegistration(reg, b"\x05" * 96)
+
+    def _slot(self, n, spe=4):
+        from charon_tpu.core.scheduler import Slot
+
+        return Slot(slot=n, time=0.0, slots_per_epoch=spe)
+
+    def test_replays_at_epoch_heads_only_once_per_epoch(self):
+        from charon_tpu.core.bcast import Recaster
+        from charon_tpu.core.types import Duty, DutyType
+
+        async def run():
+            bn = self._Beacon()
+            rc = Recaster(bn)
+            duty = Duty(3, DutyType.BUILDER_REGISTRATION)
+            await rc.on_broadcast(duty, {b"\xaa" * 48: self._signed_reg()})
+            await rc.on_slot(self._slot(5))      # mid-epoch: no recast
+            assert bn.submissions == []
+            await rc.on_slot(self._slot(8))      # epoch head (8 % 4 == 0)
+            await rc.on_slot(self._slot(8))      # duplicate tick: suppressed
+            assert len(bn.submissions) == 1
+            await rc.on_slot(self._slot(12))     # next epoch head
+            assert len(bn.submissions) == 2
+            assert bn.submissions[0][0].message.pubkey == b"\xaa" * 48
+            # a failing BN must not kill the loop; next epoch retries
+            bn.fail_next = 1
+            await rc.on_slot(self._slot(16))
+            await rc.on_slot(self._slot(20))
+            assert len(bn.submissions) == 3
+
+        asyncio.run(run())
+
+    def test_latest_registration_per_validator_wins(self):
+        from charon_tpu.core.bcast import Recaster
+        from charon_tpu.core.types import Duty, DutyType
+
+        async def run():
+            bn = self._Beacon()
+            rc = Recaster(bn)
+            duty = Duty(1, DutyType.BUILDER_REGISTRATION)
+            await rc.on_broadcast(duty, {b"\xbb" * 48: self._signed_reg()})
+            from charon_tpu.core.signeddata import SignedRegistration
+
+            newer = SignedRegistration(spec.ValidatorRegistration(
+                b"\x02" * 20, 25_000_000, 9999, b"\xbb" * 48), b"\x05" * 96)
+            await rc.on_broadcast(duty, {b"\xbb" * 48: newer})
+            await rc.on_slot(self._slot(4))
+            (subs,) = bn.submissions
+            assert len(subs) == 1
+            assert subs[0].message.timestamp == 9999   # the later one
+
+        asyncio.run(run())
+
+
+class TestAggSigDB:
+    """reference core/aggsigdb/memory_test.go shapes: blocking awaits,
+    root-specific awaits, conflict detection, expiry fails waiters."""
+
+    def _signed(self, chain, sk, data=None):
+        from charon_tpu.core.signeddata import SignedAttestation
+
+        att = spec.Attestation([True], data or _att_data(), b"\x00" * 96)
+        unsigned = SignedAttestation(att)
+        return unsigned.set_signature(
+            tbls.sign(sk, unsigned.signing_root(chain)))
+
+    def test_await_resolves_on_store_and_after(self):
+        from charon_tpu.core import aggsigdb
+        from charon_tpu.core.types import Duty, DutyType
+
+        chain = spec.ChainSpec(genesis_time=0)
+        sk = tbls.generate_secret_key()
+        duty = Duty(7, DutyType.ATTESTER)
+        pk = b"\xcc" * 48
+
+        async def run():
+            db = aggsigdb.MemDB()
+            signed = self._signed(chain, sk)
+            waiter = asyncio.ensure_future(db.await_(duty, pk))
+            await asyncio.sleep(0.01)
+            assert not waiter.done()        # blocks until the store
+            await db.store(duty, {pk: signed})
+            got = await asyncio.wait_for(waiter, 1)
+            assert bytes(got.signature()) == bytes(signed.signature())
+            # idempotent store of the SAME aggregate is fine
+            await db.store(duty, {pk: signed})
+            # and a later await resolves immediately from the store
+            got2 = await db.await_(duty, pk)
+            assert bytes(got2.signature()) == bytes(signed.signature())
+
+        asyncio.run(run())
+
+    def test_conflicting_aggregate_rejected(self):
+        from charon_tpu.core import aggsigdb
+        from charon_tpu.core.types import Duty, DutyType
+        from charon_tpu.utils.errors import CharonError
+
+        chain = spec.ChainSpec(genesis_time=0)
+        sk = tbls.generate_secret_key()
+        duty = Duty(9, DutyType.ATTESTER)
+        pk = b"\xdd" * 48
+
+        async def run():
+            db = aggsigdb.MemDB()
+            signed = self._signed(chain, sk)
+            await db.store(duty, {pk: signed})
+            forged = signed.clone().set_signature(b"\x66" * 96)
+            with pytest.raises(CharonError, match="conflicting"):
+                await db.store(duty, {pk: forged})
+
+        asyncio.run(run())
+
+    def test_root_specific_await(self):
+        from charon_tpu.core import aggsigdb
+        from charon_tpu.core.types import Duty, DutyType
+
+        chain = spec.ChainSpec(genesis_time=0)
+        sk = tbls.generate_secret_key()
+        duty = Duty(11, DutyType.SYNC_CONTRIBUTION)
+        pk = b"\xee" * 48
+
+        async def run():
+            db = aggsigdb.MemDB()
+            a = self._signed(chain, sk, _att_data(slot=11))
+            b = self._signed(chain, sk, _att_data(slot=12))
+            waiter_b = asyncio.ensure_future(
+                db.await_(duty, pk, root=b.message_root()))
+            await asyncio.sleep(0.01)
+            await db.store(duty, {pk: a})
+            await asyncio.sleep(0.01)
+            assert not waiter_b.done()      # a different payload landed
+            await db.store(duty, {pk: b})
+            got = await asyncio.wait_for(waiter_b, 1)
+            assert got.message_root() == b.message_root()
+
+        asyncio.run(run())
