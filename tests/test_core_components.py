@@ -381,3 +381,70 @@ class TestAggSigDB:
             assert got.message_root() == b.message_root()
 
         asyncio.run(run())
+
+
+class TestScheduler:
+    """Direct scheduler unit tests (reference core/scheduler/scheduler_test
+    shapes): epoch duty resolution, aggregator sharing, sync-message
+    per-slot expansion, trim window."""
+
+    def _sched(self, n_validators=2, spe=4):
+        from charon_tpu.core.scheduler import Scheduler
+        from charon_tpu.eth2.beacon import ValidatorCache
+        from charon_tpu.testutil.beaconmock import BeaconMock
+
+        pks = [bytes([i + 1]) * 48 for i in range(n_validators)]
+        beacon = BeaconMock(pks, genesis_time=0, slots_per_epoch=spe)
+        valcache = ValidatorCache(beacon, pks)
+        return Scheduler(beacon, valcache), beacon
+
+    def test_epoch_resolution_and_sharing(self):
+        from charon_tpu.core.types import Duty, DutyType
+
+        async def run():
+            sched, beacon = self._sched()
+            sched._slots_per_epoch = 4
+
+            async def sync_duties(epoch, indices):
+                v = next(iter(beacon.validators.values()))
+                return [spec.SyncCommitteeDuty(
+                    pubkey=v.pubkey, validator_index=v.index,
+                    validator_sync_committee_indices=[0])]
+
+            beacon.overrides["sync_committee_duties"] = sync_duties
+            await sched._resolve_epoch_duties(0)
+            spe = 4
+            # attester + aggregator share the SAME definition per duty
+            att_duties = [d for d in sched._duties
+                          if d.type == DutyType.ATTESTER and d.slot < spe]
+            assert att_duties, "no attester duties resolved"
+            for d in att_duties:
+                agg = Duty(d.slot, DutyType.AGGREGATOR)
+                assert sched.get_duty_definition(agg) is not None
+            # sync messages expand to EVERY slot of the epoch
+            sync_slots = {d.slot for d in sched._duties
+                          if d.type == DutyType.SYNC_MESSAGE}
+            assert sync_slots == set(range(spe))
+            # idempotent: second resolve does not duplicate
+            n = len(sched._duties)
+            await sched._resolve_epoch_duties(0)
+            assert len(sched._duties) == n
+
+        asyncio.run(run())
+
+    def test_trim_drops_stale_epochs(self):
+        from charon_tpu.core.scheduler import TRIM_EPOCH_OFFSET
+
+        async def run():
+            sched, beacon = self._sched()
+            sched._slots_per_epoch = 4
+            await sched._resolve_epoch_duties(0)
+            far = TRIM_EPOCH_OFFSET + 2
+            await sched._resolve_epoch_duties(far)
+            sched._trim(far)
+            assert all(d.slot >= (far - TRIM_EPOCH_OFFSET) * 4
+                       for d in sched._duties)
+            assert 0 not in sched._resolved_epochs
+            assert far in sched._resolved_epochs
+
+        asyncio.run(run())
