@@ -72,6 +72,7 @@ class Config:
     feature_set_enable: list[str] = field(default_factory=list)
     feature_set_disable: list[str] = field(default_factory=list)
     synthetic_proposals: bool = False
+    builder_api: bool = False  # reference --builder-api (app/app.go:89)
     p2p_fuzz: float = 0.0
     consensus_type: str = "qbft"
     loki_endpoint: str = ""  # push logs to Loki when set (utils/loki.py)
@@ -287,7 +288,13 @@ async def assemble(config: Config) -> App:
         ConsensusTCPEndpoint(node), peer_idx=my_idx, nodes=num_nodes,
         privkey=identity, peer_pubkeys=peer_pubkeys,
         deadliner=Deadliner(deadline_fn), gater=new_duty_gater(chain))
-    vapi = vapi_mod.Component(beacon, duty_db, aggsig_db, keys, chain)
+    # fee recipient from the cluster definition (reference app/app.go
+    # feeRecipientFunc built from the lock) — the VC reads it back via
+    # /proposer_config, which this surface makes authoritative
+    _fee_addr = (getattr(getattr(lock, "definition", None),
+                         "fee_recipient_address", "") or "0x" + "00" * 20)
+    vapi = vapi_mod.Component(beacon, duty_db, aggsig_db, keys, chain,
+                              fee_recipient=lambda _pk: _fee_addr)
     # Cross-duty batching window: concurrent duties (attestation +
     # sync-committee the same slot, adjacent slots) share one fused device
     # dispatch so sub-threshold batches still reach the TPU (SURVEY §2.4;
@@ -333,8 +340,23 @@ async def assemble(config: Config) -> App:
         prioritiser,
         versions=[f"charon-tpu/{version_mod.VERSION}"],
         protocols=[PROTO_CONSENSUS, PROTO_PARSIGEX, PROTO_PRIORITY],
-        proposal_types=["full", "builder"])
+        # precedence order: builder first iff this node enables it
+        # (reference app/app.go:1033 ProposalTypes)
+        proposal_types=(["builder", "full"] if config.builder_api
+                        else ["full"]))
     sched.subscribe_slots(info_sync.on_slot)
+
+    # builder (blinded) proposals need BOTH this node's --builder-api flag
+    # and cluster-wide agreement on the "builder" proposal type via
+    # infosync; the same gate drives the fetcher's proposal fetch and the
+    # proposer_config the VC bootstraps its builder mode from (reference
+    # app/app.go builderAPI + ProposalTypes wiring)
+    def _builder_enabled(_slot: int) -> bool:
+        return (config.builder_api and
+                "builder" in info_sync.agreed(infosync_mod.TOPIC_PROPOSAL))
+
+    fetch.register_builder_enabled(_builder_enabled)
+    vapi.register_builder_enabled(_builder_enabled)
 
     # feed broadcast attestations to the inclusion checker (reference wires
     # the tracker's InclusionChecker off sigagg output, inclusion.go:52)

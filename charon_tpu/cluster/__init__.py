@@ -154,6 +154,37 @@ def _write_deposit_file(path: Path, validators: list[DistValidator],
     Path(path).write_text(json.dumps(deposits, indent=2))
 
 
+def _repair_manifests(node_dirs: list[Path]) -> None:
+    """Complete a partially-committed manifest mutation before dealing new
+    validators: a crash between the per-node manifest.save calls leaves
+    node logs divergent, and a naive rerun (which reads node_dirs[0] only)
+    would deal a SECOND fresh batch on top of the half-committed first one.
+    The longest log that verifies (materialise checks the chain and every
+    approval) wins — provided every other log is a strict prefix of it —
+    and is re-saved to the lagging nodes."""
+    logs: list[list] = []
+    for nd in node_dirs:
+        p = nd / "cluster-manifest.json"
+        logs.append(manifest.load(p) if p.exists() else [])
+    longest = max(logs, key=len)
+    if not longest:
+        return
+    # prefix consistency FIRST — equal-length-but-different logs (e.g. two
+    # runs against disjoint node subsets) must refuse, not silently pass
+    head = [m.hash() for m in longest]
+    for nd, lg in zip(node_dirs, logs):
+        if [m.hash() for m in lg] != head[:len(lg)]:
+            raise errors.new(
+                "divergent cluster manifests (not a prefix) — refusing to "
+                "repair", dir=str(nd))
+    if all(len(lg) == len(head) for lg in logs):
+        return  # identical everywhere: nothing to repair
+    manifest.materialise(longest)  # raises on a broken/unapproved chain
+    for nd, lg in zip(node_dirs, logs):
+        if len(lg) < len(head):
+            manifest.save(longest, nd / "cluster-manifest.json")
+
+
 def add_validators_solo(cluster_dir: str | Path, num_validators: int, *,
                         withdrawal_addr20: bytes = b"\x11" * 20,
                         insecure_keys: bool = True) -> list[DistValidator]:
@@ -176,6 +207,7 @@ def add_validators_solo(cluster_dir: str | Path, num_validators: int, *,
             raise errors.new("missing identity key", dir=str(nd))
         identity_keys.append(bytes.fromhex(key_path.read_text().strip()))
 
+    _repair_manifests(node_dirs)
     cluster = manifest.load_cluster(node_dirs[0])
     lock = cluster.lock
     num_nodes = len(lock.definition.operators)

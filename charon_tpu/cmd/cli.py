@@ -110,6 +110,10 @@ def build_parser() -> argparse.ArgumentParser:
                        help="use the in-process beacon mock (dev/simnet)")
     run_p.add_argument("--simnet-validator-mock", dest="simnet_validator_mock",
                        action="store_true", default=None)
+    run_p.add_argument("--builder-api", dest="builder_api",
+                       action="store_true", default=None,
+                       help="enable builder (blinded) block proposals "
+                            "(reference --builder-api)")
     run_p.add_argument("--feature-set", dest="feature_set", default=None,
                        choices=["alpha", "beta", "stable"],
                        help="minimum feature maturity to enable "
@@ -297,6 +301,7 @@ def _cmd_run(args: argparse.Namespace) -> int:
         feature_set_enable=_csv("feature_set_enable"),
         feature_set_disable=_csv("feature_set_disable"),
         p2p_fuzz=float(resolve(args, "p2p_fuzz", 0.0) or 0.0),
+        builder_api=bool(resolve_bool(args, "builder_api")),
         loki_endpoint=resolve(args, "loki_addresses", "") or "",
         otlp_endpoint=resolve(args, "otlp_address", "") or "",
         test=test,

@@ -82,9 +82,21 @@ class _Window:
         futs = [f for _, _, f in reqs]
         try:
             await self._dispatch([p for _, p, _ in reqs], futs)
-        except Exception as exc:  # noqa: BLE001 — propagate to every waiter
-            for f in futs:
-                _resolve(f, exc=exc)
+        except Exception as exc:  # noqa: BLE001 — isolate the offender
+            # One malformed submission (e.g. bytes that fail the device
+            # parse) must not fail every duty sharing the window. Bisect:
+            # healthy halves still run as fused batches, so the offender is
+            # isolated in O(log N) dispatches instead of N serial ones —
+            # each dispatch has a ~1s device floor, so a serial retry of a
+            # full window would blow the slot budget.
+            if len(reqs) == 1:
+                _resolve(futs[0], exc=exc)
+                return
+            _log.debug("coalesced dispatch raised; bisecting",
+                       requests=len(reqs))
+            mid = len(reqs) // 2
+            await self._run(reqs[:mid])
+            await self._run(reqs[mid:])
 
 
 def _resolve(fut: asyncio.Future, result=None, exc=None) -> None:
@@ -107,8 +119,11 @@ class TblsCoalescer:
         impl = tbls.get_implementation()
         if flush_at is None:
             flush_at = getattr(impl, "min_device_batch", 192)
+        # the verify path only routes to the device at min_device_verify —
+        # a count-triggered flush below that would still take the CPU path
+        ver_at = getattr(impl, "min_device_verify", flush_at)
         self._agg = _Window("agg", window, flush_at, self._dispatch_agg)
-        self._ver = _Window("verify", window, flush_at, self._dispatch_ver)
+        self._ver = _Window("verify", window, ver_at, self._dispatch_ver)
         self.flushes = 0
         self.coalesced_flushes = 0
 

@@ -62,7 +62,9 @@ class Component:
     def __init__(self, beacon: BeaconNode, dutydb: DutyDB, aggsigdb: AggSigDB,
                  keys: KeyShares, chain: ChainSpec,
                  index_resolver: Callable[[int], Awaitable[PubKey | None]] | None = None,
-                 clock: Callable[[], float] = time.time):
+                 clock: Callable[[], float] = time.time,
+                 fee_recipient: Callable[[PubKey], str] | None = None,
+                 builder_enabled: Callable[[int], bool] | None = None):
         self._beacon = beacon
         self._dutydb = dutydb
         self._aggsigdb = aggsigdb
@@ -70,11 +72,104 @@ class Component:
         self._chain = chain
         self._index_resolver = index_resolver
         self._clock = clock
+        self._fee_recipient = fee_recipient or (lambda _pk: "0x" + "00" * 20)
+        self._builder_enabled = builder_enabled or (lambda _slot: False)
         self._index_cache: dict[int, PubKey] = {}
         self._subs = []
 
     def subscribe(self, fn) -> None:
         self._subs.append(fn)
+
+    def register_builder_enabled(self, fn: Callable[[int], bool]) -> None:
+        """Late-bound builder gate (the fetcher's mirror): proposer_config
+        advertises builder mode to the VC from the same cluster-wide
+        infosync agreement the fetcher uses to pick proposal types."""
+        self._builder_enabled = fn
+
+    # -- VC identity bootstrap (share⇄DV validator translation) --------------
+
+    async def get_validators(
+            self, ids: list[str]) -> list[tuple[spec.Validator, bytes]]:
+        """The states/{state_id}/validators surface a real VC bootstraps
+        from (reference validatorapi.go:969-1007 Validators /
+        ValidatorsByPubKey + convertValidators): ids are the VC's SHARE
+        pubkeys (0x-hex) or validator indices; the BN is queried for the DV
+        ROOT validators and each record comes back with the share pubkey
+        substituted — so the VC sees ITS keys as active beacon validators.
+        Empty ids serve the whole cluster. Returns (validator_record,
+        share_pubkey) pairs; raises on an id outside the cluster (the
+        reference's pubshare-not-found error)."""
+        share_by_root: dict[bytes, bytes] = {}
+        want_indices: list[int] = []
+        for raw in ids:
+            raw = raw.strip()
+            if raw.startswith("0x"):
+                share = bytes.fromhex(raw[2:])
+                root = self._keys.root_by_share_pubkey(share)
+                share_by_root[bytes(pubkey_to_bytes(root))] = share
+            else:
+                want_indices.append(int(raw))
+        if not ids or want_indices:
+            # index ids (and the empty query) resolve against the whole
+            # cluster set; share substitution uses THIS node's share keys
+            for root in self._keys.root_pubkeys:
+                share_by_root.setdefault(
+                    bytes(pubkey_to_bytes(root)),
+                    bytes(self._keys.my_share_pubkey(root)))
+        vals = await self._beacon.validators_by_pubkey(
+            list(share_by_root))
+        by_index = {v.index: (rb, v) for rb, v in vals.items()}
+        selected: list[tuple[bytes, spec.Validator]] = []
+        if not ids:
+            selected = list(vals.items())
+        else:
+            for raw in ids:
+                raw = raw.strip()
+                if raw.startswith("0x"):
+                    root = self._keys.root_by_share_pubkey(
+                        bytes.fromhex(raw[2:]))
+                    rb = bytes(pubkey_to_bytes(root))
+                    if rb in vals:  # unknown to the BN: omit, like the BN
+                        selected.append((rb, vals[rb]))
+                elif int(raw) in by_index:
+                    selected.append(by_index[int(raw)])
+                else:
+                    raise errors.new("validator index not in cluster",
+                                     index=int(raw))
+        return [(dataclasses.replace(v, pubkey=share_by_root[rb]),
+                 share_by_root[rb]) for rb, v in selected]
+
+    def proposer_config(self) -> dict:
+        """GET /proposer_config + /teku_proposer_config (reference
+        validatorapi.go:1128 ProposerConfig, eth2util/eth2exp/proposeconf.go):
+        per-SHARE-pubkey fee recipient + builder settings, with registration
+        overrides carrying the DV root pubkey and a slot-1 timestamp (so the
+        VC's pre-generated registrations are overridden)."""
+        gas_limit = 30_000_000
+        slot = max(self._chain.slot_at(self._clock()), 0)
+        ts = int(self._chain.genesis_time + self._chain.seconds_per_slot)
+        proposers = {}
+        for root in self._keys.root_pubkeys:
+            share_hex = "0x" + bytes(self._keys.my_share_pubkey(root)).hex()
+            proposers[share_hex] = {
+                "fee_recipient": self._fee_recipient(root),
+                "builder": {
+                    "enabled": bool(self._builder_enabled(slot)),
+                    "gas_limit": gas_limit,
+                    "registration_overrides": {
+                        "timestamp": str(ts),
+                        "public_key": "0x" + bytes(
+                            pubkey_to_bytes(root)).hex(),
+                    },
+                },
+            }
+        return {
+            "proposers": proposers,
+            "default_config": {
+                "fee_recipient": "0x" + "00" * 20,
+                "builder": {"enabled": False, "gas_limit": gas_limit},
+            },
+        }
 
     # -- duties (proxied to the BN with share→root pubkey mapping) ----------
 
@@ -163,7 +258,31 @@ class Component:
         VC's *partial* randao signature — verify it, route it through the
         partial-sig pipeline (duty RANDAO), then serve the consensus-agreed
         block from DutyDB (which the Fetcher builds once the cluster's
-        aggregated randao lands in AggSigDB)."""
+        aggregated randao lands in AggSigDB). Serves FULL proposals only —
+        a builder-mode (blinded) consensus proposal must be fetched via the
+        v1 blinded endpoint (blinded_block_proposal)."""
+        block = await self._propose(slot, randao_reveal)
+        if block.blinded:
+            raise errors.new(
+                "consensus proposal is blinded (builder mode); fetch it via "
+                "GET /eth/v1/validator/blinded_blocks/{slot}", slot=slot)
+        return block
+
+    async def blinded_block_proposal(self, slot: int,
+                                     randao_reveal: bytes) -> spec.BeaconBlock:
+        """GET /eth/v1/validator/blinded_blocks/{slot} (reference
+        router.go:590 proposeBlindedBlock → validatorapi
+        BlindedBeaconBlockProposal): the builder-mode proposal flow — same
+        partial-randao pipeline, but the consensus-agreed proposal must be
+        a blinded (builder) block."""
+        block = await self._propose(slot, randao_reveal)
+        if not block.blinded:
+            raise errors.new(
+                "consensus proposal is a full block; fetch it via "
+                "GET /eth/v2/validator/blocks/{slot}", slot=slot)
+        return block
+
+    async def _propose(self, slot: int, randao_reveal: bytes) -> spec.BeaconBlock:
         epoch = self._chain.epoch_of(slot)
         pubkey = await self._proposer_pubkey(slot)
         randao = SignedRandao(epoch, bytes(randao_reveal))
@@ -172,6 +291,16 @@ class Component:
         await self._emit(duty, {pubkey: ParSignedData(randao, self._keys.my_share_idx)})
         _submit_counter.inc("randao")
         return await self._dutydb.await_beacon_block(slot)
+
+    async def submit_blinded_block(self, block: spec.SignedBeaconBlock) -> None:
+        """POST /eth/v1/beacon/blinded_blocks (reference router.go:694
+        submitBlindedBlock → SubmitBlindedBeaconBlock): the builder-mode
+        submission pair of submit_block. The proposer signature covers the
+        header root (blinded and full blocks share it), so the partial-sig
+        pipeline is identical; the blinded flag rides the proposal so the
+        broadcaster submits it to the BN's blinded endpoint."""
+        block.message.blinded = True
+        await self.submit_block(block)
 
     async def submit_block(self, block: spec.SignedBeaconBlock) -> None:
         """Partial signed block from the VC (validatorapi.go:357

@@ -24,6 +24,12 @@ Intercepted surface (matching the reference's router.go endpoints table):
   POST /eth/v1/validator/sync_committee_selections     (DVT-specific)
   POST /eth/v1/beacon/pool/voluntary_exits
   POST /eth/v1/validator/register_validator
+  GET/POST /eth/v1/beacon/states/{state_id}/validators (share⇄DV identity)
+  GET  /eth/v1/beacon/states/{state_id}/validators/{validator_id}
+  GET  /eth/v1/validator/blinded_blocks/{slot}         (builder mode)
+  POST /eth/v1/beacon/blinded_blocks
+  POST /eth/v1/validator/prepare_beacon_proposer       (accepted no-op)
+  GET  /proposer_config  +  /teku_proposer_config
 """
 
 from __future__ import annotations
@@ -58,6 +64,30 @@ def _hex_arg(request: web.Request, name: str) -> bytes:
     return bytes.fromhex(raw[2:] if raw.startswith("0x") else raw)
 
 
+_FAR_EPOCH = str(2**64 - 1)
+
+
+def _encode_validator(v) -> dict:
+    """Beacon-API v1 validator record (share pubkey already substituted).
+    The fields beyond this repo's Validator subset take their post-genesis
+    active defaults — the shape real VCs parse at bootstrap."""
+    return {
+        "index": str(v.index),
+        "balance": str(v.effective_balance),
+        "status": v.status,
+        "validator": {
+            "pubkey": "0x" + bytes(v.pubkey).hex(),
+            "withdrawal_credentials": "0x" + bytes(v.withdrawal_credentials).hex(),
+            "effective_balance": str(v.effective_balance),
+            "slashed": False,
+            "activation_eligibility_epoch": str(v.activation_epoch),
+            "activation_epoch": str(v.activation_epoch),
+            "exit_epoch": _FAR_EPOCH,
+            "withdrawable_epoch": _FAR_EPOCH,
+        },
+    }
+
+
 class VapiRouter:
     """aiohttp server wrapping a validatorapi Component with BN passthrough."""
 
@@ -88,6 +118,19 @@ class VapiRouter:
         app.router.add_post("/eth/v1/validator/sync_committee_selections", self._sc_selections)
         app.router.add_post("/eth/v1/beacon/pool/voluntary_exits", self._submit_exit)
         app.router.add_post("/eth/v1/validator/register_validator", self._register)
+        # VC identity bootstrap: translate share⇄DV validators so a real VC
+        # discovers its validators (reference router.go:117-126); proxying
+        # these raw would show the VC zero validators (share pubkeys are
+        # unknown to the BN) and it would silently idle.
+        app.router.add_get("/eth/v1/beacon/states/{state_id}/validators", self._get_validators)
+        app.router.add_post("/eth/v1/beacon/states/{state_id}/validators", self._get_validators)
+        app.router.add_get("/eth/v1/beacon/states/{state_id}/validators/{validator_id}", self._get_validator)
+        # builder (blinded) pair + proposer config (router.go:137-146,157-166,197)
+        app.router.add_get("/eth/v1/validator/blinded_blocks/{slot}", self._blinded_proposal)
+        app.router.add_post("/eth/v1/beacon/blinded_blocks", self._submit_blinded_block)
+        app.router.add_post("/eth/v1/validator/prepare_beacon_proposer", self._prepare_proposer)
+        app.router.add_get("/proposer_config", self._proposer_config)
+        app.router.add_get("/teku_proposer_config", self._proposer_config)
         app.router.add_route("*", "/{tail:.*}", self._proxy)
         app.middlewares.append(_error_middleware)
         self._app = app
@@ -177,13 +220,66 @@ class VapiRouter:
             slot = int(request.match_info["slot"])
             randao = _hex_arg(request, "randao_reveal")
             graffiti = request.query.get("graffiti", "")
+            # v2 contract: a FULL block (the component rejects blinded
+            # proposals here, directing builder-mode VCs to the v1 blinded
+            # endpoint below — the standard split real VCs speak)
             block = await self._comp.block_proposal(
                 slot, randao, bytes.fromhex(graffiti[2:]) if graffiti else b"")
             return web.json_response({
                 "version": "charon-opaque",
-                "execution_payload_blinded": block.blinded,
                 "data": jc.encode_beacon_block(block),
             })
+
+    async def _blinded_proposal(self, request: web.Request) -> web.Response:
+        with _req_hist.observe_time("blinded_proposal"):
+            slot = int(request.match_info["slot"])
+            randao = _hex_arg(request, "randao_reveal")
+            block = await self._comp.blinded_block_proposal(slot, randao)
+            return web.json_response({
+                "version": "charon-opaque",
+                "data": jc.encode_beacon_block(block),
+            })
+
+    async def _submit_blinded_block(self, request: web.Request) -> web.Response:
+        with _req_hist.observe_time("submit_blinded_block"):
+            body = await request.json()
+            await self._comp.submit_blinded_block(
+                jc.decode_signed_beacon_block(body))
+            return web.json_response({})
+
+    async def _prepare_proposer(self, request: web.Request) -> web.Response:
+        # accepted and dropped, like the reference (router.go:861
+        # submitProposalPreparations): fee recipients come from the cluster
+        # config via /proposer_config, not per-VC preparations
+        await request.read()
+        return web.json_response({})
+
+    async def _proposer_config(self, request: web.Request) -> web.Response:
+        with _req_hist.observe_time("proposer_config"):
+            return web.json_response(self._comp.proposer_config())
+
+    async def _get_validators(self, request: web.Request) -> web.Response:
+        with _req_hist.observe_time("get_validators"):
+            ids: list[str] = []
+            for csv in request.query.getall("id", []):
+                ids.extend(x.strip() for x in csv.split(",") if x.strip())
+            if request.method == "POST" and request.can_read_body:
+                body = await request.json()
+                for x in (body or {}).get("ids") or []:
+                    ids.append(str(x))
+            vals = await self._comp.get_validators(ids)
+            return _data([_encode_validator(v) for v, _share in vals])
+
+    async def _get_validator(self, request: web.Request) -> web.Response:
+        with _req_hist.observe_time("get_validator"):
+            vid = request.match_info["validator_id"]
+            try:
+                vals = await self._comp.get_validators([vid])
+            except errors.CharonError:
+                vals = []
+            if not vals:
+                return _err(404, "validator not found")
+            return _data(_encode_validator(vals[0][0]))
 
     async def _submit_block(self, request: web.Request) -> web.Response:
         with _req_hist.observe_time("submit_block"):

@@ -212,7 +212,11 @@ class HTTPBeaconNode:
                         body=[jc.encode_container(a) for a in atts])
 
     async def submit_block(self, block) -> None:
-        await self._req("POST", "/eth/v2/beacon/blocks",
+        # a blinded (builder) proposal has no execution payload and must go
+        # to the BN's blinded endpoint — /eth/v2/beacon/blocks rejects it
+        path = ("/eth/v1/beacon/blinded_blocks" if block.message.blinded
+                else "/eth/v2/beacon/blocks")
+        await self._req("POST", path,
                         body=jc.encode_signed_beacon_block(block))
 
     async def submit_aggregate_and_proofs(self, aggs) -> None:

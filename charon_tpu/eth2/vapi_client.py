@@ -105,6 +105,32 @@ class HTTPValidatorClient:
         await self._req("POST", "/eth/v2/beacon/blocks",
                         json_body=jc.encode_signed_beacon_block(block))
 
+    async def blinded_block_proposal(self, slot: int,
+                                     randao_reveal: bytes) -> spec.BeaconBlock:
+        params = {"randao_reveal": "0x" + bytes(randao_reveal).hex()}
+        out = await self._req(
+            "GET", f"/eth/v1/validator/blinded_blocks/{slot}", params=params)
+        return jc.decode_beacon_block(out["data"])
+
+    async def submit_blinded_block(self, block: spec.SignedBeaconBlock) -> None:
+        await self._req("POST", "/eth/v1/beacon/blinded_blocks",
+                        json_body=jc.encode_signed_beacon_block(block))
+
+    # -- VC identity bootstrap -------------------------------------------------
+
+    async def get_validators(self, ids: list[str],
+                             state_id: str = "head") -> list[dict]:
+        """GET /eth/v1/beacon/states/{state_id}/validators — the beacon-API
+        records (share pubkeys substituted) a VC bootstraps from."""
+        params = {"id": ",".join(ids)} if ids else None
+        out = await self._req(
+            "GET", f"/eth/v1/beacon/states/{state_id}/validators",
+            params=params)
+        return out["data"]
+
+    async def proposer_config(self) -> dict:
+        return await self._req("GET", "/proposer_config")
+
     # -- sync committee --------------------------------------------------------
 
     async def submit_sync_committee_messages(self, msgs: list[spec.SyncCommitteeMessage]) -> None:

@@ -367,8 +367,43 @@ def _pt_add_unified(p, q):
 
 
 # ---------------------------------------------------------------------------
-# pallas_call wrappers
+# pallas_call wrappers. The kernel BODIES live at module level so the
+# interpret-mode equivalence test (tests/test_pallas_plane.py nightly tier)
+# can run the exact Mosaic bodies on CPU via pallas_call(interpret=True)
+# against the ops/field oracle — the CPU fast path below delegates to
+# ops/field and never executes these bodies, so without that test the
+# in-kernel code would only ever run on real TPU hardware.
 # ---------------------------------------------------------------------------
+
+
+def _kern_double(pref, x, y, z, ox, oy, oz):
+    _PCOL[0] = pref[:]
+    rx, ry, rz = _pt_double((x[:], y[:], z[:]))
+    ox[:], oy[:], oz[:] = rx, ry, rz
+
+
+def _kern_add(pref, x1, y1, z1, x2, y2, z2, ox, oy, oz):
+    _PCOL[0] = pref[:]
+    rx, ry, rz = _pt_add_unified((x1[:], y1[:], z1[:]),
+                                 (x2[:], y2[:], z2[:]))
+    ox[:], oy[:], oz[:] = rx, ry, rz
+
+
+def _kern_sub(pref, a, b, o):
+    _PCOL[0] = pref[:]
+    av = a[:]
+    o[:] = _unpack(_fq_sub(_pack(av), _pack(b[:])), av.shape[0])
+
+
+def _kern_addp(pref, a, b, o):
+    _PCOL[0] = pref[:]
+    av = a[:]
+    o[:] = _unpack(_fq_add(_pack(av), _pack(b[:])), av.shape[0])
+
+
+def _kern_mul(pref, a, b, o):
+    _PCOL[0] = pref[:]
+    o[:] = _e_mul_many([(a[:], b[:])])[0]
 
 
 def _espec(E, S, tw):
@@ -385,17 +420,12 @@ def _double_call(X, Y, Z, E):
     S, W = X.shape[-2:]
     tw = min(TW, W)
 
-    def kern(pref, x, y, z, ox, oy, oz):
-        _PCOL[0] = pref[:]
-        rx, ry, rz = _pt_double((x[:], y[:], z[:]))
-        ox[:], oy[:], oz[:] = rx, ry, rz
-
     if _interpret():
         from . import curve as DC
 
         return _cpu_point_op(DC.double, [(X, Y, Z)], E)
     return pl.pallas_call(
-        kern,
+        _kern_double,
         grid=(W // tw,),
         in_specs=[_pspec()] + [_espec(E, S, tw)] * 3,
         out_specs=[_espec(E, S, tw)] * 3,
@@ -408,19 +438,13 @@ def _add_call(X1, Y1, Z1, X2, Y2, Z2, E):
     S, W = X1.shape[-2:]
     tw = min(TW, W)
 
-    def kern(pref, x1, y1, z1, x2, y2, z2, ox, oy, oz):
-        _PCOL[0] = pref[:]
-        rx, ry, rz = _pt_add_unified((x1[:], y1[:], z1[:]),
-                                     (x2[:], y2[:], z2[:]))
-        ox[:], oy[:], oz[:] = rx, ry, rz
-
     if _interpret():
         from . import curve as DC
 
         return _cpu_point_op(DC.add_unified,
                              [(X1, Y1, Z1), (X2, Y2, Z2)], E)
     return pl.pallas_call(
-        kern,
+        _kern_add,
         grid=(W // tw,),
         in_specs=[_pspec()] + [_espec(E, S, tw)] * 6,
         out_specs=[_espec(E, S, tw)] * 3,
@@ -434,15 +458,11 @@ def _sub_call(A, B, E):
     S, W = A.shape[-2:]
     tw = min(TW, W)
 
-    def kern(pref, a, b, o):
-        _PCOL[0] = pref[:]
-        o[:] = _unpack(_fq_sub(_pack(a[:]), _pack(b[:])), E)
-
     if _interpret():
         return _rows_to_plane(F.fq_sub(_plane_to_rows(A, E),
                                        _plane_to_rows(B, E)), E)
     return pl.pallas_call(
-        kern,
+        _kern_sub,
         grid=(W // tw,),
         in_specs=[_pspec()] + [_espec(E, S, tw)] * 2,
         out_specs=_espec(E, S, tw),
@@ -464,15 +484,11 @@ def _addp_call(A, B, E):
     S, W = A.shape[-2:]
     tw = min(TW, W)
 
-    def kern(pref, a, b, o):
-        _PCOL[0] = pref[:]
-        o[:] = _unpack(_fq_add(_pack(a[:]), _pack(b[:])), E)
-
     if _interpret():
         return _rows_to_plane(F.fq_add(_plane_to_rows(A, E),
                                        _plane_to_rows(B, E)), E)
     return pl.pallas_call(
-        kern,
+        _kern_addp,
         grid=(W // tw,),
         in_specs=[_pspec()] + [_espec(E, S, tw)] * 2,
         out_specs=_espec(E, S, tw),
@@ -543,16 +559,12 @@ def _mul_call(A, B, E):
     S, W = A.shape[-2:]
     tw = min(TW, W)
 
-    def kern(pref, a, b, o):
-        _PCOL[0] = pref[:]
-        o[:] = _e_mul_many([(a[:], b[:])])[0]
-
     if _interpret():
         ra, rb = _plane_to_rows(A, E), _plane_to_rows(B, E)
         out = F.fq_mont_mul(ra, rb) if E == 1 else F.fq2_mul(ra, rb)
         return _rows_to_plane(out, E)
     return pl.pallas_call(
-        kern,
+        _kern_mul,
         grid=(W // tw,),
         in_specs=[_pspec()] + [_espec(E, S, tw)] * 2,
         out_specs=_espec(E, S, tw),

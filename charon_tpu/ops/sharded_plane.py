@@ -67,12 +67,15 @@ def threshold_aggregate_and_verify_sharded(
     """Fused aggregate+verify, data-parallel over mesh axis "data".
 
     Same contract as plane_agg.threshold_aggregate_and_verify (and the same
-    trust preconditions: partials individually verified upstream, pubkeys
-    subgroup-checked once per cluster lock the way _pk_plane_cached does —
-    the per-step graph deliberately re-validates curve membership of every
-    decompressed point but NOT subgroup membership, which is amortized
-    per-process, not per-slot); validators are sharded over the mesh.
-    Returns (compressed aggregates, all_valid).
+    trust preconditions: partials individually verified upstream). Pubkey
+    validation — infinity rejection + subgroup membership, which RLC
+    soundness requires — runs through plane_agg._pk_plane_cached below:
+    once per distinct pubkey set per process (a cluster's validator set is
+    static between reconfigurations), not per slot. The per-step sharded
+    graph re-validates curve membership of every decompressed point but
+    relies on that amortized subgroup check. Validators are sharded over
+    the mesh. Returns (compressed aggregates, all_valid); raises ValueError
+    on an invalid or out-of-subgroup pubkey, like the single-chip path.
     """
     from jax.sharding import NamedSharding, PartitionSpec as P
 
@@ -81,6 +84,9 @@ def threshold_aggregate_and_verify_sharded(
         raise ValueError("length mismatch")
     if V == 0:
         return [], True
+    # reject-infinity + subgroup-check the pk set (content-digest cached —
+    # one validation per process per pubkey set, advisor round-3 medium)
+    PA._pk_plane_cached([bytes(p) for p in pks], PA._bucket(V))
     D = mesh.devices.size
     T = max(len(b) for b in batches)
     if T == 0:

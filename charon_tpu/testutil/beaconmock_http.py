@@ -50,6 +50,7 @@ class HTTPBeaconMock:
         r.add_post("/eth/v1/beacon/pool/attestations", self._sub_atts)
         r.add_post("/eth/v1/beacon/blocks", self._sub_block)
         r.add_post("/eth/v2/beacon/blocks", self._sub_block)
+        r.add_post("/eth/v1/beacon/blinded_blocks", self._sub_block)
         r.add_post("/eth/v1/validator/aggregate_and_proofs", self._sub_aggs)
         r.add_post("/eth/v1/beacon/pool/sync_committees", self._sub_msgs)
         r.add_post("/eth/v1/validator/contribution_and_proofs", self._sub_contribs)

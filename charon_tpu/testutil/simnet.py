@@ -43,6 +43,7 @@ class SimNode:
     retryer: retry_util.Retryer
     consensus: object = None
     tcp_node: object = None
+    fetch: object = None  # fetcher.Fetcher (builder-gate access for tests)
     tasks: list[asyncio.Task] = field(default_factory=list)
 
     async def start(self) -> None:
@@ -202,4 +203,4 @@ def _build_node(idx: int, keys: KeyShares, beacon: BeaconMock, chain,
         sched.subscribe_slots(vmock.on_slot)
 
     return SimNode(idx, keys, sched, vapi, vmock, duty_db, parsig_db,
-                   aggsig_db, retryer, consensus)
+                   aggsig_db, retryer, consensus, fetch=fetch)

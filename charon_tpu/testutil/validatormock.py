@@ -105,3 +105,109 @@ class ValidatorMock:
         if not selections:
             return []
         return await self._vapi.aggregate_beacon_committee_selections(selections)
+
+
+class HTTPBootstrapValidatorMock:
+    """A validator client that learns EVERYTHING over HTTP — the honest
+    bootstrap a REAL (non-mock) VC performs against this node.
+
+    Holds only what a real VC holds: its share keystores (secrets) and the
+    beacon-API base URL. Cluster topology is DISCOVERED, never handed over
+    in-process: validators come from GET states/{id}/validators with the
+    VC's share pubkeys (the reference's share⇄DV translation surface,
+    core/validatorapi/router.go:117-126), duties are posted with
+    spec-standard decimal INDEX bodies, and builder mode is read from
+    /proposer_config. The in-process ValidatorMock above gets keys
+    directly, which is why it can never catch a broken identity surface.
+    """
+
+    def __init__(self, client, share_secrets: list[tbls.PrivateKey],
+                 chain: spec.ChainSpec):
+        self._c = client
+        self._chain = chain
+        self._secrets: dict[bytes, tbls.PrivateKey] = {
+            bytes(tbls.secret_to_public_key(sk)): sk for sk in share_secrets}
+        self.index_to_share: dict[int, bytes] = {}
+        self.builder_enabled = False
+
+    async def bootstrap(self) -> list[dict]:
+        """Discover our validators + proposer config over HTTP. A VC that
+        gets zero records here idles forever — the failure mode this mock
+        exists to catch."""
+        ids = ["0x" + pk.hex() for pk in self._secrets]
+        recs = await self._c.get_validators(ids)
+        self.index_to_share = {}
+        for r in recs:
+            pk = bytes.fromhex(r["validator"]["pubkey"][2:])
+            if pk not in self._secrets:
+                raise errors.new("vapi returned a pubkey we do not hold")
+            self.index_to_share[int(r["index"])] = pk
+        cfg = await self._c.proposer_config()
+        mine = [cfg["proposers"].get("0x" + pk.hex()) for pk in self._secrets]
+        self.builder_enabled = any(
+            p and p["builder"]["enabled"] for p in mine)
+        return recs
+
+    async def on_slot(self, slot_obj) -> None:
+        try:
+            await self.attest(slot_obj.slot)
+        except Exception as exc:  # noqa: BLE001 — lenient like a real VC
+            _log.warn("http vmock attest failed", err=exc, slot=slot_obj.slot)
+        try:
+            await self.propose(slot_obj.slot)
+        except Exception as exc:  # noqa: BLE001
+            _log.warn("http vmock propose failed", err=exc, slot=slot_obj.slot)
+
+    async def attest(self, slot: int) -> None:
+        """Spec-standard flow: duties by INDEX body, share pubkeys in the
+        response route back to our keystores."""
+        if not self.index_to_share:
+            await self.bootstrap()
+        epoch = self._chain.epoch_of(slot)
+        out = await self._c.raw(
+            "POST", f"/eth/v1/validator/duties/attester/{epoch}",
+            json_body=[str(i) for i in sorted(self.index_to_share)])
+        from ..eth2 import json_codec as jc
+
+        duties = [jc.decode_attester_duty(o) for o in out["data"]]
+        atts = []
+        for duty in duties:
+            if duty.slot != slot:
+                continue
+            secret = self._secrets[bytes(duty.pubkey)]
+            data = await self._c.attestation_data(slot, duty.committee_index)
+            bits = [False] * duty.committee_length
+            bits[duty.validator_committee_index] = True
+            unsigned = spec.Attestation(bits, data, b"\x00" * 96)
+            root = SignedAttestation(unsigned).signing_root(self._chain)
+            atts.append(spec.Attestation(bits, data,
+                                         bytes(tbls.sign(secret, root))))
+        if atts:
+            await self._c.submit_attestations(atts)
+
+    async def propose(self, slot: int) -> None:
+        """Builder-aware proposal: the blinded v1 pair when proposer_config
+        advertised builder mode, the full v2 pair otherwise."""
+        if not self.index_to_share:
+            await self.bootstrap()
+        epoch = self._chain.epoch_of(slot)
+        duties = await self._c.proposer_duties(epoch,
+                                               list(self._secrets))
+        for duty in duties:
+            if duty.slot != slot:
+                continue
+            secret = self._secrets[bytes(duty.pubkey)]
+            randao_root = SignedRandao(epoch).signing_root(self._chain)
+            randao_sig = tbls.sign(secret, randao_root)
+            if self.builder_enabled:
+                block = await self._c.blinded_block_proposal(
+                    slot, bytes(randao_sig))
+            else:
+                block = await self._c.block_proposal(slot, bytes(randao_sig))
+            block_root = SignedProposal(block).signing_root(self._chain)
+            signed = spec.SignedBeaconBlock(
+                block, bytes(tbls.sign(secret, block_root)))
+            if self.builder_enabled:
+                await self._c.submit_blinded_block(signed)
+            else:
+                await self._c.submit_block(signed)
