@@ -87,12 +87,11 @@ def _parse_peers(spec: str | None) -> dict[int, tuple[str, int]]:
     return out
 
 
-def build_parser() -> argparse.ArgumentParser:
-    p = argparse.ArgumentParser(prog="charon-tpu",
-                                description="TPU-native distributed validator middleware")
-    sub = p.add_subparsers(dest="command", required=True)
-
-    run_p = sub.add_parser("run", help="run a charon node")
+def _bind_run_flags(run_p) -> None:
+    """Flags of `run` — shared with the hidden `unsafe run` variant
+    (reference cmd/unsafe.go: same command with test flags; this CLI
+    exposes the test knobs on both, so `unsafe run` is an alias kept
+    for command-surface parity)."""
     run_p.add_argument("--data-dir", dest="data_dir", default=None,
                        help="node data directory (default .charon)")
     run_p.add_argument("--p2p-tcp-address", dest="p2p_tcp_address", default=None,
@@ -131,6 +130,21 @@ def build_parser() -> argparse.ArgumentParser:
     run_p.add_argument("--otlp-address", dest="otlp_address", default=None,
                        help="OTLP/HTTP collector endpoint for trace export "
                             "(reference app/tracer Jaeger seam)")
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="charon-tpu",
+                                description="TPU-native distributed validator middleware")
+    sub = p.add_subparsers(dest="command", required=True)
+
+    run_p = sub.add_parser("run", help="run a charon node")
+    _bind_run_flags(run_p)
+
+    # hidden test-oriented variant (reference cmd/cmd.go:52 newUnsafeCmd):
+    # same flags; kept out of the top-level help
+    unsafe_p = sub.add_parser("unsafe")
+    unsafe_sub = unsafe_p.add_subparsers(dest="unsafe_command", required=True)
+    _bind_run_flags(unsafe_sub.add_parser("run"))
 
     dkg_p = sub.add_parser("dkg", help="participate in a DKG ceremony")
     dkg_p.add_argument("--data-dir", dest="data_dir", default=None,
@@ -224,6 +238,10 @@ def _dispatch(args: argparse.Namespace) -> int:
         return 0
     if args.command == "run":
         return _cmd_run(args)
+    if args.command == "unsafe":
+        if args.unsafe_command == "run":
+            return _cmd_run(args)
+        raise AssertionError(f"unhandled unsafe command {args.unsafe_command}")
     if args.command == "dkg":
         return _cmd_dkg(args)
     if args.command == "create":

@@ -54,12 +54,35 @@ class _Window:
         self._dispatch = dispatch
         self._q: list[tuple[int, object, asyncio.Future]] = []
         self._timer: asyncio.TimerHandle | None = None
+        # close-on-quorum state: per-group DISTINCT contributor sets vs the
+        # expected contributor count the submitter declared (reference
+        # ParSigDB's threshold trigger shape, core/parsigdb/memory.go:100)
+        self._seen: dict[object, set] = {}
+        self._expected: dict[object, int] = {}
+        self._unkeyed = 0
 
-    async def submit(self, size: int, payload):
+    async def submit(self, size: int, payload, key=None,
+                     expected: int | None = None, contributor=None):
+        """Queue one submission. `key`/`expected`/`contributor` enable
+        ADAPTIVE close: when every queued group's declared contributor set
+        has fully arrived (e.g. parsigex sets from all n−1 peers for a
+        duty), the window flushes immediately instead of waiting out the
+        timer — peers arriving over a spread no longer leave the device
+        idle for the fixed window, and a straggler is still bounded by the
+        timer. Contributors are counted DISTINCT (a duplicate/retransmitted
+        set must not trigger a premature flush)."""
         loop = asyncio.get_running_loop()
         fut = loop.create_future()
         self._q.append((size, payload, fut))
-        if sum(s for s, _, _ in self._q) >= self.flush_at:
+        if key is not None and expected:
+            # an anonymous submission still counts once via a unique token
+            token = contributor if contributor is not None else object()
+            self._seen.setdefault(key, set()).add(token)
+            self._expected[key] = expected
+        else:
+            self._unkeyed += 1
+        if (sum(s for s, _, _ in self._q) >= self.flush_at
+                or self._quorum_complete()):
             self._flush()
         elif self._timer is None:
             self._timer = loop.call_later(self.window, self._flush)
@@ -69,11 +92,20 @@ class _Window:
         finally:
             _wait_hist.observe(loop.time() - t0, self.kind)
 
+    def _quorum_complete(self) -> bool:
+        """Every queued submission is group-keyed and every group's expected
+        contributor set has fully arrived (distinct contributors)."""
+        if self._unkeyed or not self._seen:
+            return False
+        return all(len(self._seen[k]) >= self._expected[k]
+                   for k in self._seen)
+
     def _flush(self) -> None:
         if self._timer is not None:
             self._timer.cancel()
             self._timer = None
         reqs, self._q = self._q, []
+        self._seen, self._expected, self._unkeyed = {}, {}, 0
         if reqs:
             asyncio.ensure_future(self._run(reqs))
 
@@ -137,11 +169,15 @@ class TblsCoalescer:
         return await self._agg.submit(
             len(batches), (list(batches), list(pks), list(roots)))
 
-    async def verify(self, pks, roots, sigs) -> bool:
+    async def verify(self, pks, roots, sigs, key=None,
+                     expected: int | None = None, contributor=None) -> bool:
         """Queue one bulk verify (the parsigex inbound path); resolves to
-        the validity of exactly this submission's set."""
+        the validity of exactly this submission's set. key/expected/
+        contributor declare the duty's contributor group for adaptive
+        close-on-quorum (_Window.submit)."""
         return await self._ver.submit(
-            len(sigs), (list(pks), list(roots), list(sigs)))
+            len(sigs), (list(pks), list(roots), list(sigs)),
+            key=key, expected=expected, contributor=contributor)
 
     # ---- fused dispatches ------------------------------------------------
 

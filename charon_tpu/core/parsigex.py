@@ -69,7 +69,15 @@ def new_batch_eth2_verifier(chain: ChainSpec, keys: KeyShares,
             roots.append(data.signing_root(chain))
             sigs.append(psd.signature())
         if coalescer is not None:
-            if await coalescer.verify(pks, roots, sigs):
+            # each of the n−1 other peers broadcasts one set per duty —
+            # declaring that lets the window close as soon as the full
+            # contributor group has arrived (adaptive close-on-quorum);
+            # the sender's share index identifies the contributor so a
+            # retransmitted set can't fake quorum
+            sender = next(iter(parsigs.values())).share_idx
+            if await coalescer.verify(pks, roots, sigs, key=duty,
+                                      expected=keys.num_shares - 1,
+                                      contributor=sender):
                 return
         elif tbls.verify_batch(pks, roots, sigs):
             return

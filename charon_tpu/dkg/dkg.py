@@ -259,6 +259,12 @@ async def _run_frost(node: TCPNode, bcast: SignedBroadcast, inbox: _FrostShares,
         sender + 1: [frost_mod.Round1Broadcast.from_json(o)
                      for o in json.loads(payload.decode())]
         for sender, payload in r1_all.items()}
+    # PoK verification per validator, then ONE batched RLC device sweep for
+    # every (dealer, validator) share-consistency check of the ceremony —
+    # the t×n×V VSS equations are the plane's wide G1 MSM shape
+    # (frost.verify_shares_batch; SURVEY §7 step 8)
+    per_v_broadcasts: list[dict] = []
+    share_checks: list[tuple[int, int, list[bytes]]] = []
     for v in range(num_validators):
         ctx = def_hash + v.to_bytes(4, "big")
         broadcasts = {}
@@ -268,9 +274,14 @@ async def _run_frost(node: TCPNode, bcast: SignedBroadcast, inbox: _FrostShares,
                 raise errors.new("frost broadcast index mismatch", sender=part)
             frost_mod.verify_round1(b, threshold, ctx)
             broadcasts[part] = b
+        per_v_broadcasts.append(broadcasts)
+        for sender, share in inbox.shares[v].items():
+            share_checks.append(
+                (my_part, share, broadcasts[sender].commitments))
+    frost_mod.verify_shares_batch(share_checks)
+    for v in range(num_validators):
+        broadcasts = per_v_broadcasts[v]
         my_shares = inbox.shares[v]
-        for sender, share in my_shares.items():
-            frost_mod.verify_share(my_part, share, broadcasts[sender].commitments)
         result = frost_mod.finalize(my_part, num_nodes, broadcasts, my_shares)
         group_pubkeys.append(bytes(result.group_pubkey))
         share_pubkeys_all.append([bytes(result.share_pubkeys[j])

@@ -159,6 +159,71 @@ def verify_share(my_index: int, share: int, commitments: list[bytes]) -> None:
         raise errors.new("share does not match commitments", index=my_index)
 
 
+# points-per-check below which the device sweep isn't worth its dispatch
+# floor; a 200-validator ceremony is ~1000 commitment points per node round
+_DEVICE_MIN_POINTS = 256
+
+
+def verify_shares_batch(
+        items: list[tuple[int, int, list[bytes]]]) -> None:
+    """Verify MANY share/commitment consistency checks at once — the
+    ceremony hot spot (BASELINE config 4; reference dkg/frost.go verifies
+    per share via kryptology on the CPU).
+
+    items: (my_index, share, commitments) triples, one per (dealer,
+    validator) pair. The M checks  f_m·G − Σ_k C_mk·x_m^k == ∞  collapse
+    under random weights r_m (RLC, 2^-RLC_BITS soundness like
+    rlc_verify_batch) into ONE equation
+        (Σ_m r_m·f_m)·G  −  Σ_m Σ_k (r_m·x_m^k)·C_mk  ==  ∞
+    i.e. a single wide G1 MSM — one device sweep for the whole ceremony
+    round instead of M native lincombs. On failure (or off-device) falls
+    back to per-item verify_share so the offending dealer is attributed
+    exactly as before. Raises like verify_share."""
+    total = sum(len(c) for _, _, c in items)
+    use_device = total >= _DEVICE_MIN_POINTS
+    if use_device:
+        from ..ops import pallas_plane as PP
+
+        use_device = not PP._interpret()
+    if use_device:
+        from ..ops import plane_agg
+
+        points, scalars = _rlc_share_equation(items)
+        try:
+            if plane_agg.g1_lincomb_is_infinity(points, scalars):
+                return
+        except ValueError:
+            pass  # invalid encoding: attribute below
+    for my_index, share, commitments in items:
+        verify_share(my_index, share, commitments)
+
+
+def _rlc_share_equation(
+        items: list[tuple[int, int, list[bytes]]],
+        rand=None) -> tuple[list[bytes], list[int]]:
+    """Assemble the single-MSM RLC equation of verify_shares_batch:
+    returns (points, scalars) with Σ kᵢ·Pᵢ == ∞ iff (whp over the rₘ)
+    every check holds. Split out so the equation algebra is unit-testable
+    against the native lincomb without a device."""
+    from ..crypto.rlc import sample_randomizer
+
+    rand = rand or sample_randomizer
+    points: list[bytes] = []
+    scalars: list[int] = []
+    gen_scalar = 0
+    for my_index, share, commitments in items:
+        r = rand()
+        gen_scalar = (gen_scalar + r * share) % F.R
+        x = 1
+        for c in commitments:
+            points.append(c)
+            scalars.append((-r * x) % F.R)
+            x = (x * my_index) % F.R
+    points.append(_g1_mul_gen(1))
+    scalars.append(gen_scalar)
+    return points, scalars
+
+
 @dataclass
 class KeygenResult:
     share_secret: tbls.PrivateKey          # x_j

@@ -56,10 +56,21 @@ def _lagrange(ids: tuple[int, ...]) -> tuple[int, ...]:
 
 
 def _bucket(n: int) -> int:
-    b = PP.TILE
-    while b < n:
-        b *= 2
-    return b
+    """Batch -> plane bucket; shares pad_batch's sub-tile buckets so a
+    small slot's plane (and with it the whole fused graph) shrinks with
+    the batch instead of flooring at one full 1024 tile."""
+    return PP.pad_batch(n)
+
+
+def _bucket_for_slots(V: int, T: int) -> int:
+    """Per-validator bucket whose T-slot combined plane (Vp·T elements)
+    is ITSELF a valid padded size — the permuted slot layout addresses the
+    combined plane directly, so its width must land exactly on a bucket."""
+    step = min(PP.TILE, PP.MIN_TILE)
+    Vp = _bucket(V)
+    while PP.pad_batch(Vp * T) != Vp * T:
+        Vp += step
+    return Vp
 
 
 def _native_lib():
@@ -631,7 +642,7 @@ def _layout_slots(batches: list[dict[int, bytes]], Vp: int | None = None,
     if T == 0:
         raise ValueError("empty partial signature set")
     if Vp is None:
-        Vp = _bucket(V)
+        Vp = _bucket_for_slots(V, T)
     zero96 = b"\xc0" + bytes(95)  # compressed infinity
 
     Wv = Vp // PP.SUB
@@ -903,6 +914,30 @@ def _pk_plane_cached(pks: list[bytes], Bp: int) -> PP.PlanePoint:
         _PK_PLANE_CACHE.pop(key)
     _PK_PLANE_CACHE[key] = plane
     return plane
+
+
+def g1_lincomb_is_infinity(points: list[bytes], scalars: list[int]) -> bool:
+    """Σ kᵢ·Pᵢ == ∞ over compressed G1 points with PER-POINT 256-bit
+    scalars, as one windowed MSM sweep + reduce on the device. This is the
+    FROST ceremony's batched share-verification check (dkg/frost.py
+    verify_shares_batch): the t×n VSS consistency equations collapse under
+    an RLC into exactly this wide-batch G1 MSM — the shape the plane is
+    built for (SURVEY §7 step 8; reference dkg/frost.go:50-86 verifies
+    share-by-share on the CPU instead). Raises ValueError on an invalid
+    point encoding; subgroup checks are unnecessary for the ∞ comparison's
+    soundness here because the commitments are themselves the values being
+    verified (a commitment outside the subgroup fails the per-item
+    fallback attribution the caller runs on False)."""
+    n = len(points)
+    if n == 0:
+        return True
+    if len(scalars) != n:
+        raise ValueError("length mismatch")
+    Bp = _bucket(n)
+    plane = g1_plane_from_compressed([bytes(p) for p in points], Bp)
+    digits = PP.scalars_to_digitplanes([s % PF.R for s in scalars], Bp)
+    S = PP.msm_sum(plane, digits)
+    return jac_is_infinity(FqOps, S)
 
 
 def rlc_verify_batch(pks: list[bytes], msgs: list[bytes], sigs: list[bytes],

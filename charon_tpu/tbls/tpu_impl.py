@@ -38,6 +38,30 @@ from .native_impl import NativeImpl
 from .types import PublicKey, Signature
 
 
+def _device_runtime_errors() -> tuple:
+    """Exception types meaning the DEVICE (or its remote tunnel) failed at
+    runtime — distinct from input-validation ValueErrors, which must
+    propagate. A transient device fault must not fail a duty: the batch
+    falls back to the native CPU path (same results, slower), like the
+    reference's tolerance of individual BN failures."""
+    try:
+        import jax
+
+        return (jax.errors.JaxRuntimeError,)
+    except Exception:  # noqa: BLE001 — no jax, no device errors
+        return ()
+
+
+_DEVICE_RUNTIME_ERRORS = _device_runtime_errors()
+
+
+def _warn_device_fallback(op: str, exc: Exception) -> None:
+    from ..utils import log
+
+    log.with_topic("tbls").warn("device dispatch failed; native fallback",
+                                op=op, err=str(exc)[:200])
+
+
 def _on_device() -> bool:
     import jax
 
@@ -58,6 +82,9 @@ class TPUImpl(NativeImpl):
     # duties up to these sizes.
     min_device_batch = 64     # threshold_aggregate paths
     min_device_verify = 128   # verify_batch
+    # benches set False so a device/tunnel fault raises (and can be
+    # retried) instead of silently timing the native path
+    fallback_on_device_error = True
 
     def threshold_aggregate_batch(self, batches: list[dict[int, Signature]]
                                   ) -> list[Signature]:
@@ -68,8 +95,14 @@ class TPUImpl(NativeImpl):
                 raise ValueError("no partial signatures to aggregate")
         from ..ops import plane_agg
 
-        raw = plane_agg.threshold_aggregate_batch(
-            [{i: bytes(s) for i, s in b.items()} for b in batches])
+        try:
+            raw = plane_agg.threshold_aggregate_batch(
+                [{i: bytes(s) for i, s in b.items()} for b in batches])
+        except _DEVICE_RUNTIME_ERRORS as exc:
+            if not self.fallback_on_device_error:
+                raise
+            _warn_device_fallback("threshold_aggregate_batch", exc)
+            return NativeImpl.threshold_aggregate_batch(self, batches)
         return [Signature(r) for r in raw]
 
     def verify_batch(self, public_keys: list[PublicKey], datas: list[bytes],
@@ -86,9 +119,16 @@ class TPUImpl(NativeImpl):
         # semantics.
         from ..ops import plane_agg
 
-        return plane_agg.rlc_verify_batch(
-            [bytes(pk) for pk in public_keys], [bytes(d) for d in datas],
-            [bytes(s) for s in signatures])
+        try:
+            return plane_agg.rlc_verify_batch(
+                [bytes(pk) for pk in public_keys], [bytes(d) for d in datas],
+                [bytes(s) for s in signatures])
+        except _DEVICE_RUNTIME_ERRORS as exc:
+            if not self.fallback_on_device_error:
+                raise
+            _warn_device_fallback("verify_batch", exc)
+            return NativeImpl.verify_batch(self, public_keys, datas,
+                                           signatures)
 
     def threshold_aggregate_verify_batch(self, batches, public_keys, datas):
         """Fused device pass: the RLC verification consumes the freshly
@@ -106,9 +146,16 @@ class TPUImpl(NativeImpl):
                 raise ValueError("no partial signatures to aggregate")
         from ..ops import plane_agg
 
-        raw, ok = plane_agg.threshold_aggregate_and_verify(
-            [{i: bytes(s) for i, s in b.items()} for b in batches],
-            [bytes(pk) for pk in public_keys], [bytes(d) for d in datas])
+        try:
+            raw, ok = plane_agg.threshold_aggregate_and_verify(
+                [{i: bytes(s) for i, s in b.items()} for b in batches],
+                [bytes(pk) for pk in public_keys], [bytes(d) for d in datas])
+        except _DEVICE_RUNTIME_ERRORS as exc:
+            if not self.fallback_on_device_error:
+                raise
+            _warn_device_fallback("threshold_aggregate_verify_batch", exc)
+            return NativeImpl.threshold_aggregate_verify_batch(
+                self, batches, public_keys, datas)
         return [Signature(r) for r in raw], ok
 
     def verify_batch_each(self, public_keys: list[PublicKey],

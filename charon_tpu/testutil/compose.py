@@ -54,6 +54,9 @@ class ComposeCluster:
     slots_per_epoch: int = 8
     p2p_fuzz: dict[int, float] = field(default_factory=dict)
     beacon_fuzz: float = 0.0
+    # False = production committee shape: each validator attests ONE slot
+    # per epoch (the scale tests' load model; True is the dense smoke shape)
+    attest_all_every_slot: bool = True
 
     mock: BeaconMock = None
     server: HTTPBeaconMock = None
@@ -96,7 +99,8 @@ class ComposeCluster:
             [v.public_key for v in lock.validators],
             genesis_time=time.time() + 2.0,
             seconds_per_slot=self.seconds_per_slot,
-            slots_per_epoch=self.slots_per_epoch)
+            slots_per_epoch=self.slots_per_epoch,
+            attest_all_every_slot=self.attest_all_every_slot)
         self.mock.fuzz = self.beacon_fuzz
         self.server = HTTPBeaconMock(self.mock)
         await self.server.start()

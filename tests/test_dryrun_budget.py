@@ -1,0 +1,47 @@
+"""Driver-artifact guard: the multichip dryrun must COLD-compile and run
+inside the driver's budget on one core (round-3 verdict item 1 — the code
+was correct but MULTICHIP_r03.json is rc=124 because the sharded graphs
+cold-compiled for ~25 min on the driver host; three rounds of official
+artifacts have now failed in the driver's environment, not the builder's).
+
+This runs EXACTLY what the driver runs — `dryrun_multichip(8)` from a
+process without 8 devices, which re-execs the compile-lean subprocess with
+a fresh (throwaway) compilation cache — under a hard timeout well inside
+the driver's. A kernel edit that regresses compile time fails HERE, in CI,
+instead of silently killing the next round's artifact."""
+
+import os
+import subprocess
+import sys
+import tempfile
+import time
+from pathlib import Path
+
+import pytest
+
+REPO = Path(__file__).resolve().parents[1]
+BUDGET_S = 900  # hard cap; driver rc=124 killed ~3000s runs
+
+
+@pytest.mark.scale
+def test_dryrun_multichip_cold_budget():
+    env = dict(os.environ)
+    # throwaway cache => a genuinely cold XLA:CPU compile, like a fresh
+    # driver host (the machine-keyed persistent cache would otherwise hide
+    # a compile-time regression on THIS box)
+    env["JAX_COMPILATION_CACHE_DIR"] = tempfile.mkdtemp(prefix="dryrun_cold_")
+    env["CHARON_TPU_COMPILE_LEAN"] = "1"
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "")
+                        + " --xla_force_host_platform_device_count=8").strip()
+    t0 = time.monotonic()
+    res = subprocess.run(
+        [sys.executable, str(REPO / "__graft_entry__.py"), "dryrun", "8"],
+        env=env, cwd=str(REPO), capture_output=True, text=True,
+        timeout=BUDGET_S)
+    elapsed = time.monotonic() - t0
+    assert res.returncode == 0, (
+        f"dryrun failed rc={res.returncode} after {elapsed:.0f}s:\n"
+        + res.stdout[-2000:] + res.stderr[-2000:])
+    assert "dryrun_multichip OK" in res.stdout, res.stdout[-2000:]
+    print(f"cold dryrun completed in {elapsed:.0f}s (budget {BUDGET_S}s)")

@@ -258,3 +258,242 @@ def test_simulation_random_delays(seed):
 
 def test_late_joiner_catches_up_via_decided():
     _run(_impl_test_late_joiner_catches_up_via_decided())
+
+
+# -- adversarial schedule matrix (reference qbft_internal_test.go:19-180
+# TestQBFT table + strategysim shapes: staggered starts, leader outages,
+# lossy fabrics, const vs increasing timers, eager-double-linear A/B) ------
+
+
+class LossyFabric(Fabric):
+    """Fabric dropping each delivered copy with probability `loss` (never
+    the sender's own copy — local delivery is in-process)."""
+
+    def __init__(self, n, *, loss=0.0, seed=0, **kw):
+        super().__init__(n, seed=seed, **kw)
+        self.loss = loss
+
+    def transport(self, process):
+        async def broadcast(msg: Msg):
+            if process in self.dead:
+                return
+            for p, q in self.queues.items():
+                if p == process:
+                    q.put_nowait(msg)
+                elif self.rng.random() >= self.loss:
+                    if self.delay is None:
+                        q.put_nowait(msg)
+                    else:
+                        d = self.rng.uniform(0, self.delay)
+                        asyncio.get_running_loop().call_later(
+                            d, q.put_nowait, msg)
+
+        return Transport(broadcast, self.queues[process])
+
+
+async def _run_schedule(n, fabric, *, start_delay=None, timer="increasing",
+                        timer_base=0.05, timeout=25.0, values=None):
+    """Run a full cluster under a start-delay schedule; returns decided
+    map. Mirrors the reference testQBFT harness knobs (StartDelay,
+    ConstPeriod)."""
+    decided = {p: [] for p in range(1, n + 1)}
+    values = values or {p: f"value-from-{p}" for p in range(1, n + 1)}
+
+    def mk_def(p):
+        if timer == "const":
+            # constant round period (the reference's ConstPeriod knob)
+            def new_timer(_r):
+                async def wait():
+                    await asyncio.sleep(timer_base * 3)
+                return wait, lambda: None
+            nt = new_timer
+        else:
+            nt = qbft.increasing_round_timer(base=timer_base, inc=timer_base)
+        return Definition(
+            is_leader=lambda inst, r, pp: (r - 1) % n + 1 == pp,
+            new_timer=nt,
+            decide=lambda inst, value, qc, _p=p: decided[_p].append(value),
+            nodes=n)
+
+    async def start_one(p):
+        if start_delay and p in start_delay:
+            await asyncio.sleep(start_delay[p])
+        await qbft.run(mk_def(p), fabric.transport(p), "inst", p, values[p])
+
+    tasks = [asyncio.create_task(start_one(p)) for p in range(1, n + 1)]
+    try:
+        async def all_decided():
+            while any(not decided[p] for p in range(1, n + 1)
+                      if p not in fabric.dead):
+                await asyncio.sleep(0.01)
+        await asyncio.wait_for(all_decided(), timeout)
+    finally:
+        for t in tasks:
+            t.cancel()
+        await asyncio.gather(*tasks, return_exceptions=True)
+    return decided
+
+
+def _assert_agreement(decided, fabric=None):
+    dead = fabric.dead if fabric else set()
+    vals = {tuple(v) for p, v in decided.items() if p not in dead}
+    assert len(vals) == 1, f"disagreement: {decided}"
+    assert len(next(iter(vals))) == 1, f"multiple decisions: {decided}"
+
+
+SCHEDULES = [
+    # (name, start_delay, timer)  — the reference's TestQBFT rows
+    ("leader_late_exp", {1: 0.4}, "increasing"),
+    ("leader_late_const", {1: 0.4}, "const"),
+    ("very_late_exp", {1: 0.5, 2: 1.0}, "increasing"),
+    ("very_late_const", {1: 0.5, 2: 1.0}, "const"),
+    ("stagger_start_exp", {1: 0.0, 2: 0.1, 3: 0.2, 4: 0.3}, "increasing"),
+    ("stagger_start_const", {1: 0.0, 2: 0.1, 3: 0.2, 4: 0.3}, "const"),
+]
+
+
+@pytest.mark.parametrize("name,delays,timer", SCHEDULES,
+                         ids=[s[0] for s in SCHEDULES])
+def test_schedule_matrix(name, delays, timer):
+    async def impl():
+        fabric = Fabric(4)
+        decided = await _run_schedule(4, fabric, start_delay=delays,
+                                      timer=timer)
+        _assert_agreement(decided)
+
+    _run(impl())
+
+
+@pytest.mark.parametrize("loss", [0.1, 0.3])
+@pytest.mark.parametrize("seed", [1, 2, 3])
+def test_lossy_fabric_terminates_with_agreement(loss, seed):
+    """Per-message loss (the strategysim adversary): liveness + agreement
+    must survive 10-30% drop rates via round-change retransmission."""
+
+    async def impl():
+        fabric = LossyFabric(4, loss=loss, seed=seed)
+        decided = await _run_schedule(4, fabric, timeout=30.0)
+        _assert_agreement(decided)
+
+    _run(impl())
+
+
+def test_leaders_of_first_two_rounds_absent():
+    """The leaders of rounds 1 AND 2 start so late the cluster must
+    round-change TWICE before a present leader proposes (deeper
+    round-change path than the single-dead-leader case; quorum stays
+    intact — with two nodes fully dead n=4 cannot decide at all)."""
+
+    async def impl():
+        fabric = Fabric(4)
+        decided = await _run_schedule(
+            4, fabric, start_delay={1: 3.0, 2: 3.0}, timeout=30.0)
+        _assert_agreement(decided)
+
+    _run(impl())
+
+
+def test_duplicate_messages_are_idempotent():
+    """Every broadcast delivered TWICE: duplicate-rule suppression must
+    keep the algorithm correct (reference TestDuplicatePrePreparesRules)."""
+
+    class DupFabric(Fabric):
+        def transport(self, process):
+            async def broadcast(msg):
+                for q in self.queues.values():
+                    q.put_nowait(msg)
+                    q.put_nowait(msg)
+
+            return Transport(broadcast, self.queues[process])
+
+    async def impl():
+        fabric = DupFabric(4)
+        decided = await _run_schedule(4, fabric)
+        _assert_agreement(decided)
+
+    _run(impl())
+
+
+# -- formula unit tests (reference TestIsJustifiedPrePrepare / TestFormulas
+# qbft_internal_test.go:594-700) -------------------------------------------
+
+
+def _defn(n=4):
+    return Definition(is_leader=lambda i, r, p: (r - 1) % n + 1 == p,
+                      new_timer=None, decide=None, nodes=n)
+
+
+class TestJustificationFormulas:
+    def test_round1_pre_prepare_from_leader_is_justified(self):
+        d = _defn()
+        m = Msg(MsgType.PRE_PREPARE, "i", source=1, round=1, value="v")
+        assert qbft.is_justified_pre_prepare(d, "i", m)
+
+    def test_round1_pre_prepare_from_non_leader_rejected(self):
+        d = _defn()
+        m = Msg(MsgType.PRE_PREPARE, "i", source=3, round=1, value="v")
+        assert not qbft.is_justified_pre_prepare(d, "i", m)
+
+    def test_round2_pre_prepare_needs_qrc_justification(self):
+        d = _defn()
+        bare = Msg(MsgType.PRE_PREPARE, "i", source=2, round=2, value="v")
+        assert not qbft.is_justified_pre_prepare(d, "i", bare)
+        rcs = tuple(Msg(MsgType.ROUND_CHANGE, "i", source=s, round=2)
+                    for s in (1, 2, 3))
+        j = Msg(MsgType.PRE_PREPARE, "i", source=2, round=2, value="v",
+                justification=rcs)
+        assert qbft.is_justified_pre_prepare(d, "i", j)
+
+    def test_round2_pre_prepare_must_follow_prepared_value(self):
+        """QRC containing a prepared value binds the new leader to it: a
+        PRE-PREPARE proposing a DIFFERENT value is unjustified."""
+        d = _defn()
+        prepares = tuple(Msg(MsgType.PREPARE, "i", source=s, round=1,
+                             value="locked") for s in (1, 2, 3))
+        rcs = tuple(
+            Msg(MsgType.ROUND_CHANGE, "i", source=s, round=2,
+                prepared_round=1, prepared_value="locked",
+                justification=prepares)
+            for s in (1, 2, 3))
+        # the wire justification is qrc + prepares FLATTENED, the shape
+        # get_justified_qrc emits (J2)
+        just = rcs + prepares
+        good = Msg(MsgType.PRE_PREPARE, "i", source=2, round=2,
+                   value="locked", justification=just)
+        evil = Msg(MsgType.PRE_PREPARE, "i", source=2, round=2,
+                   value="hijack", justification=just)
+        assert qbft.is_justified_pre_prepare(d, "i", good)
+        assert not qbft.is_justified_pre_prepare(d, "i", evil)
+
+    def test_decided_needs_quorum_commits(self):
+        d = _defn()
+        commits = tuple(Msg(MsgType.COMMIT, "i", source=s, round=1,
+                            value="v") for s in (1, 2, 3))
+        ok = Msg(MsgType.DECIDED, "i", source=1, round=1, value="v",
+                 justification=commits)
+        assert qbft.is_justified_decided(d, ok)
+        short = Msg(MsgType.DECIDED, "i", source=1, round=1, value="v",
+                    justification=commits[:2])
+        assert not qbft.is_justified_decided(d, short)
+        mixed = Msg(MsgType.DECIDED, "i", source=1, round=1, value="v",
+                    justification=commits[:2] + (
+                        Msg(MsgType.COMMIT, "i", source=4, round=1,
+                            value="OTHER"),))
+        assert not qbft.is_justified_decided(d, mixed)
+
+    def test_next_min_round_and_f_plus_1(self):
+        d = _defn()
+        rcs = [Msg(MsgType.ROUND_CHANGE, "i", source=s, round=r)
+               for s, r in ((1, 3), (2, 5))]
+        assert qbft.next_min_round(d, rcs, 1) == 3
+        frc = qbft.get_f_plus_1_round_changes(d, rcs, 1)
+        assert frc is not None and len(frc) == d.faulty + 1
+
+    def test_duplicate_sources_do_not_count_twice(self):
+        """A quorum must be over DISTINCT processes: the same source
+        repeated must not satisfy quorum (agreement-critical)."""
+        d = _defn()
+        same = [Msg(MsgType.PREPARE, "i", source=2, round=1, value="v")
+                for _ in range(4)]
+        quorums = qbft.get_prepare_quorums(d, same)
+        assert quorums == []

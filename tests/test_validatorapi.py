@@ -1,0 +1,355 @@
+"""ValidatorAPI Component unit depth: the reference's table-driven error
+and verification matrix (core/validatorapi/validatorapi_test.go — valid +
+invalid submissions per duty type, wrong-share signatures, identity
+translation, registration root-rewrite, proposer config) driven directly
+against the in-process Component with a beaconmock."""
+
+import asyncio
+import dataclasses
+
+import pytest
+
+from charon_tpu import tbls
+from charon_tpu.core import aggsigdb, dutydb
+from charon_tpu.core.keyshares import new_cluster_for_t
+from charon_tpu.core.signeddata import (
+    BeaconCommitteeSelection,
+    SignedAttestation,
+    SignedExit,
+    SignedProposal,
+    SignedRandao,
+    SignedRegistration,
+)
+from charon_tpu.core.types import Duty, DutyType, pubkey_to_bytes
+from charon_tpu.core.unsigneddata import AttestationDataUnsigned, ProposalUnsigned
+from charon_tpu.core.validatorapi import Component
+from charon_tpu.eth2 import spec
+from charon_tpu.testutil.beaconmock import BeaconMock
+from charon_tpu.utils.errors import CharonError
+
+N_VALS, THRESHOLD, N_NODES = 2, 2, 3
+
+
+class Harness:
+    def __init__(self):
+        self.root_secrets, nodes = new_cluster_for_t(
+            N_VALS, THRESHOLD, N_NODES)
+        self.keys = nodes[0]  # we are node 1 (share_idx 1)
+        self.beacon = BeaconMock(
+            [bytes(pubkey_to_bytes(r)) for r in self.keys.root_pubkeys],
+            genesis_time=0.0)
+        self.chain = self.beacon._spec
+        self.dutydb = dutydb.MemDB()
+        self.aggsigdb = aggsigdb.MemDB()
+        self.emitted = []  # (duty, parsigs)
+        self.comp = Component(self.beacon, self.dutydb, self.aggsigdb,
+                              self.keys, self.chain,
+                              fee_recipient=lambda pk: "0x" + "ee" * 20)
+
+        async def capture(duty, parsigs):
+            self.emitted.append((duty, parsigs))
+
+        self.comp.subscribe(capture)
+
+    def share_secret(self, root):
+        return self.keys.my_share_secrets[root]
+
+    def root(self, i=0):
+        return self.keys.root_pubkeys[i]
+
+    async def seed_attestation(self, slot=1, committee_index=0,
+                               val_committee_index=0, root_i=0):
+        duty_obj = spec.AttesterDuty(
+            pubkey=bytes(pubkey_to_bytes(self.root(root_i))),
+            slot=slot, validator_index=root_i, committee_index=committee_index,
+            committee_length=2, committees_at_slot=1,
+            validator_committee_index=val_committee_index)
+        data = await self.beacon.attestation_data(slot, committee_index)
+        await self.dutydb.store(
+            Duty(slot, DutyType.ATTESTER),
+            {self.root(root_i): AttestationDataUnsigned(data, duty_obj)})
+        return duty_obj, data
+
+    def signed_attestation(self, duty_obj, data, secret=None):
+        bits = [False] * duty_obj.committee_length
+        bits[duty_obj.validator_committee_index] = True
+        unsigned = spec.Attestation(bits, data, b"\x00" * 96)
+        root = SignedAttestation(unsigned).signing_root(self.chain)
+        secret = secret or self.share_secret(self.root())
+        return spec.Attestation(bits, data, bytes(tbls.sign(secret, root)))
+
+
+def _run(coro, timeout=60):
+    async def wrapped():
+        return await asyncio.wait_for(coro, timeout)
+
+    return asyncio.run(wrapped())
+
+
+class TestSubmitAttestations:
+    def test_valid_submission_emits_parsig(self):
+        async def run():
+            h = Harness()
+            duty_obj, data = await h.seed_attestation()
+            att = h.signed_attestation(duty_obj, data)
+            await h.comp.submit_attestations([att])
+            assert len(h.emitted) == 1
+            duty, parsigs = h.emitted[0]
+            assert duty == Duty(1, DutyType.ATTESTER)
+            assert h.root() in parsigs
+            assert parsigs[h.root()].share_idx == 1
+
+        _run(run())
+
+    def test_resubmission_is_accepted(self):
+        """A VC may retry a submission; the component re-emits (dedup is
+        ParSigDB's job), never errors."""
+
+        async def run():
+            h = Harness()
+            duty_obj, data = await h.seed_attestation()
+            att = h.signed_attestation(duty_obj, data)
+            await h.comp.submit_attestations([att])
+            await h.comp.submit_attestations([att])
+            assert len(h.emitted) == 2
+
+        _run(run())
+
+    @pytest.mark.parametrize("nbits", [0, 2])
+    def test_wrong_aggregation_bit_count_rejected(self, nbits):
+        async def run():
+            h = Harness()
+            duty_obj, data = await h.seed_attestation()
+            att = h.signed_attestation(duty_obj, data)
+            bits = [True] * nbits + [False] * (2 - nbits)
+            bad = spec.Attestation(bits, att.data, att.signature)
+            with pytest.raises(CharonError):
+                await h.comp.submit_attestations([bad])
+            assert not h.emitted
+
+        _run(run())
+
+    def test_wrong_share_signature_rejected(self):
+        """Signed with ANOTHER node's share: partial verification against
+        THIS node's share pubkey must fail (validatorapi_test.go
+        SubmitAttestations_Verify negative case)."""
+
+        async def run():
+            h = Harness()
+            duty_obj, data = await h.seed_attestation()
+            wrong = tbls.threshold_split(
+                h.root_secrets[0], N_NODES, THRESHOLD)[2]  # node 2's share
+            att = h.signed_attestation(duty_obj, data, secret=wrong)
+            with pytest.raises(CharonError):
+                await h.comp.submit_attestations([att])
+            assert not h.emitted
+
+        _run(run())
+
+    def test_unknown_committee_position_rejected(self):
+        async def run():
+            h = Harness()
+            duty_obj, data = await h.seed_attestation()
+            moved = dataclasses.replace(duty_obj, validator_committee_index=1)
+            att = h.signed_attestation(moved, data)
+            with pytest.raises(CharonError):
+                await h.comp.submit_attestations([att])
+
+        _run(run())
+
+    def test_garbage_signature_rejected(self):
+        async def run():
+            h = Harness()
+            duty_obj, data = await h.seed_attestation()
+            att = h.signed_attestation(duty_obj, data)
+            bad = spec.Attestation(att.aggregation_bits, att.data, b"\xaa" * 96)
+            with pytest.raises(CharonError):
+                await h.comp.submit_attestations([bad])
+
+        _run(run())
+
+
+class TestBlockProposal:
+    async def _seed_block(self, h, slot=1, blinded=False, root_i=0):
+        block = spec.BeaconBlock(
+            slot=slot, proposer_index=root_i, parent_root=b"\x01" * 32,
+            state_root=b"\x02" * 32, body_root=b"\x03" * 32,
+            blinded=blinded)
+        await h.dutydb.store(Duty(slot, DutyType.PROPOSER),
+                             {h.root(root_i): ProposalUnsigned(block)})
+        return block
+
+    def _randao(self, h, slot):
+        epoch = h.chain.epoch_of(slot)
+        root = SignedRandao(epoch).signing_root(h.chain)
+        return bytes(tbls.sign(h.share_secret(h.root()), root))
+
+    def test_full_proposal_roundtrip(self):
+        async def run():
+            h = Harness()
+            await self._seed_block(h, blinded=False)
+            got = await h.comp.block_proposal(1, self._randao(h, 1))
+            assert not got.blinded
+            # randao partial was emitted on the way
+            assert h.emitted and h.emitted[0][0] == Duty(1, DutyType.RANDAO)
+            # signed submission round-trips
+            root = SignedProposal(got).signing_root(h.chain)
+            sig = bytes(tbls.sign(h.share_secret(h.root()), root))
+            await h.comp.submit_block(spec.SignedBeaconBlock(got, sig))
+            assert h.emitted[-1][0] == Duty(1, DutyType.PROPOSER)
+
+        _run(run())
+
+    def test_blinded_consensus_rejected_on_v2_and_vice_versa(self):
+        async def run():
+            h = Harness()
+            await self._seed_block(h, slot=1, blinded=True)
+            with pytest.raises(CharonError):
+                await h.comp.block_proposal(1, self._randao(h, 1))
+            got = await h.comp.blinded_block_proposal(1, self._randao(h, 1))
+            assert got.blinded
+            h2 = Harness()
+            await self._seed_block(h2, slot=1, blinded=False)
+            with pytest.raises(CharonError):
+                await h2.comp.blinded_block_proposal(
+                    1, self._randao(h2, 1))
+
+        _run(run())
+
+    def test_invalid_randao_rejected(self):
+        async def run():
+            h = Harness()
+            await self._seed_block(h)
+            with pytest.raises(CharonError):
+                await h.comp.block_proposal(1, b"\xbb" * 96)
+            assert not h.emitted
+
+        _run(run())
+
+    def test_submit_block_invalid_signature_rejected(self):
+        async def run():
+            h = Harness()
+            block = await self._seed_block(h)
+            with pytest.raises(CharonError):
+                await h.comp.submit_block(
+                    spec.SignedBeaconBlock(block, b"\xcc" * 96))
+
+        _run(run())
+
+    def test_submit_blinded_block_marks_blinded(self):
+        async def run():
+            h = Harness()
+            block = await self._seed_block(h, blinded=True)
+            sent = dataclasses.replace(block, blinded=False)  # VC may omit
+            root = SignedProposal(sent).signing_root(h.chain)
+            sig = bytes(tbls.sign(h.share_secret(h.root()), root))
+            await h.comp.submit_blinded_block(spec.SignedBeaconBlock(sent, sig))
+            duty, parsigs = h.emitted[-1]
+            assert duty == Duty(1, DutyType.PROPOSER)
+            assert parsigs[h.root()].data.block.blinded
+
+        _run(run())
+
+
+class TestExitsAndRegistrations:
+    def test_exit_roundtrip_and_bad_signature(self):
+        async def run():
+            h = Harness()
+            msg = spec.VoluntaryExit(epoch=0, validator_index=0)
+            root = SignedExit(msg).signing_root(h.chain)
+            sig = bytes(tbls.sign(h.share_secret(h.root()), root))
+            await h.comp.submit_voluntary_exit(
+                spec.SignedVoluntaryExit(msg, sig))
+            assert h.emitted[-1][0].type == DutyType.EXIT
+            with pytest.raises(CharonError):
+                await h.comp.submit_voluntary_exit(
+                    spec.SignedVoluntaryExit(msg, b"\xdd" * 96))
+
+        _run(run())
+
+    def test_registration_rewritten_to_root_pubkey(self):
+        """The VC registers its SHARE pubkey; the emitted parsig must carry
+        the ROOT registration (validatorapi.go:555 SubmitValidatorRegistrations
+        pubkey rewrite)."""
+
+        async def run():
+            h = Harness()
+            share_pk = bytes(h.keys.my_share_pubkey(h.root()))
+            root_pk = bytes(pubkey_to_bytes(h.root()))
+            reg = spec.ValidatorRegistration(
+                fee_recipient=b"\xee" * 20, gas_limit=30_000_000,
+                timestamp=12, pubkey=root_pk)  # VC signed over the ROOT reg
+            root = SignedRegistration(reg, b"").signing_root(h.chain)
+            sig = bytes(tbls.sign(h.share_secret(h.root()), root))
+            sent = spec.SignedValidatorRegistration(
+                dataclasses.replace(reg, pubkey=share_pk), sig)
+            await h.comp.submit_validator_registrations([sent])
+            duty, parsigs = h.emitted[-1]
+            assert duty.type == DutyType.BUILDER_REGISTRATION
+            assert parsigs[h.root()].data.registration.pubkey == root_pk
+
+        _run(run())
+
+    def test_unknown_share_pubkey_rejected(self):
+        async def run():
+            h = Harness()
+            reg = spec.ValidatorRegistration(
+                fee_recipient=b"\xee" * 20, gas_limit=30_000_000,
+                timestamp=12, pubkey=b"\xab" * 48)
+            with pytest.raises(CharonError):
+                await h.comp.submit_validator_registrations(
+                    [spec.SignedValidatorRegistration(reg, b"\x00" * 96)])
+
+        _run(run())
+
+
+class TestIdentityAndConfig:
+    def test_get_validators_translation_both_directions(self):
+        async def run():
+            h = Harness()
+            share_pk = bytes(h.keys.my_share_pubkey(h.root()))
+            # by share pubkey
+            got = await h.comp.get_validators(["0x" + share_pk.hex()])
+            assert len(got) == 1
+            v, share = got[0]
+            assert bytes(v.pubkey) == share_pk and share == share_pk
+            # by index: the BN record's ROOT pubkey must come back as SHARE
+            got_i = await h.comp.get_validators([str(v.index)])
+            assert bytes(got_i[0][0].pubkey) == share_pk
+            # empty ids: whole cluster
+            all_v = await h.comp.get_validators([])
+            assert len(all_v) == N_VALS
+            with pytest.raises(CharonError):
+                await h.comp.get_validators(["12345"])
+            with pytest.raises(CharonError):
+                await h.comp.get_validators(["0x" + "ab" * 48])
+
+        _run(run())
+
+    def test_proposer_config_shape(self):
+        async def run():
+            h = Harness()
+            h.comp.register_builder_enabled(lambda s: True)
+            cfg = h.comp.proposer_config()
+            assert cfg["default_config"]["builder"]["enabled"] is False
+            assert len(cfg["proposers"]) == N_VALS
+            for root in h.keys.root_pubkeys:
+                share_hex = "0x" + bytes(h.keys.my_share_pubkey(root)).hex()
+                p = cfg["proposers"][share_hex]
+                assert p["fee_recipient"] == "0x" + "ee" * 20
+                assert p["builder"]["enabled"] is True
+                assert p["builder"]["registration_overrides"]["public_key"] \
+                    == "0x" + bytes(pubkey_to_bytes(root)).hex()
+
+        _run(run())
+
+
+class TestSelections:
+    def test_unknown_validator_index_rejected(self):
+        async def run():
+            h = Harness()
+            sel = BeaconCommitteeSelection(999, 1, b"\x00" * 96)
+            with pytest.raises(CharonError):
+                await h.comp.aggregate_beacon_committee_selections([sel])
+
+        _run(run())
