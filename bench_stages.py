@@ -70,7 +70,7 @@ def main() -> None:
     stages["agg.total"] = tick("agg.total (production call)", t0)
 
     V = len(batches)
-    Vp = PA._bucket(V)
+    Vp = PA._bucket_for_slots(V, T)
     Wv = Vp // PP.SUB
     W4 = (Vp * T) // PP.SUB
     zero96 = b"\xc0" + bytes(95)

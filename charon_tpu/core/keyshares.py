@@ -25,6 +25,10 @@ class KeyShares:
     share_pubkeys: dict[PubKey, dict[int, tbls.PublicKey]] = field(default_factory=dict)
     # This node's share secrets (held by its VC; present in vmock/test setups).
     my_share_secrets: dict[PubKey, tbls.PrivateKey] = field(default_factory=dict)
+    # lazy reverse index: my share pubkey bytes -> DV root (built once;
+    # share maps are static for a run — rebuilt views carry fresh indexes)
+    _root_by_share: dict[bytes, PubKey] | None = field(
+        default=None, repr=False, compare=False)
 
     @property
     def root_pubkeys(self) -> list[PubKey]:
@@ -47,12 +51,20 @@ class KeyShares:
 
     def root_by_share_pubkey(self, share_pk: bytes) -> PubKey:
         """Map a VC's share pubkey back to the DV root
-        (reference validatorapi.go:978-1005 pubkey mapping)."""
-        share_pk = bytes(share_pk)
-        for root, shares in self.share_pubkeys.items():
-            if bytes(shares[self.my_share_idx]) == share_pk:
-                return root
-        raise errors.new("unknown share pubkey", share=share_pk[:8].hex())
+        (reference validatorapi.go:978-1005 pubkey mapping). O(1) via a
+        reverse index built on first use — the linear scan this replaces
+        was O(validators) per lookup and collapsed the duty pipeline at
+        2000 DVs (every duties call is O(N) lookups, so the pipeline was
+        quadratic in cluster size)."""
+        if self._root_by_share is None:
+            self._root_by_share = {
+                bytes(shares[self.my_share_idx]): root
+                for root, shares in self.share_pubkeys.items()}
+        root = self._root_by_share.get(bytes(share_pk))
+        if root is None:
+            raise errors.new("unknown share pubkey",
+                             share=bytes(share_pk)[:8].hex())
+        return root
 
 
 def new_cluster_for_t(num_validators: int, threshold: int, num_nodes: int,

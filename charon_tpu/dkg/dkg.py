@@ -227,14 +227,15 @@ async def _run_frost(node: TCPNode, bcast: SignedBroadcast, inbox: _FrostShares,
     """All validators' keygens in parallel (reference runFrostParallel
     dkg/frost.go:50)."""
     my_part = my_idx + 1  # 1-based participant index
-    participants = []
+    participants = [
+        frost_mod.Participant(my_part, threshold, num_nodes,
+                              def_hash + v.to_bytes(4, "big"))
+        for v in range(num_validators)]
+    # ONE batched fixed-base device dispatch for every validator's
+    # commitments + PoK nonces (frost.round1_batch)
     round1_bcasts = []
     outgoing: dict[int, dict[int, int]] = {j: {} for j in range(1, num_nodes + 1)}
-    for v in range(num_validators):
-        ctx = def_hash + v.to_bytes(4, "big")
-        p = frost_mod.Participant(my_part, threshold, num_nodes, ctx)
-        b, shares = p.round1()
-        participants.append(p)
+    for v, (b, shares) in enumerate(frost_mod.round1_batch(participants)):
         round1_bcasts.append(b)
         for j, share in shares.items():
             outgoing[j][v] = share

@@ -110,15 +110,10 @@ class Participant:
     _coeffs: list[int] = field(default_factory=list)
 
     def round1(self) -> tuple[Round1Broadcast, dict[int, int]]:
-        """Returns (broadcast, {participant_j -> share f_i(j)})."""
-        self._coeffs = [self._rand_scalar() for _ in range(self.threshold)]
-        commitments = [_g1_mul_gen(a) for a in self._coeffs]
-        k = self._rand_scalar()
-        r_commit = _g1_mul_gen(k)
-        c = _pok_challenge(self.index, self.context, commitments[0], r_commit)
-        mu = (k + self._coeffs[0] * c) % F.R
-        shares = {j: self._eval(j) for j in range(1, self.total + 1)}
-        return Round1Broadcast(self.index, commitments, r_commit, mu), shares
+        """Returns (broadcast, {participant_j -> share f_i(j)}). One
+        participant of the batched path — round1_batch holds the single
+        copy of the PoK construction."""
+        return round1_batch([self])[0]
 
     def _eval(self, x: int) -> int:
         acc = 0
@@ -132,6 +127,73 @@ class Participant:
             s = _secrets.randbelow(F.R)
             if s:
                 return s
+
+
+def round1_batch(parts: list[Participant]
+                 ) -> list[tuple[Round1Broadcast, dict[int, int]]]:
+    """Round 1 for MANY participants (a node's whole validator set) with
+    the generator multiplications BATCHED: all commitments C_ik = a_ik·G
+    and all PoK nonce commitments k·G of the batch ride one device
+    fixed-base dispatch (plane_agg.g1_mul_gen_batch) instead of one
+    scalar-mul each — the ceremony keygen hot spot (BASELINE config 4;
+    reference dkg/frost.go:50-86 + runFrostParallel compute them
+    serially via kryptology). Off-device (or for small batches) the
+    per-participant path is used; outputs are bit-identical."""
+    for p in parts:
+        p._coeffs = [p._rand_scalar() for _ in range(p.threshold)]
+    nonces = [p._rand_scalar() for p in parts]
+    scalars = [a for p in parts for a in p._coeffs] + nonces
+    pts = _mul_gen_many(scalars)
+    out = []
+    off = 0
+    for i, p in enumerate(parts):
+        commitments = pts[off:off + p.threshold]
+        off += p.threshold
+        r_commit = pts[len(scalars) - len(parts) + i]
+        c = _pok_challenge(p.index, p.context, commitments[0], r_commit)
+        mu = (nonces[i] + p._coeffs[0] * c) % F.R
+        shares = {j: p._eval(j) for j in range(1, p.total + 1)}
+        out.append((Round1Broadcast(p.index, commitments, r_commit, mu),
+                    shares))
+    return out
+
+
+# TRUST BOUNDARY: batched device keygen ships the secret polynomial
+# coefficients and PoK nonces to the device as digit planes. On a machine
+# whose accelerator is in the host's trust domain that is equivalent to
+# host memory — but over a REMOTE/shared TPU tunnel it hands key material
+# to the transport, defeating the DKG's no-single-party-learns-the-key
+# property. Therefore OFF by default (native keygen; secrets never leave
+# the process) and explicitly opt-in for trusted-device deployments via
+# enable_device_keygen(). Measured gain is modest anyway (1.3x at a
+# 200-validator operator; grows with ceremony size).
+DEVICE_KEYGEN = False
+_DEVICE_MIN_KEYGEN = 256
+
+
+def enable_device_keygen() -> None:
+    """Opt in to batched on-device generator multiplications for round-1
+    keygen — ONLY for deployments whose accelerator (and the path to it)
+    is inside the operator's trust domain; see the trust-boundary note."""
+    global DEVICE_KEYGEN
+    DEVICE_KEYGEN = True
+
+
+def _mul_gen_many(scalars: list[int]) -> list[bytes]:
+    use_device = DEVICE_KEYGEN and len(scalars) >= _DEVICE_MIN_KEYGEN
+    if use_device:
+        from ..ops import pallas_plane as PP
+
+        use_device = not PP._interpret()
+    if use_device:
+        from ..ops import plane_agg
+        from ..tbls.tpu_impl import _DEVICE_RUNTIME_ERRORS
+
+        try:
+            return plane_agg.g1_mul_gen_batch(scalars)
+        except _DEVICE_RUNTIME_ERRORS:
+            pass  # device/tunnel fault: serial native below
+    return [_g1_mul_gen(s) for s in scalars]
 
 
 def verify_round1(bcast: Round1Broadcast, threshold: int, context: bytes) -> None:
@@ -159,9 +221,14 @@ def verify_share(my_index: int, share: int, commitments: list[bytes]) -> None:
         raise errors.new("share does not match commitments", index=my_index)
 
 
-# points-per-check below which the device sweep isn't worth its dispatch
-# floor; a 200-validator ceremony is ~1000 commitment points per node round
-_DEVICE_MIN_POINTS = 256
+# Measured on v5e (BASELINE config 4): the share-verification equation is
+# DECOMPRESS-bound — every commitment is a fresh one-shot point, and the
+# native C++ decoder + lincomb (~0.8 ms/check) beats the device pipeline
+# (hybrid native-decode + device sweep measured 0.4-0.7x at 1000-4000
+# points through the tunnel). The device equation stays correct and
+# tested; it activates only where the batch is large enough that the
+# sweep's linear win could overtake the fixed scan/transfer overheads.
+_DEVICE_MIN_POINTS = 16384
 
 
 def verify_shares_batch(
@@ -186,16 +253,63 @@ def verify_shares_batch(
 
         use_device = not PP._interpret()
     if use_device:
-        from ..ops import plane_agg
+        from ..tbls.tpu_impl import _DEVICE_RUNTIME_ERRORS
 
-        points, scalars = _rlc_share_equation(items)
         try:
-            if plane_agg.g1_lincomb_is_infinity(points, scalars):
+            if _verify_shares_device(items):
                 return
         except ValueError:
             pass  # invalid encoding: attribute below
+        except _DEVICE_RUNTIME_ERRORS:  # device/tunnel fault: native path
+            pass
     for my_index, share, commitments in items:
         verify_share(my_index, share, commitments)
+
+
+def _verify_shares_device(items) -> bool:
+    """Device evaluation of the RLC equation. When every check shares the
+    same evaluation point x (a node verifying its own shares — the
+    ceremony case), the equation factors as
+        (Σ_m r_m·f_m)·G == Σ_k x^k · (Σ_m r_m·C_mk)
+    so the device sweep runs on the SHORT (RLC_BITS-bit) r_m digits with
+    one masked reduce per degree k — 4x fewer windows than sweeping the
+    256-bit products r_m·x^k — and the host finishes with t tiny
+    Jacobian scalar-muls. Mixed-x batches fall back to the generic single
+    wide MSM (g1_lincomb_is_infinity)."""
+    from ..crypto.curve import FqOps, jac_add, jac_is_infinity, jac_mul
+    from ..crypto.rlc import sample_randomizer
+    from ..ops import plane_agg
+
+    xs = {mi for mi, _, _ in items}
+    if len(xs) != 1:
+        points, scalars = _rlc_share_equation(items)
+        return plane_agg.g1_lincomb_is_infinity(points, scalars)
+    x = xs.pop()
+    t = max(len(c) for _, _, c in items)
+    points: list[bytes] = []
+    scalars: list[int] = []
+    groups: list[int] = []
+    gen_scalar = 0
+    for _mi, share, commitments in items:
+        r = sample_randomizer()
+        gen_scalar = (gen_scalar + r * share) % F.R
+        for k, c in enumerate(commitments):
+            points.append(c)
+            scalars.append(r)
+            groups.append(k)
+    sums = plane_agg.g1_groups_msm(points, scalars, groups, t)
+    # host: Σ_k x^k·P_k − gen_scalar·G == ∞  (t+1 small host jac_muls)
+    acc = None
+    xk = 1
+    for k in range(t):
+        term = jac_mul(FqOps, sums[k], xk)
+        acc = term if acc is None else jac_add(FqOps, acc, term)
+        xk = (xk * x) % F.R
+    from ..crypto.curve import g1_generator
+
+    lhs = jac_mul(FqOps, g1_generator(), gen_scalar)
+    neg = (lhs[0], (-lhs[1]) % F.P, lhs[2])
+    return jac_is_infinity(FqOps, jac_add(FqOps, acc, neg))
 
 
 def _rlc_share_equation(

@@ -175,9 +175,12 @@ def g2_plane_from_compressed(sigs: list[bytes], Bp: int,
 
 def g1_plane_from_compressed(pks: list[bytes], Bp: int,
                              check_subgroup: bool = False,
-                             reject_infinity: bool = False) -> PP.PlanePoint:
+                             reject_infinity: bool = False,
+                             device_decode: bool | None = None) -> PP.PlanePoint:
     n = len(pks)
-    if _device_path(n):
+    if device_decode is None:
+        device_decode = _device_path(n)
+    if device_decode:
         plane = _g1_plane_device(pks, Bp, reject_infinity)
         if check_subgroup and not g1_subgroup_ok(plane):
             raise ValueError("G1 point not in subgroup")
@@ -811,6 +814,86 @@ def _g2_affine_std_core(X, Y, Z):
     return xs, sign, inf
 
 
+@jax.jit
+def _g1_affine_std_jit(X, Y, Z):
+    """Jacobian G1 plane -> affine standard-form x plane + sign/infinity
+    masks, one dispatch (the G1 analog of _g2_affine_std_jit; powers the
+    batched fixed-base keygen serializer). The field inversion is the
+    batched p−2 power scan; Z=0 lanes yield 0^(p-2)=0 and are masked by
+    the infinity flag."""
+    _, inv_bits = _sqrt_inv_bits()
+    zi = PP._pow_scan(Z, jnp.asarray(inv_bits))
+    zi2 = PP._mul_call(zi, zi, 1)
+    zi3 = PP._mul_call(zi2, zi, 1)
+    xa = PP._mul_call(X, zi2, 1)
+    ya = PP._mul_call(Y, zi3, 1)
+    S, W = X.shape[-2:]
+    one_raw = _one_raw_plane(S, W)
+    xs = PP._mul_call(xa, one_raw, 1)
+    ys = PP._mul_call(ya, one_raw, 1)
+    inf = jnp.all(Z == 0, axis=(0, 1))
+    sign = _gt_half_std(ys)
+    return xs, sign, inf
+
+
+def _g1_emit_bytes(x_np: np.ndarray, sign_np: np.ndarray,
+                   inf_np: np.ndarray, V: int) -> list[bytes]:
+    """Standard-form affine G1 x plane + sign/infinity masks -> compressed
+    48-byte strings (host byte slicing only)."""
+    sign_np, inf_np = sign_np.reshape(-1)[:V], inf_np.reshape(-1)[:V]
+    x = _fp_limbs_to_be(PP.from_plane(x_np, V))
+    inf_bytes = b"\xc0" + bytes(47)
+    out = []
+    for i in range(V):
+        if inf_np[i]:
+            out.append(inf_bytes)
+            continue
+        b = bytearray(x[i].tobytes())
+        b[0] |= 0x80 | (0x20 if sign_np[i] else 0)
+        out.append(bytes(b))
+    return out
+
+
+@functools.lru_cache(maxsize=8)
+def _gen_plane(Bp: int):
+    """Broadcast plane holding the G1 generator in EVERY lane (Montgomery
+    Jacobian), cached per bucket — the fixed base of the batched keygen."""
+    from ..crypto.curve import to_affine
+
+    ax, ay = to_affine(FqOps, g1_generator())
+    X = np.broadcast_to(F.fq_from_int(ax)[None], (Bp, F.LIMBS))
+    Y = np.broadcast_to(F.fq_from_int(ay)[None], (Bp, F.LIMBS))
+    Z = np.broadcast_to(_MONT_ONE[None], (Bp, F.LIMBS))
+    return (jnp.asarray(PP.to_plane(X, 1)), jnp.asarray(PP.to_plane(Y, 1)),
+            jnp.asarray(PP.to_plane(Z, 1)))
+
+
+@jax.jit
+def _g1_fixedbase_jit(X, Y, Z, digits):
+    pX, pY, pZ = PP._scalar_mul_windowed(X, Y, Z, digits.astype(jnp.int32), 1)
+    return _g1_affine_std_jit(pX, pY, pZ)
+
+
+def g1_mul_gen_batch(scalars: list[int]) -> list[bytes]:
+    """Batched fixed-base scalar multiplication kᵢ·G -> compressed bytes,
+    one device dispatch for the whole batch + host byte slicing. The FROST
+    ceremony's round-1 keygen hot spot (commitments C_ik = a_ik·G and the
+    PoK nonces, reference dkg/frost.go:50-86 computes them one
+    kryptology scalar-mul at a time): a 6-op × 200-validator ceremony is
+    ~5k generator multiplications — exactly the plane's batch shape.
+    Bit-identical to the native/serial path (same ETH serialization)."""
+    n = len(scalars)
+    if n == 0:
+        return []
+    Bp = _bucket(n)
+    X, Y, Z = _gen_plane(Bp)
+    digits = jnp.asarray(PP.scalars_to_digitplanes(
+        [s % PF.R for s in scalars], Bp))
+    xs, sign, inf = _g1_fixedbase_jit(X, Y, Z, digits)
+    return _g1_emit_bytes(np.asarray(xs), np.asarray(sign),
+                          np.asarray(inf), n)
+
+
 def _fp_limbs_to_be(limbs: np.ndarray) -> np.ndarray:
     """(n, 32) int32 12-bit limbs -> (n, 48) uint8 big-endian bytes
     (vectorized inverse of _fp_limbs_raw)."""
@@ -914,6 +997,50 @@ def _pk_plane_cached(pks: list[bytes], Bp: int) -> PP.PlanePoint:
         _PK_PLANE_CACHE.pop(key)
     _PK_PLANE_CACHE[key] = plane
     return plane
+
+
+@functools.partial(jax.jit, static_argnames=("G",))
+def _g1_groups_sweep_jit(X, Y, Z, rdig, gmask, *, G):
+    """ONE windowed sweep (shared short digits) + per-group masked reduces
+    over an already-loaded G1 plane, one dispatch. The FROST batched share
+    verification's device core: grouping by commitment degree k lets the
+    sweep run on the 64-bit RLC randomizers instead of full 256-bit
+    products — 4x fewer windows (frost.verify_shares_batch)."""
+    pX, pY, pZ = PP._scalar_mul_windowed(X, Y, Z, rdig.astype(jnp.int32), 1)
+    reds = []
+    for g in range(G):
+        sel = gmask[g][None, None]
+        reds.append(PP._reduce_tree_jit(
+            jnp.where(sel, pX, 0), jnp.where(sel, pY, 0),
+            jnp.where(sel, pZ, 0), 1))
+    return reds
+
+
+def g1_groups_msm(points: list[bytes], scalars: list[int],
+                  groups: list[int], n_groups: int):
+    """Per-group G1 MSMs with SHARED-width short scalars: returns a list of
+    n_groups host Jacobians [Σ_{i∈group g} kᵢ·Pᵢ]. scalars are RLC_BITS-bit
+    (the sweep runs one 64-bit windowed pass over the whole plane); groups
+    assigns each point a group id. Raises ValueError on invalid points."""
+    n = len(points)
+    if not (n == len(scalars) == len(groups)):
+        raise ValueError("length mismatch")
+    Bp = _bucket(n)
+    # NATIVE bulk decode + DEVICE sweep: fresh one-shot points (ceremony
+    # commitments are never reused) make the batched device square-root
+    # scans the dominant cost — the native C++ decoder at ~80µs/point beats
+    # them through the tunnel, while the MSM sweep still wins on the device
+    plane = g1_plane_from_compressed([bytes(p) for p in points], Bp,
+                                     device_decode=False)
+    rdig = jnp.asarray(PP.scalars_to_digitplanes(scalars, Bp,
+                                                 nbits=RLC_BITS))
+    W = Bp // PP.SUB
+    gmask = np.zeros((n_groups, PP.SUB, W), bool)
+    for i, g in enumerate(groups):
+        gmask[g, i // W, i % W] = True
+    reds = _g1_groups_sweep_jit(plane.X, plane.Y, plane.Z, rdig,
+                                jnp.asarray(gmask), G=n_groups)
+    return [PP._host_fold(*red, 1) for red in reds]
 
 
 def g1_lincomb_is_infinity(points: list[bytes], scalars: list[int]) -> bool:

@@ -18,7 +18,9 @@ Routes the duty pipeline's hot calls onto the fused Pallas kernel plane
     bad signature and callers attribute per-item.
 
 Everything else (keygen, split/recover, sign, single verify) delegates to
-the native C++ backend. Small batches stay on the CPU: a fused device
+the native C++ backend — key material never rides this backend's device
+path. (The DKG's batched keygen is a separate, explicitly opt-in
+trusted-device path: dkg/frost.enable_device_keygen.) Small batches stay on the CPU: a fused device
 call has a fixed floor (~0.36 s aggregate+verify, ~0.20 s bulk verify —
 one dispatch + one transfer, round-3 single-dispatch design) regardless
 of batch size ≤1024, so it only wins past `min_device_batch` /

@@ -149,6 +149,48 @@ class TestFrostMath:
         scs[0] = (scs[0] + 1) % (2**256 - 1)
         assert not plane_agg.g1_lincomb_is_infinity(pts, scs)
 
+    def test_same_x_device_equation_matches_per_item(self):
+        """The factored same-x device path (one short-digit sweep + per-k
+        reduces + host x^k fold) must accept exactly the batches the
+        per-item verifier accepts, and reject a corrupted one."""
+        items = []
+        for v in range(2):
+            for dealer in (1, 2, 3):
+                p = frost.Participant(dealer, 3, 3, b"cx%d" % v)
+                b, shares = p.round1()
+                items.append((2, shares[2], b.commitments))
+        assert frost._verify_shares_device(items)
+        bad = list(items)
+        mi, sh, cm = bad[4]
+        bad[4] = (mi, (sh + 1) % __import__("charon_tpu.crypto.fields",
+                                            fromlist=["R"]).R, cm)
+        assert not frost._verify_shares_device(bad)
+
+
+    def test_g1_mul_gen_batch_bit_identity(self):
+        """The batched fixed-base device serializer must be bit-identical
+        to the serial generator multiplication (keygen path)."""
+        import random
+        from charon_tpu.crypto import fields as PF
+        from charon_tpu.ops import plane_agg
+
+        rng = random.Random(31)
+        scalars = [rng.randrange(1, PF.R) for _ in range(9)]
+        scalars += [1, 2, PF.R - 1]
+        got = plane_agg.g1_mul_gen_batch(scalars)
+        want = [frost._g1_mul_gen(s) for s in scalars]
+        assert got == want
+
+    def test_round1_batch_matches_per_participant_semantics(self):
+        """round1_batch broadcasts must verify exactly like round1's and
+        the shares must match the published commitments."""
+        parts = [frost.Participant(1, 3, 4, b"ctx") for _ in range(3)]
+        for (b, shares), p in zip(frost.round1_batch(parts), parts):
+            frost.verify_round1(b, 3, b"ctx")
+            for j in range(1, 5):
+                frost.verify_share(j, shares[j], b.commitments)
+
+
 
 def _ceremony_setup(num_nodes, num_validators, threshold, algorithm, tmp_path):
     identity_keys = [k1util.generate_private_key() for _ in range(num_nodes)]
