@@ -1,0 +1,152 @@
+"""Broadcaster per-duty-type matrix — every broadcastable duty type routes
+to its beacon-node submission endpoint, internal duty types route nowhere,
+and the blinded flag survives to the BN (reference core/bcast/bcast_test.go
+TestBroadcast's per-type table)."""
+
+import asyncio
+
+import pytest
+
+from charon_tpu.core.bcast import Broadcaster
+from charon_tpu.core.signeddata import (
+    SignedAggregateAndProof,
+    SignedAttestation,
+    SignedExit,
+    SignedProposal,
+    SignedRandao,
+    SignedRegistration,
+    SignedSyncContributionAndProof,
+    SignedSyncMessage,
+)
+from charon_tpu.core.types import Duty, DutyType, pubkey_from_bytes, pubkey_to_bytes
+from charon_tpu.eth2 import spec
+from charon_tpu.testutil.beaconmock import BeaconMock
+
+PUBKEY = pubkey_from_bytes(b"\xbb" * 48)
+SIG = b"\x05" * 96
+
+
+def _harness():
+    mock = BeaconMock([bytes(pubkey_to_bytes(PUBKEY))], genesis_time=0.0)
+    return mock, Broadcaster(mock, mock._spec)
+
+
+def _att_data():
+    cp = spec.Checkpoint(epoch=0, root=b"\x01" * 32)
+    return spec.AttestationData(slot=1, index=0,
+                                beacon_block_root=b"\x02" * 32,
+                                source=cp, target=cp)
+
+
+def _block(blinded=False):
+    return spec.BeaconBlock(slot=1, proposer_index=0,
+                            parent_root=b"\x03" * 32,
+                            state_root=b"\x04" * 32,
+                            body_root=b"\x05" * 32, blinded=blinded)
+
+
+CASES = [
+    (
+        "attestation",
+        Duty(1, DutyType.ATTESTER),
+        lambda: SignedAttestation(spec.Attestation([True], _att_data(), SIG)),
+        lambda m: m.attestations,
+    ),
+    (
+        "block_proposal",
+        Duty(1, DutyType.PROPOSER),
+        lambda: SignedProposal(_block(), SIG),
+        lambda m: m.blocks,
+    ),
+    (
+        "aggregate_attestation",
+        Duty(1, DutyType.AGGREGATOR),
+        lambda: SignedAggregateAndProof(
+            spec.AggregateAndProof(0, spec.Attestation([True], _att_data(),
+                                                       SIG), SIG), SIG),
+        lambda m: m.aggregates,
+    ),
+    (
+        "sync_message",
+        Duty(1, DutyType.SYNC_MESSAGE),
+        lambda: SignedSyncMessage(spec.SyncCommitteeMessage(
+            slot=1, beacon_block_root=b"\x06" * 32, validator_index=0,
+            signature=SIG)),
+        lambda m: m.sync_messages,
+    ),
+    (
+        "sync_contribution",
+        Duty(1, DutyType.SYNC_CONTRIBUTION),
+        lambda: SignedSyncContributionAndProof(
+            spec.ContributionAndProof(0, spec.SyncCommitteeContribution(
+                slot=1, beacon_block_root=b"\x06" * 32,
+                subcommittee_index=0, aggregation_bits=[True] * 128,
+                signature=SIG), SIG), SIG),
+        lambda m: m.contributions,
+    ),
+    (
+        "validator_registration",
+        Duty(1, DutyType.BUILDER_REGISTRATION),
+        lambda: SignedRegistration(spec.ValidatorRegistration(
+            fee_recipient=b"\xee" * 20, gas_limit=30_000_000, timestamp=1,
+            pubkey=bytes(pubkey_to_bytes(PUBKEY))), SIG),
+        lambda m: m.registrations,
+    ),
+    (
+        "voluntary_exit",
+        Duty(1, DutyType.EXIT),
+        lambda: SignedExit(spec.VoluntaryExit(epoch=0, validator_index=0),
+                           SIG),
+        lambda m: m.exits,
+    ),
+]
+
+
+@pytest.mark.parametrize("name,duty,mk,sink", CASES, ids=[c[0] for c in CASES])
+def test_broadcast_routes_to_bn_endpoint(name, duty, mk, sink):
+    async def run():
+        mock, caster = _harness()
+        await caster.broadcast(duty, {PUBKEY: mk()})
+        assert len(sink(mock)) == 1, f"{name} did not reach its BN endpoint"
+        # idempotent second broadcast also lands (dedup is the BN's concern)
+        await caster.broadcast(duty, {PUBKEY: mk()})
+        assert len(sink(mock)) == 2
+
+    asyncio.run(run())
+
+
+@pytest.mark.parametrize("duty_type", [
+    DutyType.RANDAO, DutyType.PREPARE_AGGREGATOR,
+    DutyType.PREPARE_SYNC_CONTRIBUTION, DutyType.SIGNATURE,
+])
+def test_internal_duties_broadcast_nothing(duty_type):
+    async def run():
+        mock, caster = _harness()
+        await caster.broadcast(Duty(1, duty_type),
+                               {PUBKEY: SignedRandao(0, SIG)})
+        for sink in (mock.attestations, mock.blocks, mock.aggregates,
+                     mock.sync_messages, mock.contributions,
+                     mock.registrations, mock.exits):
+            assert not sink
+
+    asyncio.run(run())
+
+
+def test_blinded_proposal_flag_survives_to_bn():
+    async def run():
+        mock, caster = _harness()
+        await caster.broadcast(Duty(1, DutyType.PROPOSER),
+                               {PUBKEY: SignedProposal(_block(blinded=True),
+                                                       SIG)})
+        assert mock.blocks and mock.blocks[0].message.blinded
+
+    asyncio.run(run())
+
+
+def test_empty_set_is_a_noop():
+    async def run():
+        mock, caster = _harness()
+        await caster.broadcast(Duty(1, DutyType.ATTESTER), {})
+        assert not mock.attestations
+
+    asyncio.run(run())

@@ -1,0 +1,125 @@
+"""ParSigDB threshold-matching matrix — the reference's table-driven cases
+(core/parsigdb/memory_internal_test.go:19 TestGetThresholdMatching) across
+two message providers: sync-committee messages (root varies with the
+signed block root) and beacon-committee selections (root varies with the
+slot). n=4, threshold=3."""
+
+import asyncio
+
+import pytest
+
+from charon_tpu.core import parsigdb
+from charon_tpu.core.signeddata import BeaconCommitteeSelection, SignedSyncMessage
+from charon_tpu.core.types import Duty, DutyType, ParSignedData, pubkey_from_bytes
+from charon_tpu.eth2 import spec
+
+N, THRESHOLD = 4, 3
+PUBKEY = pubkey_from_bytes(b"\xaa" * 48)
+ROOTS = [b"\x01" * 32, b"\x02" * 32]
+
+# (name, per-share root index list, expected firing share idxs or None)
+MATRIX = [
+    ("empty", [], None),
+    ("all_identical_exact_threshold", [0, 0, 0], {1, 2, 3}),
+    ("all_identical_above_threshold_fires_once", [0, 0, 0, 0], {1, 2, 3}),
+    ("one_odd", [0, 0, 1, 0], {1, 2, 4}),
+    ("two_odd", [0, 0, 1, 1], None),
+]
+
+
+def _sync_message(i: int, root_i: int) -> ParSignedData:
+    msg = spec.SyncCommitteeMessage(
+        slot=9, beacon_block_root=ROOTS[root_i], validator_index=3,
+        signature=bytes([i]) * 96)
+    return ParSignedData(SignedSyncMessage(msg), i)
+
+
+def _selection(i: int, root_i: int) -> ParSignedData:
+    # the selection's message root varies with its SLOT (like the
+    # reference's provider); share i signs slot root_i
+    sel = BeaconCommitteeSelection(3, 100 + root_i, bytes([i]) * 96)
+    return ParSignedData(sel, i)
+
+
+PROVIDERS = [
+    ("sync_message", DutyType.SYNC_MESSAGE, _sync_message),
+    ("selection", DutyType.PREPARE_AGGREGATOR, _selection),
+]
+
+
+@pytest.mark.parametrize("pname,duty_type,provider", PROVIDERS,
+                         ids=[p[0] for p in PROVIDERS])
+@pytest.mark.parametrize("name,inputs,expect", MATRIX,
+                         ids=[m[0] for m in MATRIX])
+def test_threshold_matching_matrix(pname, duty_type, provider,
+                                   name, inputs, expect):
+    async def run():
+        db = parsigdb.MemDB(THRESHOLD)
+        fires = []
+
+        async def on_threshold(duty, payload):
+            fires.append(payload)
+
+        db.subscribe_threshold(on_threshold)
+        duty = Duty(9, duty_type)
+        for i, root_i in enumerate(inputs):
+            await db.store_external(
+                duty, {PUBKEY: provider(i + 1, root_i)})
+        if expect is None:
+            assert not fires, f"unexpected threshold fire: {name}"
+            return
+        assert len(fires) == 1, f"expected exactly one fire: {name}"
+        group = fires[0][PUBKEY]
+        assert {p.share_idx for p in group} == expect
+        # the fired group is root-consistent
+        roots = {p.message_root() for p in group}
+        assert len(roots) == 1
+
+    asyncio.run(run())
+
+
+def test_above_threshold_late_partial_is_stored_not_refired():
+    """A 4th matching partial after the fire must neither re-fire nor
+    error (reference 'all identical above threshold' row)."""
+
+    async def run():
+        db = parsigdb.MemDB(THRESHOLD)
+        fires = []
+
+        async def on_threshold(duty, payload):
+            fires.append(payload)
+
+        db.subscribe_threshold(on_threshold)
+        duty = Duty(9, DutyType.SYNC_MESSAGE)
+        for i in range(1, 5):
+            await db.store_external(duty, {PUBKEY: _sync_message(i, 0)})
+        assert len(fires) == 1
+
+    asyncio.run(run())
+
+
+def test_multi_root_duty_fires_per_root_group():
+    """PREPARE_* duties aggregate PER ROOT: two distinct root groups each
+    reaching threshold fire independently (the k-subcommittee shape)."""
+
+    async def run():
+        db = parsigdb.MemDB(2)
+        fires = []
+
+        async def on_threshold(duty, payload):
+            fires.append(payload)
+
+        db.subscribe_threshold(on_threshold)
+        duty = Duty(9, DutyType.PREPARE_AGGREGATOR)
+        # shares 1,2 sign slot-100 AND slot-101 selections (multi-root
+        # duties allow the same share on multiple roots)
+        for root_i in (0, 1):
+            for i in (1, 2):
+                await db.store_external(
+                    duty, {PUBKEY: _selection(i, root_i)})
+        assert len(fires) == 2
+        fired_roots = {next(iter(f.values()))[0].message_root()
+                       for f in fires}
+        assert len(fired_roots) == 2
+
+    asyncio.run(run())
