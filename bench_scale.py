@@ -167,16 +167,25 @@ def bench_parsigex500() -> None:
     old_impl = tbls_mod.get_implementation()
     tbls_mod.set_implementation(tpu)
     rng = _random.Random(77)
+    # per-peer sets of 170 keep the coalesced batch in the 512 plane
+    # bucket: the 2048-lane fused verify graph exceeds the remote compile
+    # service's size budget (repeatedly drops the connection), while the
+    # 512 shape is the same production graph the bulk measurement runs
+    n_per, n_peers = 170, 3
+    pk3, mg3, sg3 = pks[:n_per], msgs[:n_per], sigs[:n_per]
+    t0 = time.time()
+    assert native.verify_batch(pk3, mg3, sg3)
+    t_cpu_peer = time.time() - t0
     try:
         async def burst():
             co = TblsCoalescer(window=0.2, flush_at=1600)
 
             async def peer(i):
                 await asyncio.sleep(rng.uniform(0, 0.02))
-                return await co.verify(pks, msgs, sigs,
-                                       key=("duty", 1), expected=3)
+                return await co.verify(pk3, mg3, sg3,
+                                       key=("duty", 1), expected=n_peers)
 
-            oks = await asyncio.gather(*[peer(i) for i in range(3)])
+            oks = await asyncio.gather(*[peer(i) for i in range(n_peers)])
             assert all(oks) and co.coalesced_flushes == 1
             return co
 
@@ -184,9 +193,10 @@ def bench_parsigex500() -> None:
         t_burst = _best_of(lambda: asyncio.run(burst()))
     finally:
         tbls_mod.set_implementation(old_impl)
-    _emit("parsigex 3-peer coalesced burst (1500 sigs, jittered)",
-          1500 / t_burst, "sigs/sec", device_s=round(t_burst, 3),
-          vs_cpu=round(3 * t_cpu / t_burst, 2))
+    total = n_per * n_peers
+    _emit(f"parsigex {n_peers}-peer coalesced burst ({total} sigs, jittered)",
+          total / t_burst, "sigs/sec", device_s=round(t_burst, 3),
+          vs_cpu=round(n_peers * t_cpu_peer / t_burst, 2))
 
 
 def bench_frost200() -> None:

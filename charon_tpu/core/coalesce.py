@@ -148,14 +148,23 @@ class TblsCoalescer:
     concurrent duties into single fused tbls dispatches (module doc)."""
 
     def __init__(self, window: float = 0.025, flush_at: int | None = None):
-        impl = tbls.get_implementation()
+        # An EXPLICIT flush_at always wins, for both windows. The default
+        # is one plane tile: coalescing amortizes the device dispatch
+        # floor until the batch stops fitting a tile, so flushing EARLIER
+        # by count splits batches that would have shared one dispatch (a
+        # per-peer 170-sig set must not flush alone just because it
+        # crossed the device-eligibility minimum — that cost the 3-peer
+        # burst its coalescing when ver_at was min_device_verify). A
+        # tile-sized count flush can also never land below
+        # min_device_batch/min_device_verify, so a count-triggered flush
+        # always takes the device path; the window timer still bounds
+        # latency for batches that never fill.
         if flush_at is None:
-            flush_at = getattr(impl, "min_device_batch", 192)
-        # the verify path only routes to the device at min_device_verify —
-        # a count-triggered flush below that would still take the CPU path
-        ver_at = getattr(impl, "min_device_verify", flush_at)
+            from ..ops.pallas_plane import TILE
+
+            flush_at = TILE
         self._agg = _Window("agg", window, flush_at, self._dispatch_agg)
-        self._ver = _Window("verify", window, ver_at, self._dispatch_ver)
+        self._ver = _Window("verify", window, flush_at, self._dispatch_ver)
         self.flushes = 0
         self.coalesced_flushes = 0
 

@@ -1,0 +1,229 @@
+"""Fetcher unit depth — per-duty-type fetch semantics against a recording
+beacon (reference core/fetcher/fetcher_test.go table shapes): attestation
+data deduped per committee, aggregator selection gating via the
+consensus-spec is_aggregator rule, proposer blocking on the aggregated
+randao with the builder gate, and registration seams."""
+
+import asyncio
+
+import pytest
+
+from charon_tpu.core.fetcher import Fetcher, _is_agg
+from charon_tpu.core.signeddata import BeaconCommitteeSelection, SignedRandao
+from charon_tpu.core.types import Duty, DutyType, pubkey_from_bytes
+from charon_tpu.core.unsigneddata import (
+    AttesterDefinition,
+    ProposalUnsigned,
+    ProposerDefinition,
+)
+from charon_tpu.eth2 import spec
+from charon_tpu.testutil.beaconmock import BeaconMock
+from charon_tpu.utils.errors import CharonError
+
+PK_A = pubkey_from_bytes(b"\xa1" * 48)
+PK_B = pubkey_from_bytes(b"\xa2" * 48)
+
+
+class CountingBeacon:
+    """Wraps BeaconMock counting per-method calls."""
+
+    def __init__(self):
+        from charon_tpu.core.types import pubkey_to_bytes
+
+        self._inner = BeaconMock(
+            [bytes(pubkey_to_bytes(PK_A)), bytes(pubkey_to_bytes(PK_B))],
+            genesis_time=0.0)
+        self.calls: dict[str, int] = {}
+
+    def __getattr__(self, name):
+        inner = getattr(self._inner, name)
+        if not callable(inner):
+            return inner
+
+        async def counted(*a, **kw):
+            self.calls[name] = self.calls.get(name, 0) + 1
+            return await inner(*a, **kw)
+
+        return counted
+
+
+def _att_defn(pk, committee_index, vci=0):
+    return AttesterDefinition(spec.AttesterDuty(
+        pubkey=b"\x00" * 48, slot=3, validator_index=0,
+        committee_index=committee_index, committee_length=32,
+        committees_at_slot=2, validator_committee_index=vci))
+
+
+def _run(coro, timeout=30):
+    async def wrapped():
+        return await asyncio.wait_for(coro, timeout)
+
+    return asyncio.run(wrapped())
+
+
+class TestFetchAttester:
+    def test_one_bn_request_per_distinct_committee(self):
+        async def run():
+            beacon = CountingBeacon()
+            f = Fetcher(beacon)
+            emitted = []
+
+            async def capture(duty, unsigned):
+                emitted.append(unsigned)
+
+            f.subscribe(capture)
+            defset = {
+                PK_A: _att_defn(PK_A, committee_index=0, vci=0),
+                PK_B: _att_defn(PK_B, committee_index=0, vci=1),
+            }
+            await f.fetch(Duty(3, DutyType.ATTESTER), defset)
+            assert beacon.calls.get("attestation_data") == 1, \
+                "same-committee validators must share one BN request"
+            assert set(emitted[0]) == {PK_A, PK_B}
+            # different committees: one request each
+            beacon.calls.clear()
+            defset2 = {
+                PK_A: _att_defn(PK_A, committee_index=0),
+                PK_B: _att_defn(PK_B, committee_index=1),
+            }
+            await f.fetch(Duty(3, DutyType.ATTESTER), defset2)
+            assert beacon.calls.get("attestation_data") == 2
+
+        _run(run())
+
+    def test_unsupported_duty_type_raises(self):
+        async def run():
+            f = Fetcher(CountingBeacon())
+            with pytest.raises(CharonError):
+                await f.fetch(Duty(3, DutyType.RANDAO), {})
+
+        _run(run())
+
+
+class TestFetchProposer:
+    def _defset(self):
+        return {PK_A: ProposerDefinition(spec.ProposerDuty(
+            pubkey=b"\x00" * 48, slot=3, validator_index=0))}
+
+    def test_blocks_on_randao_then_fetches_block(self):
+        async def run():
+            beacon = CountingBeacon()
+            f = Fetcher(beacon)
+            emitted = []
+
+            async def capture(duty, unsigned):
+                emitted.append(unsigned)
+
+            f.subscribe(capture)
+            randao_fut = asyncio.get_running_loop().create_future()
+
+            async def aggsig_await(duty, pubkey, root=None):
+                assert duty == Duty(3, DutyType.RANDAO)
+                return await randao_fut
+
+            f.register_agg_sig_db(aggsig_await)
+            task = asyncio.create_task(
+                f.fetch(Duty(3, DutyType.PROPOSER), self._defset()))
+            await asyncio.sleep(0.05)
+            assert not task.done(), "must block until the randao aggregates"
+            randao_fut.set_result(SignedRandao(0, b"\x07" * 96))
+            await asyncio.wait_for(task, 10)
+            assert emitted and isinstance(emitted[0][PK_A], ProposalUnsigned)
+            assert not emitted[0][PK_A].block.blinded
+
+        _run(run())
+
+    def test_builder_gate_fetches_blinded(self):
+        async def run():
+            beacon = CountingBeacon()
+            f = Fetcher(beacon)
+            f.register_builder_enabled(lambda slot: True)
+            emitted = []
+
+            async def capture(duty, unsigned):
+                emitted.append(unsigned)
+
+            f.subscribe(capture)
+
+            async def aggsig_await(duty, pubkey, root=None):
+                return SignedRandao(0, b"\x07" * 96)
+
+            f.register_agg_sig_db(aggsig_await)
+            await f.fetch(Duty(3, DutyType.PROPOSER), self._defset())
+            assert emitted[0][PK_A].block.blinded
+
+        _run(run())
+
+    def test_wrong_randao_type_raises(self):
+        async def run():
+            f = Fetcher(CountingBeacon())
+
+            async def aggsig_await(duty, pubkey, root=None):
+                return BeaconCommitteeSelection(0, 3, b"\x00" * 96)
+
+            f.register_agg_sig_db(aggsig_await)
+            with pytest.raises(CharonError):
+                await f.fetch(Duty(3, DutyType.PROPOSER), self._defset())
+
+        _run(run())
+
+    def test_unregistered_aggsigdb_raises(self):
+        async def run():
+            f = Fetcher(CountingBeacon())
+            with pytest.raises(CharonError):
+                await f.fetch(Duty(3, DutyType.PROPOSER), self._defset())
+
+        _run(run())
+
+
+class TestFetchAggregator:
+    def test_only_spec_aggregators_fetch(self):
+        """The consensus-spec is_aggregator gate: a selection proof that
+        does not meet the modulus emits nothing; one that does fetches the
+        aggregate for the agreed data root."""
+
+        async def run():
+            beacon = CountingBeacon()
+            f = Fetcher(beacon)
+            emitted = []
+
+            async def capture(duty, unsigned):
+                emitted.append(unsigned)
+
+            f.subscribe(capture)
+            # find one aggregating and one non-aggregating proof for
+            # committee_length=32 (modulus 2: ~half aggregate)
+            agg_proof = non_proof = None
+            i = 0
+            while agg_proof is None or non_proof is None:
+                p = bytes([i % 256, i // 256]) + b"\x00" * 94
+                if _is_agg(p, 32):
+                    agg_proof = agg_proof or p
+                else:
+                    non_proof = non_proof or p
+                i += 1
+
+            data = await beacon.attestation_data(3, 0)
+
+            async def att_await(slot, committee_index):
+                return data
+
+            f.register_await_attestation_data(att_await)
+
+            def mk_aggsig(proof):
+                async def aggsig_await(duty, pubkey, root=None):
+                    return BeaconCommitteeSelection(0, 3, proof)
+                return aggsig_await
+
+            f.register_agg_sig_db(mk_aggsig(non_proof))
+            await f.fetch(Duty(3, DutyType.AGGREGATOR),
+                          {PK_A: _att_defn(PK_A, 0)})
+            assert not emitted, "non-aggregator must emit nothing"
+
+            f.register_agg_sig_db(mk_aggsig(agg_proof))
+            await f.fetch(Duty(3, DutyType.AGGREGATOR),
+                          {PK_A: _att_defn(PK_A, 0)})
+            assert emitted and PK_A in emitted[0]
+            assert beacon.calls.get("aggregate_attestation") == 1
+
+        _run(run())
