@@ -109,9 +109,24 @@ class _Window:
         if reqs:
             asyncio.ensure_future(self._run(reqs))
 
-    async def _run(self, reqs) -> None:
+    async def _run(self, reqs, fail_budget: list | None = None) -> None:
         _flush_hist.observe(sum(s for s, _, _ in reqs), self.kind)
         futs = [f for _, _, f in reqs]
+        if fail_budget is None:
+            # A SINGLE bad submission fails at most one dispatch per bisect
+            # level — log2(flush_at)+1 of them. More failed dispatches than
+            # that means the failure is systemic (device/tunnel down, every
+            # item malformed), and a full bisect tree would serially await
+            # up to 2N-1 dispatches at the ~1s device floor — far past the
+            # slot budget (advisor round-4). [remaining, last_exc] is shared
+            # across the whole flush's recursion; once exhausted, pending
+            # subtrees fail in one pass with the last observed exception
+            # instead of dispatching at all.
+            fail_budget = [max(2, self.flush_at).bit_length() + 1, None]
+        elif fail_budget[0] <= 0:
+            for f in futs:
+                _resolve(f, exc=fail_budget[1])
+            return
         try:
             await self._dispatch([p for _, p, _ in reqs], futs)
         except Exception as exc:  # noqa: BLE001 — isolate the offender
@@ -124,11 +139,20 @@ class _Window:
             if len(reqs) == 1:
                 _resolve(futs[0], exc=exc)
                 return
+            fail_budget[0] -= 1
+            fail_budget[1] = exc
+            if fail_budget[0] <= 0:
+                _log.warn(
+                    "coalesced dispatch failing systemically; "
+                    "abandoning bisect", requests=len(reqs))
+                for f in futs:
+                    _resolve(f, exc=exc)
+                return
             _log.debug("coalesced dispatch raised; bisecting",
                        requests=len(reqs))
             mid = len(reqs) // 2
-            await self._run(reqs[:mid])
-            await self._run(reqs[mid:])
+            await self._run(reqs[:mid], fail_budget)
+            await self._run(reqs[mid:], fail_budget)
 
 
 def _resolve(fut: asyncio.Future, result=None, exc=None) -> None:

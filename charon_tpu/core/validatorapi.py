@@ -97,7 +97,12 @@ class Component:
         ROOT validators and each record comes back with the share pubkey
         substituted — so the VC sees ITS keys as active beacon validators.
         Empty ids serve the whole cluster. Returns (validator_record,
-        share_pubkey) pairs; raises on an id outside the cluster (the
+        share_pubkey) pairs. Ids the BN doesn't know — share pubkeys OR
+        numeric indices — are omitted from the result, like the BN's own
+        validators endpoint (an index absent from the BN's response cannot
+        be distinguished from a cluster validator not yet in the head
+        state, so both id forms degrade the same way); a share pubkey
+        outside the cluster still raises from root_by_share_pubkey (the
         reference's pubshare-not-found error)."""
         share_by_root: dict[bytes, bytes] = {}
         want_indices: list[int] = []
@@ -133,9 +138,10 @@ class Component:
                         selected.append((rb, vals[rb]))
                 elif int(raw) in by_index:
                     selected.append(by_index[int(raw)])
-                else:
-                    raise errors.new("validator index not in cluster",
-                                     index=int(raw))
+                # index unknown to the BN: omit, like the pubkey branch
+                # (advisor round-4: the error contradicted both the pubkey
+                # behavior and the docstring for in-cluster validators
+                # absent from the BN's head state)
         return [(dataclasses.replace(v, pubkey=share_by_root[rb]),
                  share_by_root[rb]) for rb, v in selected]
 

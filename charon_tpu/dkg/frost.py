@@ -198,9 +198,19 @@ def _mul_gen_many(scalars: list[int]) -> list[bytes]:
 
 def verify_round1(bcast: Round1Broadcast, threshold: int, context: bytes) -> None:
     """Verify the Schnorr PoK: mu*G == R + challenge*C0
-    (reference frost round1 verification inside kryptology)."""
+    (reference frost round1 verification inside kryptology). Rejects
+    INFINITY commitments up front: C_ik = ∞ means a zero polynomial
+    coefficient — a degenerate dealer (zero contribution to the group key
+    for k=0), which kryptology's verifiers reject as identity points, and
+    which the batched RLC share check must never see as it is the RLC
+    identity element (a random coefficient is zero with prob 1/r, so no
+    honest dealer is ever rejected)."""
     if len(bcast.commitments) != threshold:
         raise errors.new("wrong commitment count", participant=bcast.participant)
+    for k, c in enumerate(bcast.commitments):
+        if len(c) != 48 or (c[0] & 0x40):
+            raise errors.new("infinity or malformed commitment",
+                             participant=bcast.participant, degree=k)
     c = _pok_challenge(bcast.participant, context, bcast.commitments[0], bcast.pok_r)
     lhs = _g1_mul_gen(bcast.pok_mu)
     rhs = _g1_lincomb([bcast.pok_r, bcast.commitments[0]], [1, c])

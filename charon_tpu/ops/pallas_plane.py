@@ -165,10 +165,37 @@ LEAN = False
 WINDOW = 4       # scalar-mul window bits (digit tables of 2^WINDOW entries)
 POW_WINDOW = 4   # fixed-exponent power-scan window bits
 
+_TRACED = False  # any schedule-dependent jit has traced (guard below)
+
+
+def _note_trace() -> None:
+    """Called from the trace-time bodies of the WINDOW/POW_WINDOW-dependent
+    jits so enable_compile_lean can detect too-late activation."""
+    global _TRACED
+    _TRACED = True
+
 
 def enable_compile_lean() -> None:
     global LEAN, WINDOW, POW_WINDOW
+    if LEAN:
+        return
+    if _TRACED:
+        # Flipping the schedule after a trace silently MIXES 4-bit and
+        # 1-bit executables: already-cached window loops would consume
+        # digit planes produced at the new width (advisor round-4). The
+        # flag must be set before the first plane dispatch — normally via
+        # the CHARON_TPU_COMPILE_LEAN env var, read at import.
+        raise RuntimeError(
+            "enable_compile_lean() called after a schedule-dependent jit "
+            "already traced; set CHARON_TPU_COMPILE_LEAN=1 before import "
+            "instead")
     LEAN, WINDOW, POW_WINDOW = True, 1, 1
+    # Interpret-mode muls (the dryrun's CPU path) trace ~4x fewer op
+    # bodies with the CIOS loop fully rolled; runtime cost is irrelevant
+    # at dryrun shapes. Production pallas kernels don't read this.
+    from . import field as _F
+
+    _F.CIOS_UNROLL = 1
 
 
 import os as _os  # noqa: E402
@@ -598,6 +625,7 @@ def _pow_scan(A, edigits):
     step serves every fixed exponent of the same padded digit count. Powers
     the device square-root/inverse chains of the batched point
     decompression and affine serialization (plane_agg)."""
+    _note_trace()
     nt = 1 << POW_WINDOW
     one_col = np.zeros((1, LIMBS, 1, 1), np.int32)
     one_col[0, :, 0, 0] = F.fq_from_int(1)
@@ -627,6 +655,7 @@ def _shared_mul_call(X, Y, Z, k, E):
     per-element 64-bit sweep. Compile-lean mode trades the unrolled chain
     (~2 traced point bodies PER BIT) for the windowed scan with the shared
     scalar broadcast to every lane — ~2 traced bodies TOTAL, same result."""
+    _note_trace()
     assert k >= 1
     if LEAN:
         S, W = X.shape[-2:]
@@ -680,6 +709,7 @@ def _scalar_mul_windowed(X, Y, Z, digits, E):
     masked sum in plain XLA (cheap, HBM-bound); the point ops are the fused
     pallas kernels. digit==0 selects the ∞ entry (Z=0), which the unified
     add treats as identity."""
+    _note_trace()
     tab = [(X * 0, Y * 0, Z * 0), (X, Y, Z)]
     for k in range(2, 1 << WINDOW):
         if k % 2 == 0:

@@ -169,9 +169,12 @@ def threshold_aggregate_and_verify_sharded(
     Same contract as plane_agg.threshold_aggregate_and_verify (and the same
     trust preconditions: partials individually verified upstream). Pubkey
     validation — infinity rejection + subgroup membership, which RLC
-    soundness requires — runs through plane_agg._pk_plane_cached below:
+    soundness requires — runs through plane_agg.validate_pk_set below:
     once per distinct pubkey set per process (a cluster's validator set is
-    static between reconfigurations), not per slot. The per-step sharded
+    static between reconfigurations), not per slot, and via the NATIVE
+    backend so no single-device graph compiles inside the multichip dryrun
+    (the _pk_plane_cached route cold-compiled _g1_subgroup_jit for ~6 min
+    on the driver host — MULTICHIP_r04.json rc=124). The per-step sharded
     graph re-validates curve membership of every decompressed point but
     relies on that amortized subgroup check. Validators are sharded over
     the mesh. Returns (compressed aggregates, all_valid); raises ValueError
@@ -186,7 +189,7 @@ def threshold_aggregate_and_verify_sharded(
         return [], True
     # reject-infinity + subgroup-check the pk set (content-digest cached —
     # one validation per process per pubkey set, advisor round-3 medium)
-    PA._pk_plane_cached([bytes(p) for p in pks], PA._bucket(V))
+    PA.validate_pk_set([bytes(p) for p in pks])
     D = mesh.devices.size
     T = max(len(b) for b in batches)
     if T == 0:

@@ -225,3 +225,58 @@ def test_duplicate_contributor_does_not_fake_quorum(counting_impl):
         assert elapsed >= 0.045, "duplicate contributor faked quorum close"
 
     asyncio.run(run())
+
+
+def test_systemic_failure_abandons_bisect(counting_impl):
+    """Advisor round-4: when EVERY dispatch raises (device/tunnel down, or
+    fallback disabled as in benches), the bisect must not serially await
+    2N-1 dispatches at the ~1s device floor — after the single-offender
+    budget (log2(flush_at)+2 failures) it degrades to one pass, failing
+    the remaining requests with the observed exception."""
+    calls = []
+
+    def exploding_agg(batches, pks, roots):
+        calls.append(len(batches))
+        raise RuntimeError("device down")
+
+    counting_impl.threshold_aggregate_verify_batch = exploding_agg
+
+    async def run():
+        co = TblsCoalescer(window=0.01, flush_at=64)
+        return await asyncio.gather(
+            *[co.aggregate_verify(*_agg_req(1, bytes([i]) * 32))
+              for i in range(64)],
+            return_exceptions=True)
+
+    results = asyncio.run(run())
+    assert all(isinstance(r, RuntimeError) for r in results)
+    # budget: bit_length(64)+1 = 8 failed multi-request dispatches plus the
+    # size-1 leaves reached before exhaustion — far below the uncapped
+    # worst case of 2*64-1 = 127 serial dispatches
+    assert len(calls) <= 16, calls
+
+
+def test_single_offender_bisect_still_isolates(counting_impl):
+    """The budget must NOT truncate the healthy case: one bad request among
+    15 is isolated by the bisect and every innocent request still resolves
+    ok — within the single-offender dispatch budget."""
+    boom = {b"bad" + b"\x00" * 29}
+
+    def raising_agg(batches, pks, roots):
+        if any(r in boom for r in roots):
+            raise ValueError("malformed submission")
+        return [b"\xc0" + bytes(95)] * len(batches), True
+
+    counting_impl.threshold_aggregate_verify_batch = raising_agg
+
+    async def run():
+        co = TblsCoalescer(window=0.01, flush_at=16)
+        reqs = [co.aggregate_verify(*_agg_req(1, bytes([i]) * 32))
+                for i in range(15)]
+        reqs.append(co.aggregate_verify(*_agg_req(1, b"bad" + b"\x00" * 29)))
+        return await asyncio.gather(*reqs, return_exceptions=True)
+
+    results = asyncio.run(run())
+    assert isinstance(results[-1], ValueError)
+    good = results[:-1]
+    assert all(not isinstance(r, Exception) and r[1] is True for r in good)

@@ -1,0 +1,185 @@
+"""Round-timer STRATEGY SIMULATOR over message-delay distributions — the
+analogue of the reference's core/consensus/strategysim_internal_test.go:
+run full QBFT instances through a latency-injecting fabric (per-peer mean
+latency + gaussian jitter), for each round-timer strategy, and measure the
+decided-round / undecided distribution. The reference uses this to compare
+the increasing timer against the double-eager-linear timer under realistic
+network weather; here the same harness drives this repo's production
+timers (charon_tpu/core/consensus.py IncreasingRoundTimer /
+DoubleEagerLinearRoundTimer) through the generic algorithm (core/qbft.py).
+
+Timer constants are scaled 10x down (75 ms round-1 instead of 750 ms) so a
+simulation matrix runs in seconds of wall clock while keeping the
+latency:timeout ratios of the reference configs.
+"""
+
+import asyncio
+import random
+import statistics
+
+import pytest
+
+from charon_tpu.core import consensus
+from charon_tpu.core import qbft
+from charon_tpu.core.qbft import Definition, Msg, Transport
+
+SCALE = 0.1  # timer scale vs production constants (wall-clock economy)
+
+
+class LatencyFabric:
+    """Broadcast fabric that delays each delivery by a per-SENDER gaussian
+    (mean latency per peer + shared stddev), like the reference simulator's
+    latencyPerPeer/latencyStdDev; self-delivery is immediate."""
+
+    def __init__(self, n, latency_s, stddev_s, seed):
+        self.n = n
+        self.queues = {p: asyncio.Queue() for p in range(1, n + 1)}
+        self.latency = latency_s  # {peer -> mean seconds}
+        self.stddev = stddev_s
+        self.rng = random.Random(seed)
+
+    def transport(self, process):
+        async def broadcast(msg: Msg):
+            for p, q in self.queues.items():
+                if p == process:
+                    q.put_nowait(msg)
+                    continue
+                d = max(0.0, self.rng.gauss(
+                    self.latency[process], self.stddev))
+                asyncio.get_running_loop().call_later(d, q.put_nowait, msg)
+
+        return Transport(broadcast, self.queues[process])
+
+
+def _timer_factory(kind: str):
+    """Producer of per-INSTANCE new_timer callables. The simulator runs
+    with consensus.LINEAR_ROUND_INC patched to SCALE seconds (see
+    _run_config), so both strategies keep their production shape at 10x
+    compressed wall clock."""
+    if kind == "inc":
+        return lambda: qbft.increasing_round_timer(
+            base=consensus.INC_ROUND_START * SCALE,
+            inc=consensus.INC_ROUND_INCREASE * SCALE)
+    if kind == "eager_dlinear":
+        return lambda: consensus.DoubleEagerLinearRoundTimer().new_timer
+    raise ValueError(kind)
+
+
+async def _sim_once(n, timer_kind, latency_s, stddev_s, seed, timeout=4.0):
+    """One full instance across n processes; returns (decided_values,
+    decided_rounds, undecided_count)."""
+    fabric = LatencyFabric(n, latency_s, stddev_s, seed)
+    decided = {}
+    rounds = {}
+    mk_timer = _timer_factory(timer_kind)
+
+    tasks = []
+    for p in range(1, n + 1):
+        def mk_decide(p=p):
+            def decide(_inst, value, qcommit):
+                decided[p] = value
+                rounds[p] = max(m.round for m in qcommit)
+            return decide
+
+        timer_new = mk_timer()
+        d = Definition(
+            is_leader=lambda inst, r, proc: (r - 1) % n + 1 == proc,
+            new_timer=timer_new,
+            decide=mk_decide(),
+            nodes=n,
+        )
+        tasks.append(asyncio.create_task(qbft.run(
+            d, fabric.transport(p), "inst", p, f"v{p}")))
+
+    async def all_decided():
+        while len(decided) < n:
+            await asyncio.sleep(0.005)
+
+    try:
+        await asyncio.wait_for(all_decided(), timeout)
+    except asyncio.TimeoutError:
+        pass
+    finally:
+        for t in tasks:
+            t.cancel()
+        await asyncio.gather(*tasks, return_exceptions=True)
+    return decided, rounds, n - len(decided)
+
+
+def _run_config(n, timer_kind, latency_s, stddev_s, iters, seed0):
+    """Run `iters` independent instances; aggregate like the reference's
+    testStrategySimulator: undecided count + decided-round distribution +
+    agreement check inside every instance."""
+    und, rds = 0, []
+    old_linear = consensus.LINEAR_ROUND_INC
+    consensus.LINEAR_ROUND_INC = old_linear * SCALE
+    try:
+        for i in range(iters):
+            decided, rounds, undecided = asyncio.run(_sim_once(
+                n, timer_kind, latency_s, stddev_s, seed=seed0 + i))
+            und += undecided
+            # agreement: every decided process in an instance agrees
+            assert len({str(v) for v in decided.values()}) <= 1, (
+                f"DISAGREEMENT under {timer_kind} latencies={latency_s}")
+            rds.extend(rounds.values())
+    finally:
+        consensus.LINEAR_ROUND_INC = old_linear
+    return und, rds
+
+
+def test_simulator_once():
+    """Reference TestSimulatorOnce shape: 4 peers, symmetric latency well
+    inside the round-1 timeout — everyone decides, no undecided."""
+    lat = {p: 0.010 for p in range(1, 5)}
+    und, rds = _run_config(4, "inc", lat, 0.005, iters=2, seed0=42)
+    assert und == 0
+    assert max(rds) <= 2, rds
+
+
+def test_both_timers_decide_under_moderate_jitter():
+    """Both production strategies must terminate with agreement when the
+    mean latency is ~15% of the round-1 timeout with heavy jitter."""
+    lat = {p: 0.012 for p in range(1, 5)}
+    for kind in ("inc", "eager_dlinear"):
+        und, rds = _run_config(4, kind, lat, 0.008, iters=3, seed0=7)
+        assert und == 0, f"{kind} left undecided instances"
+        assert statistics.median(rds) <= 2, (kind, rds)
+
+
+def test_slow_leader_forces_round_change_and_still_decides():
+    """One slow peer (the round-1 leader) with latency past the round-1
+    timeout: the cluster must round-change and still decide — the scenario
+    the reference's matrix uses to separate the strategies."""
+    lat = {1: 0.200, 2: 0.010, 3: 0.010, 4: 0.010}  # leader 1 very slow
+    for kind in ("inc", "eager_dlinear"):
+        und, rds = _run_config(4, kind, lat, 0.002, iters=3, seed0=99)
+        assert und == 0, f"{kind} undecided with slow leader"
+        assert max(rds) >= 2, f"{kind} impossibly decided round 1: {rds}"
+
+
+@pytest.mark.scale
+def test_matrix_distribution():
+    """The reference's TestMatrix shape (scaled down): a config × strategy
+    sweep printing the decided-round distribution, asserting zero
+    undecided everywhere and that the round distribution stays bounded.
+    Run with -m scale; tune ITERS for accuracy vs duration."""
+    ITERS = 10
+    configs = {
+        "sym-fast": ({p: 0.005 for p in range(1, 5)}, 0.002),
+        "sym-mid": ({p: 0.015 for p in range(1, 5)}, 0.008),
+        "jittery": ({p: 0.010 for p in range(1, 5)}, 0.020),
+        "one-slow": ({1: 0.150, 2: 0.010, 3: 0.010, 4: 0.010}, 0.005),
+    }
+    rows = []
+    for cname, (lat, sd) in configs.items():
+        for kind in ("inc", "eager_dlinear"):
+            und, rds = _run_config(4, kind, lat, sd, iters=ITERS, seed0=13)
+            rows.append((cname, kind, und,
+                         statistics.median(rds) if rds else None,
+                         max(rds) if rds else None))
+    print("\nconfig        timer          undecided  p50round  maxround")
+    for cname, kind, und, p50, mx in rows:
+        print(f"{cname:13} {kind:14} {und:9} {p50!s:9} {mx!s:8}")
+    for cname, kind, und, p50, mx in rows:
+        assert und == 0, f"{cname}/{kind}: {und} undecided"
+        assert mx <= 6, f"{cname}/{kind}: runaway rounds {mx}"

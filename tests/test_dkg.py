@@ -167,6 +167,93 @@ class TestFrostMath:
         assert not frost._verify_shares_device(bad)
 
 
+    def test_device_rlc_rejects_small_order_commitment(self):
+        """Advisor round-4 HIGH regression: an off-subgroup commitment with
+        a small-order component passes the 64-bit-randomizer RLC with
+        probability ~1/order (G1's cofactor is divisible by 3, so order-3
+        points exist on E(Fp) and survive compressed decoding) — the device
+        paths must therefore subgroup-check at decode and raise ValueError
+        (routing callers to exact per-item attribution) instead of
+        probabilistically accepting a corrupted dealer commitment."""
+        from charon_tpu.crypto import fields as F2
+        from charon_tpu.crypto.curve import (
+            B_G1, FqOps, jac_add, jac_double, jac_infinity, jac_is_infinity,
+            to_jacobian)
+        from charon_tpu.crypto.serialize import g1_from_bytes, g1_to_bytes
+        from charon_tpu.ops import plane_agg
+
+        def mul_raw(pt, k):  # no mod-R reduction: k exceeds r on purpose
+            acc = jac_infinity(FqOps)
+            for bit in bin(k)[2:]:
+                acc = jac_double(FqOps, acc)
+                if bit == "1":
+                    acc = jac_add(FqOps, acc, pt)
+            return acc
+
+        # an order-3 point: T = [n/3]P for random on-curve P, n = h*r
+        h = 0x396C8C005555E1568C00AAAB0000AAAB  # E(Fp) cofactor, 3 | h
+        T = None
+        x = 1
+        while T is None and x < 500:
+            y2 = (x * x * x + B_G1) % F2.P
+            y = F2.fq_sqrt(y2)
+            x += 1
+            if y is None:
+                continue
+            cand = mul_raw(to_jacobian(FqOps, (x - 1, y)), h * F2.R // 3)
+            if not jac_is_infinity(FqOps, cand):
+                T = cand
+        assert T is not None and jac_is_infinity(FqOps, mul_raw(T, 3))
+
+        p = frost.Participant(1, 2, 2, b"ctx")
+        b, shares = p.round1()
+        # dealer 1's C0 corrupted by the order-3 component; the share still
+        # matches the commitment polynomial modulo T
+        c0 = g1_from_bytes(b.commitments[0], subgroup_check=False)
+        evil = g1_to_bytes(jac_add(FqOps, c0, T))
+        commitments = [evil] + b.commitments[1:]
+        items = [(2, shares[2], commitments)]
+
+        # generic single-MSM equation: decode must raise, not RLC-accept
+        pts, scs = frost._rlc_share_equation(items)
+        with pytest.raises(ValueError):
+            plane_agg.g1_lincomb_is_infinity(pts, scs)
+        # same-x factored path (g1_groups_msm): same rejection
+        with pytest.raises(ValueError):
+            frost._verify_shares_device(items)
+        # end to end the batch falls back and attributes the dealer exactly
+        with pytest.raises(CharonError):
+            frost.verify_shares_batch(items)
+        # and the per-item oracle agrees the share check fails
+        with pytest.raises(CharonError):
+            frost.verify_share(2, shares[2], commitments)
+
+    def test_infinity_commitment_rejected_everywhere(self):
+        """An INFINITY commitment (zero polynomial coefficient) is a
+        degenerate dealer: kryptology rejects identity points, and the RLC
+        paths must too — ∞ is the RLC identity element and would vanish
+        from the batched equation instead of failing (round-5 review).
+        All three gates reject: the round-1 broadcast verify, the generic
+        device equation, and the same-x device path."""
+        from charon_tpu.ops import plane_agg
+
+        p = frost.Participant(1, 2, 2, b"ctx")
+        b, shares = p.round1()
+        inf = b"\xc0" + bytes(47)
+        evil = [inf] + b.commitments[1:]
+
+        bad_bcast = frost.Round1Broadcast(
+            participant=1, commitments=evil, pok_r=b.pok_r, pok_mu=b.pok_mu)
+        with pytest.raises(CharonError):
+            frost.verify_round1(bad_bcast, 2, b"ctx")
+
+        items = [(2, shares[2], evil)]
+        pts, scs = frost._rlc_share_equation(items)
+        with pytest.raises(ValueError):
+            plane_agg.g1_lincomb_is_infinity(pts, scs)
+        with pytest.raises(ValueError):
+            frost._verify_shares_device(items)
+
     def test_g1_mul_gen_batch_bit_identity(self):
         """The batched fixed-base device serializer must be bit-identical
         to the serial generator multiplication (keygen path)."""
@@ -279,3 +366,32 @@ class TestCeremony:
 
         results = _run(run(), timeout=60)
         assert all(isinstance(r, Exception) for r in results), results
+
+
+@pytest.mark.nightly
+def test_share_verify_fused_device_decode_path(monkeypatch):
+    """Drive the round-5 FUSED device graph (plane_agg.
+    _g1_decode_groups_sweep_jit: batched G1 decompression + subgroup check
+    + RLC sweep + per-degree reduces, ONE dispatch) through interpret-mode
+    kernels: accepts a valid batch, rejects a corrupted share, and raises
+    on an off-subgroup commitment. The default tier covers the native-
+    decode branch; this is the branch the real TPU runs at ceremony
+    sizes."""
+    from charon_tpu.ops import pallas_plane as PP
+    from charon_tpu.ops import plane_agg
+
+    monkeypatch.setattr(PP, "TILE", 64)
+    monkeypatch.setattr(plane_agg, "_device_path", lambda n=0: True)
+
+    items = []
+    for dealer in (1, 2, 3):
+        p = frost.Participant(dealer, 3, 3, b"fx")
+        b, shares = p.round1()
+        items.append((2, shares[2], b.commitments))
+    assert frost._verify_shares_device(items)
+
+    from charon_tpu.crypto import fields as F2
+    bad = list(items)
+    mi, sh, cm = bad[1]
+    bad[1] = (mi, (sh + 1) % F2.R, cm)
+    assert not frost._verify_shares_device(bad)

@@ -20,20 +20,30 @@ from pathlib import Path
 import pytest
 
 REPO = Path(__file__).resolve().parents[1]
-BUDGET_S = 900  # hard cap; driver rc=124 killed ~3000s runs
+# Hard cap. History: r3/r4 both died rc=124 on the DRIVER host. r4's local
+# cold was 542 s and the driver killed it only after a soundness fix
+# silently added the single-device _g1_subgroup_jit compile (+352 s) to
+# the path — that compile is gone (plane_agg.validate_pk_set routes pk
+# validation through the native backend) and the inventory print makes
+# any future graph addition visible. Round-5 measured local cold:
+# 511-542 s across three runs (the floor is Python TRACE time of the
+# interpret-mode graphs plus two sharded executions, not XLA — disabling
+# XLA optimization made it WORSE, >19 min). The cap guards against
+# regression from this floor; the driver's margin comes from the warm
+# machine-keyed persistent cache it shares with this filesystem.
+BUDGET_S = 650
 
 
 @pytest.mark.scale
 def test_dryrun_multichip_cold_budget():
-    env = dict(os.environ)
+    sys.path.insert(0, str(REPO))
+    import __graft_entry__ as entry
+
+    env = entry.dryrun_env(8)  # EXACTLY the driver subprocess recipe
     # throwaway cache => a genuinely cold XLA:CPU compile, like a fresh
     # driver host (the machine-keyed persistent cache would otherwise hide
     # a compile-time regression on THIS box)
     env["JAX_COMPILATION_CACHE_DIR"] = tempfile.mkdtemp(prefix="dryrun_cold_")
-    env["CHARON_TPU_COMPILE_LEAN"] = "1"
-    env["JAX_PLATFORMS"] = "cpu"
-    env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "")
-                        + " --xla_force_host_platform_device_count=8").strip()
     t0 = time.monotonic()
     res = subprocess.run(
         [sys.executable, str(REPO / "__graft_entry__.py"), "dryrun", "8"],

@@ -22,10 +22,15 @@ import __graft_entry__ as graft
 
 
 @pytest.mark.scale
+@pytest.mark.nightly
 @pytest.mark.skipif(len(jax.devices()) < 8, reason="needs 8 virtual devices")
 def test_dryrun_multichip_in_process():
     # conftest provisioned 8 CPU devices, so this runs the shard_map path
-    # in-process (the driver exercises the subprocess-isolation path).
+    # in-process at the PRODUCTION window-4 schedule. Nightly tier
+    # (round-4 verdict weak #6: its cold compile is tens of minutes of one
+    # CI core); the default tier's compile-regression guard is
+    # tests/test_dryrun_budget.py, which cold-runs the exact driver recipe
+    # (compile-lean subprocess) under a hard cap every run.
     graft.dryrun_multichip(8)
 
 

@@ -172,3 +172,41 @@ def test_fused_aggregate_verify_device_pipeline(monkeypatch):
     monkeypatch.setattr(plane_agg, "_device_path", lambda n=0: True)
     monkeypatch.setattr(plane_agg, "_PK_PLANE_CACHE", {})
     run_pipeline_drive()
+
+
+@pytest.mark.nightly
+def test_rlc_verify_batch_chunks_past_tile(monkeypatch):
+    """Bursts past one plane tile verify via TILE-sized CHUNKS of the
+    already-compiled graphs (round-4 weak #2: the 2048-lane fused verify
+    graph exceeded the remote compile service's budget, so a >1024-sig
+    coalesced multi-peer burst could not verify in one flush). The chunks
+    dispatch back-to-back and their per-chunk RLC partial sums combine on
+    the host — this drives correctness ACROSS the chunk seam: validity,
+    a corruption isolated to a non-first chunk, per-chunk group masks for
+    two messages, and an out-of-subgroup point in the last chunk."""
+    monkeypatch.setattr(PP, "TILE", 64)
+    monkeypatch.setattr(plane_agg, "_device_path", lambda n=0: True)
+    monkeypatch.setattr(plane_agg, "_PK_PLANE_CACHE", {})
+
+    n = 150  # 3 chunks at TILE=64: 64 + 64 + 22
+    m1, m2 = b"\x61" * 32, b"\x62" * 32
+    pks, msgs, sigs = [], [], []
+    for i in range(n):
+        sk = _native.generate_secret_key()
+        m = m1 if i % 2 == 0 else m2
+        pks.append(bytes(_native.secret_to_public_key(sk)))
+        msgs.append(m)
+        sigs.append(bytes(_native.sign(sk, m)))
+
+    assert plane_agg.rlc_verify_batch(pks, msgs, sigs) is True
+
+    # corruption living entirely in the SECOND chunk must flip the result
+    bad = list(sigs)
+    bad[100], bad[102] = bad[102], bad[100]  # same message group, wrong keys
+    assert plane_agg.rlc_verify_batch(pks, msgs, bad) is False
+
+    # out-of-subgroup signature in the LAST chunk fails the (chunked)
+    # batched endomorphism check
+    rogue = list(sigs)
+    rogue[-1] = _g2_point_outside_subgroup()
+    assert plane_agg.rlc_verify_batch(pks, msgs, rogue) is False

@@ -319,8 +319,12 @@ class TestIdentityAndConfig:
             # empty ids: whole cluster
             all_v = await h.comp.get_validators([])
             assert len(all_v) == N_VALS
-            with pytest.raises(CharonError):
-                await h.comp.get_validators(["12345"])
+            # an index the BN doesn't know is OMITTED, like the pubkey
+            # branch / the BN's own endpoint (advisor round-4: raising here
+            # contradicted the pubkey behavior for in-cluster validators
+            # absent from the head state)
+            assert await h.comp.get_validators(["12345"]) == []
+            # a share pubkey outside the cluster still raises
             with pytest.raises(CharonError):
                 await h.comp.get_validators(["0x" + "ab" * 48])
 
@@ -351,5 +355,270 @@ class TestSelections:
             sel = BeaconCommitteeSelection(999, 1, b"\x00" * 96)
             with pytest.raises(CharonError):
                 await h.comp.aggregate_beacon_committee_selections([sel])
+
+        _run(run())
+
+
+class TestAggregateAndProofSubmissions:
+    """Error-path table for SubmitAggregateAttestations (reference
+    validatorapi_test.go TestSubmitAggregateAttestations: valid, unknown
+    index, wrong-share signature, garbage signature)."""
+
+    @staticmethod
+    def _signed_agg(h, data, secret=None, aggregator_index=0):
+        from charon_tpu.core.signeddata import (
+            SignedAggregateAndProof as SAP)
+
+        att = spec.Attestation([True, False], data, b"\x00" * 96)
+        msg = spec.AggregateAndProof(aggregator_index, att, b"\x11" * 96)
+        root = SAP(msg).signing_root(h.chain)
+        secret = secret or h.share_secret(h.root())
+        return spec.SignedAggregateAndProof(msg, bytes(tbls.sign(secret, root)))
+
+    def test_valid_submission_emits_parsig(self):
+        async def run():
+            h = Harness()
+            _duty_obj, data = await h.seed_attestation()
+            await h.comp.submit_aggregate_attestations(
+                [self._signed_agg(h, data)])
+            assert len(h.emitted) == 1
+            duty, parsigs = h.emitted[0]
+            assert duty.type == DutyType.AGGREGATOR
+            assert h.root() in parsigs
+
+        _run(run())
+
+    def test_unknown_aggregator_index_rejected(self):
+        async def run():
+            h = Harness()
+            _duty_obj, data = await h.seed_attestation()
+            with pytest.raises(CharonError):
+                await h.comp.submit_aggregate_attestations(
+                    [self._signed_agg(h, data, aggregator_index=777)])
+            assert not h.emitted
+
+        _run(run())
+
+    def test_wrong_share_signature_rejected(self):
+        """Signed with the ROOT secret (a VC holding the wrong key) — the
+        partial verify against MY share pubkey must fail."""
+        async def run():
+            h = Harness()
+            _duty_obj, data = await h.seed_attestation()
+            bad = self._signed_agg(h, data, secret=h.root_secrets[0])
+            with pytest.raises(CharonError):
+                await h.comp.submit_aggregate_attestations([bad])
+            assert not h.emitted
+
+        _run(run())
+
+    def test_garbage_signature_rejected(self):
+        async def run():
+            h = Harness()
+            _duty_obj, data = await h.seed_attestation()
+            agg = self._signed_agg(h, data)
+            bad = spec.SignedAggregateAndProof(agg.message, b"\xaa" * 96)
+            with pytest.raises(CharonError):
+                await h.comp.submit_aggregate_attestations([bad])
+
+        _run(run())
+
+
+class TestSyncCommitteeSubmissions:
+    """Error-path tables for the three sync-committee flows (reference
+    validatorapi_test.go TestSubmitSyncCommitteeMessages /
+    TestSubmitContributionAndProofs)."""
+
+    @staticmethod
+    def _sync_msg(h, slot=1, vindex=0, secret=None):
+        from charon_tpu.core.signeddata import SignedSyncMessage
+
+        msg = spec.SyncCommitteeMessage(slot, b"\x22" * 32, vindex,
+                                        b"\x00" * 96)
+        root = SignedSyncMessage(msg).signing_root(h.chain)
+        secret = secret or h.share_secret(h.root())
+        return dataclasses.replace(msg,
+                                   signature=bytes(tbls.sign(secret, root)))
+
+    def test_sync_message_valid(self):
+        async def run():
+            h = Harness()
+            await h.comp.submit_sync_committee_messages([self._sync_msg(h)])
+            assert len(h.emitted) == 1
+            duty, parsigs = h.emitted[0]
+            assert duty.type == DutyType.SYNC_MESSAGE and h.root() in parsigs
+
+        _run(run())
+
+    def test_sync_message_wrong_share_rejected(self):
+        async def run():
+            h = Harness()
+            bad = self._sync_msg(h, secret=h.root_secrets[0])
+            with pytest.raises(CharonError):
+                await h.comp.submit_sync_committee_messages([bad])
+            assert not h.emitted
+
+        _run(run())
+
+    def test_sync_message_unknown_index_rejected(self):
+        async def run():
+            h = Harness()
+            with pytest.raises(CharonError):
+                await h.comp.submit_sync_committee_messages(
+                    [self._sync_msg(h, vindex=555)])
+
+        _run(run())
+
+    @staticmethod
+    def _signed_contrib(h, slot=1, secret=None, aggregator_index=0):
+        from charon_tpu.core.signeddata import (
+            SignedSyncContributionAndProof as SSCP)
+        from charon_tpu.eth2.spec import (
+            SYNC_COMMITTEE_SIZE, SYNC_COMMITTEE_SUBNET_COUNT)
+
+        nbits = SYNC_COMMITTEE_SIZE // SYNC_COMMITTEE_SUBNET_COUNT
+        contrib = spec.SyncCommitteeContribution(
+            slot, b"\x33" * 32, 2, [False] * nbits, b"\xcc" * 96)
+        msg = spec.ContributionAndProof(aggregator_index, contrib,
+                                        b"\x44" * 96)
+        root = SSCP(msg).signing_root(h.chain)
+        secret = secret or h.share_secret(h.root())
+        return spec.SignedContributionAndProof(
+            msg, bytes(tbls.sign(secret, root)))
+
+    def test_contribution_valid(self):
+        async def run():
+            h = Harness()
+            await h.comp.submit_contribution_and_proofs(
+                [self._signed_contrib(h)])
+            assert len(h.emitted) == 1
+            duty, _ = h.emitted[0]
+            assert duty.type == DutyType.SYNC_CONTRIBUTION
+
+        _run(run())
+
+    def test_contribution_wrong_share_rejected(self):
+        async def run():
+            h = Harness()
+            bad = self._signed_contrib(h, secret=h.root_secrets[0])
+            with pytest.raises(CharonError):
+                await h.comp.submit_contribution_and_proofs([bad])
+            assert not h.emitted
+
+        _run(run())
+
+    def test_sync_selection_combined_roundtrip(self):
+        """aggregate_sync_committee_selections: the partial is emitted to
+        the cluster and the COMBINED selection comes back from AggSigDB —
+        fed here by a simulated sigagg task (reference validatorapi_test.go
+        TestSubmitSyncCommitteeSelections)."""
+        from charon_tpu.core.signeddata import SyncCommitteeSelection
+
+        async def run():
+            h = Harness()
+            sel0 = SyncCommitteeSelection(0, 1, 2)
+            root = sel0.signing_root(h.chain)
+            sel = dataclasses.replace(
+                sel0, sig=bytes(tbls.sign(h.share_secret(h.root()), root)))
+
+            combined = dataclasses.replace(sel0, sig=b"\x77" * 96)
+
+            async def feed():
+                await asyncio.sleep(0.05)
+                from charon_tpu.core.types import Duty as D
+                await h.aggsigdb.store(
+                    D(1, DutyType.PREPARE_SYNC_CONTRIBUTION),
+                    {h.root(): combined})
+
+            feeder = asyncio.ensure_future(feed())
+            out = await h.comp.aggregate_sync_committee_selections([sel])
+            await feeder
+            assert len(out) == 1 and out[0].sig == b"\x77" * 96
+            assert len(h.emitted) == 1
+            duty, _ = h.emitted[0]
+            assert duty.type == DutyType.PREPARE_SYNC_CONTRIBUTION
+
+        _run(run())
+
+    def test_beacon_selection_combined_roundtrip(self):
+        """Same combined round-trip for beacon-committee selections."""
+        async def run():
+            h = Harness()
+            sel0 = BeaconCommitteeSelection(0, 1, b"\x00" * 96)
+            root = sel0.signing_root(h.chain)
+            sel = dataclasses.replace(
+                sel0, sig=bytes(tbls.sign(h.share_secret(h.root()), root)))
+            combined = dataclasses.replace(sel0, sig=b"\x88" * 96)
+
+            async def feed():
+                await asyncio.sleep(0.05)
+                from charon_tpu.core.types import Duty as D
+                await h.aggsigdb.store(
+                    D(1, DutyType.PREPARE_AGGREGATOR), {h.root(): combined})
+
+            feeder = asyncio.ensure_future(feed())
+            out = await h.comp.aggregate_beacon_committee_selections([sel])
+            await feeder
+            assert len(out) == 1 and out[0].sig == b"\x88" * 96
+
+        _run(run())
+
+    def test_beacon_selection_wrong_share_rejected(self):
+        async def run():
+            h = Harness()
+            sel0 = BeaconCommitteeSelection(0, 1, b"\x00" * 96)
+            root = sel0.signing_root(h.chain)
+            bad = dataclasses.replace(
+                sel0, sig=bytes(tbls.sign(h.root_secrets[0], root)))
+            with pytest.raises(CharonError):
+                await h.comp.aggregate_beacon_committee_selections([bad])
+
+        _run(run())
+
+
+class TestDutyEndpointsShareTranslation:
+    """attester/proposer/sync duties come back with SHARE pubkeys
+    substituted (reference validatorapi.go duties wrappers + the VC-side
+    contract that it only knows its share keys)."""
+
+    def test_attester_duties_translated(self):
+        async def run():
+            h = Harness()
+            share_pk = bytes(h.keys.my_share_pubkey(h.root()))
+            duties = await h.comp.attester_duties(0, [share_pk])
+            assert duties, "no attester duties returned"
+            assert all(bytes(d.pubkey) == share_pk for d in duties
+                       if d.validator_index == 0)
+
+        _run(run())
+
+    def test_attester_duties_unknown_share_pubkey_raises(self):
+        async def run():
+            h = Harness()
+            with pytest.raises(CharonError):
+                await h.comp.attester_duties(0, [b"\xab" * 48])
+
+        _run(run())
+
+    def test_share_pubkeys_by_index(self):
+        async def run():
+            h = Harness()
+            share_pk = bytes(h.keys.my_share_pubkey(h.root()))
+            got = await h.comp.share_pubkeys_by_index([0])
+            assert got == [share_pk]
+
+        _run(run())
+
+
+class TestVoluntaryExitErrors:
+    def test_unknown_validator_index_rejected(self):
+        async def run():
+            h = Harness()
+            from charon_tpu.core.signeddata import SignedExit as SE
+
+            msg = spec.VoluntaryExit(epoch=0, validator_index=444)
+            with pytest.raises(CharonError):
+                await h.comp.submit_voluntary_exit(
+                    spec.SignedVoluntaryExit(msg, b"\x00" * 96))
 
         _run(run())
