@@ -114,21 +114,27 @@ class _Window:
         futs = [f for _, _, f in reqs]
         if fail_budget is None:
             # A SINGLE bad submission fails at most one dispatch per bisect
-            # level — log2(flush_at)+1 of them. More failed dispatches than
-            # that means the failure is systemic (device/tunnel down, every
-            # item malformed), and a full bisect tree would serially await
-            # up to 2N-1 dispatches at the ~1s device floor — far past the
-            # slot budget (advisor round-4). [remaining, last_exc] is shared
-            # across the whole flush's recursion; once exhausted, pending
-            # subtrees fail in one pass with the last observed exception
-            # instead of dispatching at all.
-            fail_budget = [max(2, self.flush_at).bit_length() + 1, None]
+            # level — log2(flush_at)+1 of them. More CONSECUTIVE failures
+            # than that with no success anywhere means the failure is
+            # systemic (device/tunnel down, every item malformed), and a
+            # full bisect tree would serially await up to 2N-1 dispatches
+            # at the ~1s device floor — far past the slot budget (advisor
+            # round-4). [remaining, last_exc, initial] is shared across the
+            # whole flush's recursion; each SUCCESSFUL dispatch refills the
+            # budget (k scattered offenders produce healthy sibling batches
+            # between failures, so isolation completes — only a
+            # success-free failure streak abandons). Once exhausted,
+            # pending subtrees fail in one pass with the last observed
+            # exception instead of dispatching at all.
+            b0 = max(2, self.flush_at).bit_length() + 1
+            fail_budget = [b0, None, b0]
         elif fail_budget[0] <= 0:
             for f in futs:
                 _resolve(f, exc=fail_budget[1])
             return
         try:
             await self._dispatch([p for _, p, _ in reqs], futs)
+            fail_budget[0] = fail_budget[2]  # success: refill the streak
         except Exception as exc:  # noqa: BLE001 — isolate the offender
             # One malformed submission (e.g. bytes that fail the device
             # parse) must not fail every duty sharing the window. Bisect:

@@ -31,6 +31,15 @@ class DeserializationError(ValueError):
     pass
 
 
+def g1_finite_compressed(data: bytes) -> bool:
+    """Cheap flag-level check: 48 bytes, compression bit set, NOT the point
+    at infinity. The single source of truth for call sites that must
+    reject ∞ before a decoder that would accept it (pubkey sets for RLC
+    verification, FROST dealer commitments) — the full on-curve/subgroup
+    work stays in the decoders."""
+    return len(data) == 48 and bool(data[0] & _COMP) and not (data[0] & _INF)
+
+
 def g1_to_bytes(pt_jac) -> bytes:
     aff = to_affine(FqOps, pt_jac)
     if aff is None:

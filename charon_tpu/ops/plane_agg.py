@@ -1036,11 +1036,13 @@ def validate_pk_set(pks: list[bytes]) -> None:
     except Exception:  # noqa: BLE001 — no native lib → device fallback
         lib = None
     if lib is not None:
+        from ..crypto.serialize import g1_finite_compressed
+
         for i, p in enumerate(pks):
-            if len(p) != 48:
-                raise ValueError(f"pubkey {i}: bad length {len(p)}")
-            if p[0] & 0x40:  # infinity flag — RLC soundness rejects ∞ pks
-                raise ValueError(f"pubkey {i}: point at infinity")
+            # finite-compressed flag check (RLC soundness rejects ∞ pks),
+            # then native decode+subgroup (bls12381.cpp g1_from_bytes)
+            if not g1_finite_compressed(p):
+                raise ValueError(f"pubkey {i}: not a finite compressed G1")
             if lib.ct_g1_check(p) != 1:
                 raise ValueError(f"pubkey {i}: not a valid subgroup point")
     else:
@@ -1214,6 +1216,17 @@ def rlc_verify_batch(pks: list[bytes], msgs: list[bytes], sigs: list[bytes],
     # per-chunk RLC partial sums combine on the host with K-1 Jacobian
     # adds (the RLC equation is a sum; splitting lanes splits the sum).
     # Nothing ever compiles at >TILE lanes.
+    state = rlc_verify_dispatch(pks, msgs, sigs)
+    return rlc_verify_finish(state, hash_fn)
+
+
+def rlc_verify_dispatch(pks, msgs, sigs):
+    """Host parse + ASYNC device dispatch of one verify batch; returns the
+    pending state for rlc_verify_finish. Callers overlap the next batch's
+    host parse (or any host work) with this batch's device execution —
+    the parsigex steady state, mirroring _fused_dispatch/_fused_finish on
+    the sigagg side. Device path only (rlc_verify_batch gates)."""
+    n = len(msgs)
     chunks = ([(0, n)] if n <= PP.TILE else
               [(s, min(s + PP.TILE, n)) for s in range(0, n, PP.TILE)])
     # distinct-message groups are GLOBAL so chunk g-indices agree
@@ -1238,7 +1251,16 @@ def rlc_verify_batch(pks: list[bytes], msgs: list[bytes], sigs: list[bytes],
                 pk_plane.X, pk_plane.Y, pk_plane.Z, jnp.asarray(gmask),
                 G=G))
     except ValueError:
+        return ("invalid",)
+    return ("pending", G, group_msgs, pending)
+
+
+def rlc_verify_finish(state, hash_fn=None) -> bool:
+    """Block on the dispatched chunks, combine the per-chunk RLC sums and
+    run the multi-pairing."""
+    if state[0] == "invalid":
         return False
+    _tag, G, group_msgs, pending = state
     S = None
     Pg: list = [None] * G
     for outs in pending:

@@ -280,3 +280,39 @@ def test_single_offender_bisect_still_isolates(counting_impl):
     assert isinstance(results[-1], ValueError)
     good = results[:-1]
     assert all(not isinstance(r, Exception) and r[1] is True for r in good)
+
+
+def test_two_offenders_do_not_abandon_healthy_requests(counting_impl):
+    """Review round-5: the fail budget REFILLS on every successful
+    dispatch, so k scattered offenders (whose healthy sibling batches
+    succeed between failures) are fully isolated — only a success-free
+    failure streak (truly systemic) abandons the bisect. Two byzantine
+    peers in a 64-request flush must not fail the other 62."""
+    boom = {b"badA" + b"\x00" * 28, b"badB" + b"\x00" * 28}
+
+    def raising_agg(batches, pks, roots):
+        if any(r in boom for r in roots):
+            raise ValueError("malformed submission")
+        return [b"\xc0" + bytes(95)] * len(batches), True
+
+    counting_impl.threshold_aggregate_verify_batch = raising_agg
+
+    async def run():
+        co = TblsCoalescer(window=0.01, flush_at=64)
+        reqs = []
+        for i in range(64):
+            if i == 10:
+                reqs.append(co.aggregate_verify(
+                    *_agg_req(1, b"badA" + b"\x00" * 28)))
+            elif i == 50:
+                reqs.append(co.aggregate_verify(
+                    *_agg_req(1, b"badB" + b"\x00" * 28)))
+            else:
+                reqs.append(co.aggregate_verify(*_agg_req(1, bytes([i]) * 32)))
+        return await asyncio.gather(*reqs, return_exceptions=True)
+
+    results = asyncio.run(run())
+    assert isinstance(results[10], ValueError)
+    assert isinstance(results[50], ValueError)
+    good = [r for i, r in enumerate(results) if i not in (10, 50)]
+    assert all(not isinstance(r, Exception) and r[1] is True for r in good)
