@@ -57,6 +57,19 @@ def _err(status: int, message: str) -> web.Response:
     return web.json_response({"code": status, "message": message}, status=status)
 
 
+def _decode(fn):
+    """Run a request-body decode callable; STRUCTURALLY wrong JSON (a dict
+    where a list of containers belongs, a string where an object belongs)
+    surfaces from the decoders as TypeError/AttributeError — remap those to
+    ValueError so the error middleware's client-error arm returns 400,
+    WITHOUT widening the middleware itself (which would misreport internal
+    handler bugs as client errors and skip their 500 log line)."""
+    try:
+        return fn()
+    except (TypeError, AttributeError) as exc:
+        raise ValueError(f"malformed body: {exc}") from exc
+
+
 def _hex_arg(request: web.Request, name: str) -> bytes:
     raw = request.query.get(name, "")
     if not raw:
@@ -211,7 +224,8 @@ class VapiRouter:
     async def _submit_attestations(self, request: web.Request) -> web.Response:
         with _req_hist.observe_time("submit_attestations"):
             body = await request.json()
-            atts = [jc.decode_container(spec.Attestation, o) for o in body]
+            atts = _decode(lambda: [
+                jc.decode_container(spec.Attestation, o) for o in body])
             await self._comp.submit_attestations(atts)
             return web.json_response({})
 
@@ -244,7 +258,7 @@ class VapiRouter:
         with _req_hist.observe_time("submit_blinded_block"):
             body = await request.json()
             await self._comp.submit_blinded_block(
-                jc.decode_signed_beacon_block(body))
+                _decode(lambda: jc.decode_signed_beacon_block(body)))
             return web.json_response({})
 
     async def _prepare_proposer(self, request: web.Request) -> web.Response:
@@ -265,7 +279,7 @@ class VapiRouter:
                 ids.extend(x.strip() for x in csv.split(",") if x.strip())
             if request.method == "POST" and request.can_read_body:
                 body = await request.json()
-                for x in (body or {}).get("ids") or []:
+                for x in _decode(lambda: (body or {}).get("ids") or []):
                     ids.append(str(x))
             vals = await self._comp.get_validators(ids)
             return _data([_encode_validator(v) for v, _share in vals])
@@ -284,7 +298,7 @@ class VapiRouter:
     async def _submit_block(self, request: web.Request) -> web.Response:
         with _req_hist.observe_time("submit_block"):
             body = await request.json()
-            await self._comp.submit_block(jc.decode_signed_beacon_block(body))
+            await self._comp.submit_block(_decode(lambda: jc.decode_signed_beacon_block(body)))
             return web.json_response({})
 
     async def _aggregate_attestation(self, request: web.Request) -> web.Response:
@@ -297,14 +311,18 @@ class VapiRouter:
     async def _submit_aggregates(self, request: web.Request) -> web.Response:
         with _req_hist.observe_time("submit_aggregates"):
             body = await request.json()
-            aggs = [jc.decode_container(spec.SignedAggregateAndProof, o) for o in body]
+            aggs = _decode(lambda: [
+                jc.decode_container(spec.SignedAggregateAndProof, o)
+                for o in body])
             await self._comp.submit_aggregate_attestations(aggs)
             return web.json_response({})
 
     async def _submit_sync_messages(self, request: web.Request) -> web.Response:
         with _req_hist.observe_time("submit_sync_messages"):
             body = await request.json()
-            msgs = [jc.decode_container(spec.SyncCommitteeMessage, o) for o in body]
+            msgs = _decode(lambda: [
+                jc.decode_container(spec.SyncCommitteeMessage, o)
+                for o in body])
             await self._comp.submit_sync_committee_messages(msgs)
             return web.json_response({})
 
@@ -319,21 +337,27 @@ class VapiRouter:
     async def _submit_contributions(self, request: web.Request) -> web.Response:
         with _req_hist.observe_time("submit_contributions"):
             body = await request.json()
-            contribs = [jc.decode_container(spec.SignedContributionAndProof, o) for o in body]
+            contribs = _decode(lambda: [
+                jc.decode_container(spec.SignedContributionAndProof, o)
+                for o in body])
             await self._comp.submit_contribution_and_proofs(contribs)
             return web.json_response({})
 
     async def _bc_selections(self, request: web.Request) -> web.Response:
         with _req_hist.observe_time("beacon_committee_selections"):
             body = await request.json()
-            sels = [jc.decode_container(spec.BeaconCommitteeSelection, o) for o in body]
+            sels = _decode(lambda: [
+                jc.decode_container(spec.BeaconCommitteeSelection, o)
+                for o in body])
             combined = await self._comp.aggregate_beacon_committee_selections(sels)
             return _data([jc.encode_container(s) for s in combined])
 
     async def _sc_selections(self, request: web.Request) -> web.Response:
         with _req_hist.observe_time("sync_committee_selections"):
             body = await request.json()
-            sels = [jc.decode_container(spec.SyncCommitteeSelection, o) for o in body]
+            sels = _decode(lambda: [
+                jc.decode_container(spec.SyncCommitteeSelection, o)
+                for o in body])
             combined = await self._comp.aggregate_sync_committee_selections(sels)
             return _data([jc.encode_container(s) for s in combined])
 
@@ -341,13 +365,16 @@ class VapiRouter:
         with _req_hist.observe_time("voluntary_exit"):
             body = await request.json()
             await self._comp.submit_voluntary_exit(
-                jc.decode_container(spec.SignedVoluntaryExit, body))
+                _decode(lambda: jc.decode_container(
+                    spec.SignedVoluntaryExit, body)))
             return web.json_response({})
 
     async def _register(self, request: web.Request) -> web.Response:
         with _req_hist.observe_time("register_validator"):
             body = await request.json()
-            regs = [jc.decode_container(spec.SignedValidatorRegistration, o) for o in body]
+            regs = _decode(lambda: [
+                jc.decode_container(spec.SignedValidatorRegistration, o)
+                for o in body])
             await self._comp.submit_validator_registrations(regs)
             return web.json_response({})
 
@@ -383,6 +410,10 @@ async def _error_middleware(request: web.Request, handler):
     except asyncio.TimeoutError:
         return _err(408, "request timed out awaiting consensus data")
     except (KeyError, ValueError) as exc:
+        # ValueError covers JSONDecodeError and the _decode remap of
+        # structurally-wrong bodies; TypeError/AttributeError stay on the
+        # 500 path so internal handler bugs are logged, not blamed on the
+        # client
         return _err(400, f"bad request: {exc}")
     except errors.CharonError as exc:
         # component rejections (unknown pubkey, invalid partial sig, bad

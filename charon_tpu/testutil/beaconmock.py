@@ -72,6 +72,8 @@ class BeaconMock:
         return self._spec
 
     async def node_syncing(self) -> bool:
+        if "node_syncing" in self.overrides:
+            return await self.overrides["node_syncing"]()
         return self.syncing
 
     async def validators_by_pubkey(self, pubkeys: list[bytes]) -> dict[bytes, spec.Validator]:

@@ -1,0 +1,102 @@
+"""Multi-BN failover matrix (reference app/eth2wrap/eth2wrap.go:100
+best-node selector + forkjoin fan-out): parallel first-success-wins racing,
+loser cancellation, best-node stickiness, and the all-failed error path."""
+
+import asyncio
+
+import pytest
+
+from charon_tpu.eth2.beacon import MultiBeaconNode
+from charon_tpu.utils.errors import CharonError
+
+
+class StubBN:
+    """Scriptable beacon node: per-method (delay, result-or-exception)."""
+
+    def __init__(self, name, delay=0.0, fail=None, result="ok"):
+        self.name = name
+        self.delay = delay
+        self.fail = fail
+        self.result = result
+        self.calls = 0
+        self.cancelled = 0
+
+    async def attestation_data(self, slot, committee_index):
+        self.calls += 1
+        try:
+            if self.delay:
+                await asyncio.sleep(self.delay)
+        except asyncio.CancelledError:
+            self.cancelled += 1
+            raise
+        if self.fail is not None:
+            raise self.fail
+        return (self.name, self.result, slot)
+
+
+def _run(coro, timeout=30):
+    async def wrapped():
+        return await asyncio.wait_for(coro, timeout)
+
+    return asyncio.run(wrapped())
+
+
+def test_requires_at_least_one_node():
+    with pytest.raises(CharonError):
+        MultiBeaconNode([])
+
+
+def test_single_node_no_race():
+    bn = StubBN("a")
+    multi = MultiBeaconNode([bn])
+    got = _run(multi.attestation_data(3, 0))
+    assert got == ("a", "ok", 3) and bn.calls == 1
+
+
+def test_first_success_wins_and_losers_cancelled():
+    fast = StubBN("fast", delay=0.01)
+    slow = StubBN("slow", delay=5.0)
+    multi = MultiBeaconNode([slow, fast])
+
+    async def race():
+        got = await multi.attestation_data(1, 0)
+        # same loop as the race: let the cancelled loser task unwind
+        await asyncio.sleep(0.05)
+        return got
+
+    got = _run(race())
+    assert got[0] == "fast"
+    assert multi._best == 1          # winner becomes the preferred node
+    assert slow.cancelled == 1, "losing racer was not cancelled"
+
+
+def test_failing_node_does_not_block_success():
+    bad = StubBN("bad", fail=RuntimeError("503"))
+    good = StubBN("good", delay=0.05)
+    multi = MultiBeaconNode([bad, good])
+    got = _run(multi.attestation_data(2, 1))
+    assert got == ("good", "ok", 2)
+    assert multi._best == 1
+
+
+def test_all_nodes_failing_raises_wrapped():
+    bns = [StubBN(f"n{i}", fail=RuntimeError(f"down{i}")) for i in range(3)]
+    multi = MultiBeaconNode(bns)
+    with pytest.raises(CharonError) as ei:
+        _run(multi.attestation_data(9, 0))
+    assert "all beacon nodes failed" in str(ei.value)
+    assert all(b.calls == 1 for b in bns)
+
+
+def test_sticky_best_after_mixed_outcomes():
+    """A node that failed last round can win the next (per-request race,
+    no permanent blacklisting — the reference reselects each call)."""
+    flaky = StubBN("flaky", fail=RuntimeError("503"))
+    steady = StubBN("steady", delay=0.02)
+    multi = MultiBeaconNode([flaky, steady])
+    assert _run(multi.attestation_data(1, 0))[0] == "steady"
+    flaky.fail = None
+    flaky.delay = 0.0
+    got = _run(multi.attestation_data(2, 0))
+    assert got[0] == "flaky"         # recovered node wins on speed again
+    assert multi._best == 0

@@ -448,3 +448,195 @@ class TestScheduler:
             assert far in sched._resolved_epochs
 
         asyncio.run(run())
+
+
+def test_tracker_reason_taxonomy_matrix():
+    """The reference's reason.go mapping, table-driven: for a duty whose
+    pipeline stalls after step K, the report names the FIRST step after the
+    furthest successful one and the step's root-cause code; a recorded
+    error at/after that step refines the reason string (reference
+    analyseDutyFailed tracker.go:223)."""
+
+    async def run():
+        from charon_tpu.core import tracker as tracker_mod
+
+        class StubDeadliner:
+            def add(self, duty):
+                return True
+
+        CASES = [
+            # (events up to..., expected failed_step, expected reason_code)
+            ([], "scheduler", "not_scheduled"),
+            ([("scheduler", None)], "fetcher", "fetch_error"),
+            ([("scheduler", None), ("fetcher", None)],
+             "consensus", "no_consensus"),
+            ([("scheduler", None), ("fetcher", None), ("consensus", None)],
+             "dutydb", "dutydb_error"),
+            ([("scheduler", None), ("fetcher", None), ("consensus", None),
+              ("dutydb", None)], "parsigdb_internal", "vc_not_submitted"),
+            ([("scheduler", None), ("fetcher", None), ("consensus", None),
+              ("dutydb", None), ("parsigdb_internal", None)],
+             "parsigex", "parsigs_not_exchanged"),
+            ([("scheduler", None), ("fetcher", None), ("consensus", None),
+              ("dutydb", None), ("parsigdb_internal", None),
+              ("parsigex", None)],
+             "parsigdb_external", "insufficient_parsigs"),
+            ([("scheduler", None), ("fetcher", None), ("consensus", None),
+              ("dutydb", None), ("parsigdb_internal", None),
+              ("parsigex", None), ("parsigdb_external", None)],
+             "sigagg", "aggregation_failed"),
+            # an error recorded AT a later step wins the attribution
+            ([("scheduler", None), ("fetcher", None), ("consensus", None),
+              ("dutydb", None), ("parsigdb_internal", None),
+              ("parsigex", None), ("parsigdb_external", None),
+              ("sigagg", None), ("aggsigdb", None),
+              ("bcast", RuntimeError("bn 503"))],
+             "bcast", "bcast_failed"),
+        ]
+        for i, (events, want_step, want_code) in enumerate(CASES):
+            tr = tracker_mod.Tracker(StubDeadliner(), num_shares=4)
+            duty = types.Duty(10 + i, types.DutyType.ATTESTER)
+            for comp, err in events:
+                await tr.report_event(comp, duty, None, err)
+            report = tr._analyse(
+                duty, tr._duties.pop(duty, tracker_mod._DutyEvents()))
+            assert not report.success
+            assert report.failed_step == want_step, (
+                f"case {i}: {report.failed_step} != {want_step}")
+            assert report.reason_code == want_code, (
+                f"case {i}: {report.reason_code} != {want_code}")
+            if events and events[-1][1] is not None:
+                assert "bn 503" in report.reason
+
+        # success: a clean bcast regardless of earlier errors elsewhere
+        tr = tracker_mod.Tracker(StubDeadliner(), num_shares=4)
+        duty = types.Duty(99, types.DutyType.ATTESTER)
+        await tr.report_event("fetcher", duty, None, RuntimeError("flaky"))
+        await tr.report_event("bcast", duty, None, None)
+        report = tr._analyse(duty, tr._duties.pop(duty))
+        assert report.success
+
+    asyncio.run(run())
+
+
+def test_tracker_even_split_blames_no_peer():
+    """2-vs-2 divergent roots: the divergence is reported (root cause) but
+    no individual peer is named — either side is equally plausible
+    (reference extractParSigs majority rule)."""
+
+    async def run():
+        from charon_tpu.core import tracker as tracker_mod
+
+        chain = spec.ChainSpec(genesis_time=0)
+        _, nodes = new_cluster_for_t(1, 3, 4)
+        root = nodes[0].root_pubkeys[0]
+
+        class StubDeadliner:
+            def add(self, duty):
+                return True
+
+        tr = tracker_mod.Tracker(StubDeadliner(), num_shares=4)
+        duty = types.Duty(7, types.DutyType.ATTESTER)
+        for i, node in enumerate(nodes):
+            data = _att_data(slot=7 if i < 2 else 8)  # 2-vs-2 split
+            await tr.report_event(
+                "parsigdb_external", duty,
+                {root: _psd(chain, node.my_share_secrets[root], i + 1, data)},
+                None)
+        report = tr._analyse(duty, tr._duties.pop(duty))
+        assert not report.success
+        assert report.inconsistent == set(), report   # nobody named
+        assert report.reason_code == "inconsistent_parsigs", report
+
+    asyncio.run(run())
+
+
+class TestSchedulerRunLoop:
+    """Run-loop behaviors the epoch-resolution tests don't reach
+    (reference scheduler.go waitChainStart:649 / waitBeaconSync:674 +
+    intra-slot duty offsets): the scheduler must hold before genesis,
+    hold while the BN reports syncing, then emit duties in offset order,
+    and a crashing subscriber must not kill the tick loop."""
+
+    def test_waits_for_chain_start_and_bn_sync(self):
+        from charon_tpu.core.scheduler import Scheduler
+        from charon_tpu.eth2.beacon import ValidatorCache
+        from charon_tpu.testutil.beaconmock import BeaconMock
+
+        async def run():
+            t = {"now": -0.35}  # genesis at 0: start BEFORE chain start
+            pks = [bytes([1]) * 48]
+            beacon = BeaconMock(pks, genesis_time=0, slots_per_epoch=4,
+                                seconds_per_slot=0.2)
+            syncing_polls = {"n": 2}
+
+            async def node_syncing():
+                if syncing_polls["n"] > 0:
+                    syncing_polls["n"] -= 1
+                    return True
+                return False
+
+            beacon.overrides["node_syncing"] = node_syncing
+            valcache = ValidatorCache(beacon, pks)
+            sched = Scheduler(beacon, valcache, clock=lambda: t["now"])
+            emitted = []
+
+            async def on_duty(duty, defset):
+                emitted.append(duty)
+                if len(emitted) >= 2:
+                    sched.stop()
+
+            sched.subscribe_duties(on_duty)
+
+            async def advance():
+                # wall-clock driver for the fake clock
+                for _ in range(600):
+                    await asyncio.sleep(0.005)
+                    t["now"] += 0.05
+                sched.stop()
+
+            drv = asyncio.ensure_future(advance())
+            await asyncio.wait_for(sched.run(), 20)
+            drv.cancel()
+            assert syncing_polls["n"] == 0, "never polled BN sync status"
+            assert emitted, "no duties emitted after chain start"
+
+        asyncio.run(run())
+
+    def test_crashing_subscriber_does_not_stop_emission(self):
+        from charon_tpu.core.scheduler import Scheduler
+        from charon_tpu.eth2.beacon import ValidatorCache
+        from charon_tpu.testutil.beaconmock import BeaconMock
+
+        async def run():
+            t = {"now": 0.0}
+            pks = [bytes([1]) * 48]
+            beacon = BeaconMock(pks, genesis_time=0, slots_per_epoch=4,
+                                seconds_per_slot=0.2)
+            valcache = ValidatorCache(beacon, pks)
+            sched = Scheduler(beacon, valcache, clock=lambda: t["now"])
+            seen = []
+
+            async def bad_sub(duty, defset):
+                raise RuntimeError("subscriber bug")
+
+            async def good_sub(duty, defset):
+                seen.append(duty)
+                if len(seen) >= 2:
+                    sched.stop()
+
+            sched.subscribe_duties(bad_sub)
+            sched.subscribe_duties(good_sub)
+
+            async def advance():
+                for _ in range(600):
+                    await asyncio.sleep(0.005)
+                    t["now"] += 0.05
+                sched.stop()
+
+            drv = asyncio.ensure_future(advance())
+            await asyncio.wait_for(sched.run(), 20)
+            drv.cancel()
+            assert len(seen) >= 2, "good subscriber starved by crashing one"
+
+        asyncio.run(run())

@@ -1,0 +1,156 @@
+"""DutyDB unit depth (reference core/dutydb/memory_test.go): the
+slashing-protection unique index, blocking awaits resolving on store,
+per-committee/per-proposer conflict rejection, deadline-expired drops, and
+the aggregate/sync-contribution resolution paths."""
+
+import asyncio
+
+import pytest
+
+from charon_tpu.core import dutydb
+from charon_tpu.core.types import Duty, DutyType
+from charon_tpu.core.unsigneddata import (
+    AggregatedAttestationUnsigned,
+    AttestationDataUnsigned,
+    ProposalUnsigned,
+    SyncContributionUnsigned,
+)
+from charon_tpu.eth2 import spec
+from charon_tpu.utils.errors import CharonError
+
+
+def _att_unsigned(slot=3, committee=0, vci=0, pk=b"\x01" * 48, beacon=b"\x07"):
+    duty_obj = spec.AttesterDuty(
+        pubkey=pk, slot=slot, validator_index=0, committee_index=committee,
+        committee_length=2, committees_at_slot=1,
+        validator_committee_index=vci)
+    data = spec.AttestationData(slot, committee, beacon * 32,
+                                spec.Checkpoint(0, b"\x02" * 32),
+                                spec.Checkpoint(1, b"\x03" * 32))
+    return AttestationDataUnsigned(data, duty_obj)
+
+
+def _run(coro, timeout=20):
+    async def wrapped():
+        return await asyncio.wait_for(coro, timeout)
+
+    return asyncio.run(wrapped())
+
+
+def test_unique_index_idempotent_and_conflicting():
+    """Storing the SAME agreed data twice is fine; different data for the
+    same duty+validator is the slashing signal and must raise
+    (reference memory.go:76-157)."""
+
+    async def run():
+        db = dutydb.MemDB()
+        duty = Duty(3, DutyType.ATTESTER)
+        pk = b"\x01" * 48
+        u = _att_unsigned(pk=pk)
+        await db.store(duty, {pk: u})
+        await db.store(duty, {pk: u})  # idempotent re-store
+        evil = _att_unsigned(pk=pk, beacon=b"\x99")
+        with pytest.raises(CharonError, match="slashing"):
+            await db.store(duty, {pk: evil})
+
+    _run(run())
+
+
+def test_await_attestation_resolves_on_store():
+    async def run():
+        db = dutydb.MemDB()
+        waiter = asyncio.ensure_future(db.await_attestation(3, 0))
+        await asyncio.sleep(0.01)
+        assert not waiter.done()
+        u = _att_unsigned()
+        await db.store(Duty(3, DutyType.ATTESTER), {b"\x01" * 48: u})
+        got = await asyncio.wait_for(waiter, 5)
+        assert got.hash_tree_root() == u.data.hash_tree_root()
+        # and a late query gets the cached value immediately
+        again = await asyncio.wait_for(db.await_attestation(3, 0), 1)
+        assert again.hash_tree_root() == u.data.hash_tree_root()
+
+    _run(run())
+
+
+def test_conflicting_committee_data_rejected():
+    """Two validators of the SAME committee must carry the same agreed
+    attestation data; a divergent one is rejected."""
+
+    async def run():
+        db = dutydb.MemDB()
+        duty = Duty(3, DutyType.ATTESTER)
+        await db.store(duty, {b"\x01" * 48: _att_unsigned(vci=0)})
+        bad = _att_unsigned(vci=1, pk=b"\x02" * 48, beacon=b"\x55")
+        with pytest.raises(CharonError, match="conflicting attestation"):
+            await db.store(duty, {b"\x02" * 48: bad})
+
+    _run(run())
+
+
+def test_conflicting_proposer_rejected():
+    async def run():
+        db = dutydb.MemDB()
+        duty = Duty(4, DutyType.PROPOSER)
+        block = spec.BeaconBlock(
+            slot=4, proposer_index=0, parent_root=b"\x01" * 32,
+            state_root=b"\x02" * 32, body_root=b"\x03" * 32)
+        await db.store(duty, {b"\x01" * 48: ProposalUnsigned(block)})
+        with pytest.raises(CharonError, match="conflicting block proposer"):
+            await db.store(duty, {b"\x02" * 48: ProposalUnsigned(block)})
+        assert db.proposer_pubkey(4) == b"\x01" * 48
+
+    _run(run())
+
+
+def test_expired_duty_dropped_by_deadliner():
+    class ExpiredDeadliner:
+        def add(self, duty):
+            return False
+
+        async def expired(self):
+            while True:
+                await asyncio.sleep(3600)
+
+    async def run():
+        db = dutydb.MemDB(deadliner=ExpiredDeadliner())
+        duty = Duty(3, DutyType.ATTESTER)
+        await db.store(duty, {b"\x01" * 48: _att_unsigned()})
+        waiter = asyncio.ensure_future(db.await_attestation(3, 0))
+        await asyncio.sleep(0.02)
+        assert not waiter.done(), "expired duty should not have stored"
+        waiter.cancel()
+
+    _run(run())
+
+
+def test_agg_attestation_and_sync_contribution_resolution():
+    async def run():
+        db = dutydb.MemDB()
+        data = spec.AttestationData(6, 0, b"\x07" * 32,
+                                    spec.Checkpoint(0, b"\x02" * 32),
+                                    spec.Checkpoint(1, b"\x03" * 32))
+        att = spec.Attestation([True, False], data, b"\xaa" * 96)
+        root = data.hash_tree_root()
+        waiter = asyncio.ensure_future(db.await_agg_attestation(6, root))
+        await asyncio.sleep(0.01)
+        await db.store(Duty(6, DutyType.AGGREGATOR),
+                       {b"\x01" * 48: AggregatedAttestationUnsigned(att)})
+        got = await asyncio.wait_for(waiter, 5)
+        assert got.data.hash_tree_root() == root
+
+        from charon_tpu.eth2.spec import (
+            SYNC_COMMITTEE_SIZE, SYNC_COMMITTEE_SUBNET_COUNT)
+
+        nbits = SYNC_COMMITTEE_SIZE // SYNC_COMMITTEE_SUBNET_COUNT
+        contrib = spec.SyncCommitteeContribution(
+            6, b"\x08" * 32, 2, [False] * nbits, b"\xbb" * 96)
+        w2 = asyncio.ensure_future(
+            db.await_sync_contribution(6, 2, b"\x08" * 32))
+        await asyncio.sleep(0.01)
+        await db.store(Duty(6, DutyType.SYNC_CONTRIBUTION),
+                       {b"\x01" * 48: SyncContributionUnsigned(contrib)})
+        got2 = await asyncio.wait_for(w2, 5)
+        assert got2.subcommittee_index == 2
+
+    _run(run())

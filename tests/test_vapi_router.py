@@ -369,3 +369,86 @@ class TestHTTPBootstrap:
                 await _teardown(sim, routers, clients)
 
         _run(run())
+
+
+class TestErrorMapping:
+    """Content-negotiation / malformed-input table driven with RAW HTTP
+    against a single node's router (reference validatorapi_test.go's
+    error-path tables: bad JSON, wrong field types, bad query args,
+    unknown ids → 4xx with an eth2-style error body; handler crashes →
+    500; unknown routes → 404; wrong method → 405)."""
+
+    @staticmethod
+    async def _one_router():
+        import sys
+        sys.path.insert(0, __file__.rsplit("/", 1)[0])
+        from test_validatorapi import Harness
+
+        h = Harness()
+        router = VapiRouter(h.comp)
+        await router.start()
+        return h, router
+
+    def test_error_table(self):
+        from aiohttp import ClientSession
+
+        CASES = [
+            # (method, path, body_or_none, expected_status)
+            ("POST", "/eth/v1/beacon/pool/attestations", b"not json", 400),
+            ("POST", "/eth/v1/beacon/pool/attestations", b'{"a": 1}', 400),
+            ("POST", "/eth/v1/beacon/pool/attestations",
+             b'[{"aggregation_bits": 3}]', 400),
+            ("POST", "/eth/v1/validator/duties/attester/0",
+             b'["0xzznothex"]', 400),
+            ("GET", "/eth/v1/validator/attestation_data?slot=abc", None, 400),
+            ("GET", "/eth/v1/no/such/route", None, 404),
+            # proxy-first design: an unmatched METHOD on a known path falls
+            # to the BN passthrough like any unknown route — with no
+            # upstream configured that is a 404, not a 405 (the reference
+            # router also forwards unmatched requests to the BN)
+            ("GET", "/eth/v1/beacon/pool/attestations", None, 404),
+            # unknown share pubkey: component CharonError -> 400
+            ("POST", "/eth/v1/validator/duties/attester/0",
+             ('["0x' + "ab" * 48 + '"]').encode(), 400),
+            # voluntary exit for an index the BN doesn't know -> 400
+            ("POST", "/eth/v1/beacon/pool/voluntary_exits",
+             b'{"message": {"epoch": "0", "validator_index": "9999"},'
+             b' "signature": "0x' + b"00" * 96 + b'"}', 400),
+        ]
+
+        async def run():
+            h, router = await self._one_router()
+            try:
+                async with ClientSession() as s:
+                    for method, path, body, want in CASES:
+                        url = router.base_url + path
+                        resp = await s.request(method, url, data=body)
+                        assert resp.status == want, (
+                            f"{method} {path}: {resp.status} != {want}: "
+                            f"{await resp.text()}")
+                        if want in (400, 404) and method == "POST":
+                            # eth2-style error body with code + message
+                            obj = await resp.json()
+                            assert obj.get("code") == want and obj.get(
+                                "message"), obj
+            finally:
+                await router.stop()
+
+        _run(run())
+
+    def test_node_version_and_health_shapes(self):
+        from aiohttp import ClientSession
+
+        async def run():
+            h, router = await self._one_router()
+            try:
+                async with ClientSession() as s:
+                    resp = await s.get(
+                        router.base_url + "/eth/v1/node/version")
+                    assert resp.status == 200
+                    obj = await resp.json()
+                    assert "version" in obj.get("data", {})
+            finally:
+                await router.stop()
+
+        _run(run())
