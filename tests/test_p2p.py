@@ -250,3 +250,122 @@ class TestPipelineOverTCP:
                     await asyncio.wait_for(sim.stop(), 10)
 
         _run(run(), timeout=90)
+
+
+class TestChannelAdversarial:
+    """Wire-level adversarial cases the handshake/gater tests don't reach:
+    tampered ciphertext, replayed frames (nonce sequence), truncated
+    handshake hellos, and oversized frames (reference: libp2p noise/yamux
+    enforce the same properties; here they are the AES-GCM channel's)."""
+
+    @staticmethod
+    async def _pair(keys, pubs):
+        """A connected (initiator_channel, responder_channel) pair plus the
+        raw responder-side frame stream for wire injection."""
+        accepted = asyncio.get_running_loop().create_future()
+
+        async def on_conn(reader, writer):
+            inner = TCPFrameStream(reader, writer)
+            ch = await SecureChannel.respond(inner, keys[0], lambda pk: True)
+            accepted.set_result((ch, inner))
+
+        server = await asyncio.start_server(on_conn, "127.0.0.1", 0)
+        port = server.sockets[0].getsockname()[1]
+        reader, writer = await asyncio.open_connection("127.0.0.1", port)
+        inner_i = TCPFrameStream(reader, writer)
+        ch_i = await SecureChannel.initiate(inner_i, keys[1], pubs[0])
+        ch_r, inner_r = await accepted
+        return server, ch_i, inner_i, ch_r, inner_r
+
+    def test_tampered_ciphertext_rejected(self):
+        async def run():
+            keys = [k1util.generate_private_key() for _ in range(2)]
+            pubs = [k1util.public_key(k) for k in keys]
+            server, ch_i, inner_i, ch_r, _ = await self._pair(keys, pubs)
+            # write a valid encrypted frame, then flip one bit on the wire
+            ct = ch_i._send.encrypt(
+                ch_i._nonce(ch_i._send_salt, ch_i._send_seq), b"payload", b"")
+            ch_i._send_seq += 1
+            bad = bytes([ct[0] ^ 1]) + ct[1:]
+            await inner_i.write(bad)
+            with pytest.raises(Exception):  # InvalidTag from AESGCM
+                await ch_r.read()
+            server.close()
+
+        _run(run())
+
+    def test_replayed_frame_rejected(self):
+        """Re-sending a previously valid ciphertext must fail: the receive
+        nonce has advanced (XOR counter), so the tag cannot verify — replay
+        protection falls out of the sequence discipline."""
+
+        async def run():
+            keys = [k1util.generate_private_key() for _ in range(2)]
+            pubs = [k1util.public_key(k) for k in keys]
+            server, ch_i, inner_i, ch_r, _ = await self._pair(keys, pubs)
+            ct = ch_i._send.encrypt(
+                ch_i._nonce(ch_i._send_salt, ch_i._send_seq), b"m1", b"")
+            ch_i._send_seq += 1
+            await inner_i.write(ct)
+            assert await ch_r.read() == b"m1"
+            await inner_i.write(ct)  # replay the same wire bytes
+            with pytest.raises(Exception):
+                await ch_r.read()
+            server.close()
+
+        _run(run())
+
+    def test_truncated_hello_rejected(self):
+        async def run():
+            keys = [k1util.generate_private_key() for _ in range(2)]
+            failed = asyncio.get_running_loop().create_future()
+
+            async def on_conn(reader, writer):
+                try:
+                    await SecureChannel.respond(
+                        TCPFrameStream(reader, writer), keys[0],
+                        lambda pk: True)
+                    failed.set_result(None)
+                except HandshakeError as exc:
+                    failed.set_result(exc)
+
+            server = await asyncio.start_server(on_conn, "127.0.0.1", 0)
+            port = server.sockets[0].getsockname()[1]
+            reader, writer = await asyncio.open_connection("127.0.0.1", port)
+            await TCPFrameStream(reader, writer).write(b"\x01" * 50)  # short
+            exc = await asyncio.wait_for(failed, 10)
+            assert isinstance(exc, HandshakeError), "short hello accepted"
+            server.close()
+
+        _run(run())
+
+    def test_oversized_frame_rejected_both_directions(self):
+        from charon_tpu.p2p.channel import _MAX_FRAME
+        from charon_tpu.utils.errors import CharonError
+
+        async def run():
+            got = asyncio.get_running_loop().create_future()
+
+            async def on_conn(reader, writer):
+                try:
+                    await TCPFrameStream(reader, writer).read()
+                    got.set_result(None)
+                except CharonError as exc:
+                    got.set_result(exc)
+
+            server = await asyncio.start_server(on_conn, "127.0.0.1", 0)
+            port = server.sockets[0].getsockname()[1]
+            reader, writer = await asyncio.open_connection("127.0.0.1", port)
+            out = TCPFrameStream(reader, writer)
+            # writer-side guard
+            with pytest.raises(CharonError):
+                await out.write(b"\x00" * (_MAX_FRAME + 1))
+            # reader-side guard: forge an oversized length header raw
+            import struct as _s
+            writer.write(_s.pack(">I", _MAX_FRAME + 1))
+            await writer.drain()
+            exc = await asyncio.wait_for(got, 10)
+            assert isinstance(exc, CharonError), "oversized header accepted"
+            server.close()
+
+        _run(run())
