@@ -227,3 +227,96 @@ class TestFetchAggregator:
             assert beacon.calls.get("aggregate_attestation") == 1
 
         _run(run())
+
+
+class TestFetchSyncContribution:
+    """The sync-contribution path (reference fetcher.go:296): selection
+    gating by the consensus-spec sync-aggregator rule per SUBCOMMITTEE,
+    and the subcommittee derivation from validator positions."""
+
+    def test_subcommittee_derivation(self):
+        from charon_tpu.core.fetcher import _subcommittees
+        from charon_tpu.eth2.spec import (
+            SYNC_COMMITTEE_SIZE, SYNC_COMMITTEE_SUBNET_COUNT)
+
+        per = SYNC_COMMITTEE_SIZE // SYNC_COMMITTEE_SUBNET_COUNT
+        duty = spec.SyncCommitteeDuty(
+            pubkey=b"\x00" * 48, validator_index=0,
+            validator_sync_committee_indices=[0, 1, per, 3 * per + 5])
+        assert _subcommittees(duty) == [0, 1, 3]
+
+    def test_selected_sync_aggregator_fetches_contribution(self):
+        from charon_tpu.core.fetcher import _is_sync_agg
+        from charon_tpu.core.signeddata import SyncCommitteeSelection
+        from charon_tpu.core.unsigneddata import SyncCommitteeDefinition
+
+        async def run():
+            beacon = CountingBeacon()
+            f = Fetcher(beacon)
+            # find a selection proof that IS a sync aggregator, and one
+            # that is not, by brute force over deterministic bytes
+            win = lose = None
+            i = 0
+            while win is None or lose is None:
+                proof = bytes([i % 256, i // 256 % 256]) + b"\x00" * 94
+                if _is_sync_agg(proof):
+                    win = win or proof
+                else:
+                    lose = lose or proof
+                i += 1
+
+            picked = {}
+
+            async def agg_await(duty, pubkey, root=None):
+                return picked[pubkey]
+
+            f.register_agg_sig_db(agg_await)
+            duty_obj = spec.SyncCommitteeDuty(
+                pubkey=b"\x00" * 48, validator_index=0,
+                validator_sync_committee_indices=[0])
+            defset = {PK_A: SyncCommitteeDefinition(duty_obj),
+                      PK_B: SyncCommitteeDefinition(duty_obj)}
+            picked[PK_A] = SyncCommitteeSelection(0, 3, 0, win)
+            picked[PK_B] = SyncCommitteeSelection(0, 3, 0, lose)
+
+            out = []
+            f.subscribe(lambda d, u: _collect(out, d, u))
+            await f.fetch(Duty(3, DutyType.SYNC_CONTRIBUTION), defset)
+            assert len(out) == 1
+            _d, unsigned = out[0]
+            assert PK_A in unsigned and PK_B not in unsigned
+            assert beacon.calls.get("sync_committee_contribution", 0) == 1
+
+        _run(run())
+
+    def test_wrong_subcommittee_selection_skipped(self):
+        from charon_tpu.core.fetcher import _is_sync_agg
+        from charon_tpu.core.signeddata import SyncCommitteeSelection
+        from charon_tpu.core.unsigneddata import SyncCommitteeDefinition
+
+        async def run():
+            beacon = CountingBeacon()
+            f = Fetcher(beacon)
+            proof = b"\x01" * 96
+
+            async def agg_await(duty, pubkey, root=None):
+                # selection names subcommittee 7; the duty position is in 0
+                return SyncCommitteeSelection(0, 3, 7, proof)
+
+            f.register_agg_sig_db(agg_await)
+            duty_obj = spec.SyncCommitteeDuty(
+                pubkey=b"\x00" * 48, validator_index=0,
+                validator_sync_committee_indices=[0])
+            out = []
+            f.subscribe(lambda d, u: _collect(out, d, u))
+            await f.fetch(Duty(3, DutyType.SYNC_CONTRIBUTION),
+                          {PK_A: SyncCommitteeDefinition(duty_obj)})
+            # mismatched subcommittee -> nothing fetched, nothing emitted
+            assert beacon.calls.get("sync_committee_contribution", 0) == 0
+            assert out == [] or all(not u for _d, u in out)
+
+        _run(run())
+
+
+async def _collect(acc, duty, unsigned):
+    acc.append((duty, unsigned))
