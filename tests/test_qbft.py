@@ -497,3 +497,53 @@ class TestJustificationFormulas:
                 for _ in range(4)]
         quorums = qbft.get_prepare_quorums(d, same)
         assert quorums == []
+
+
+def test_fifo_limit_bounds_per_source_buffer():
+    """A spamming source cannot grow a process's message buffer past
+    fifo_limit (reference qbft.go's per-peer FIFO bound) — and the flood
+    must not prevent the instance from deciding."""
+
+    async def run():
+        n = 4
+        fabric = Fabric(n)
+        limit = 16
+
+        decided = {p: [] for p in range(1, n + 1)}
+        defs = {}
+        for p in range(1, n + 1):
+            def mk(p=p):
+                def decide(instance, value, qcommit):
+                    decided[p].append(value)
+                return decide
+            defs[p] = Definition(
+                is_leader=lambda inst, r, proc: (r - 1) % n + 1 == proc,
+                new_timer=qbft.increasing_round_timer(base=0.05, inc=0.05),
+                decide=mk(), nodes=n, fifo_limit=limit)
+
+        # flood every queue with junk future-round PREPAREs from source 2
+        for p in range(1, n + 1):
+            for i in range(200):
+                fabric.queues[p].put_nowait(Msg(
+                    MsgType.PREPARE, "inst", 2, 50 + (i % 3),
+                    f"junk-{i}"))
+
+        values = {p: f"value-from-{p}" for p in range(1, n + 1)}
+        tasks = [asyncio.create_task(
+            qbft.run(defs[p], fabric.transport(p), "inst", p, values[p]))
+            for p in range(1, n + 1)]
+
+        async def all_decided():
+            while any(not decided[p] for p in range(1, n + 1)):
+                await asyncio.sleep(0.01)
+
+        try:
+            await asyncio.wait_for(all_decided(), 10)
+        finally:
+            for t in tasks:
+                t.cancel()
+            await asyncio.gather(*tasks, return_exceptions=True)
+        got = {tuple(v) for v in decided.values()}
+        assert len(got) == 1, f"disagreement under flood: {got}"
+
+    asyncio.run(run())
