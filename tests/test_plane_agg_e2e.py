@@ -174,19 +174,13 @@ def test_fused_aggregate_verify_device_pipeline(monkeypatch):
     run_pipeline_drive()
 
 
-@pytest.mark.nightly
-def test_rlc_verify_batch_chunks_past_tile(monkeypatch):
-    """Bursts past one plane tile verify via TILE-sized CHUNKS of the
-    already-compiled graphs (round-4 weak #2: the 2048-lane fused verify
-    graph exceeded the remote compile service's budget, so a >1024-sig
-    coalesced multi-peer burst could not verify in one flush). The chunks
-    dispatch back-to-back and their per-chunk RLC partial sums combine on
-    the host — this drives correctness ACROSS the chunk seam: validity,
-    a corruption isolated to a non-first chunk, per-chunk group masks for
-    two messages, and an out-of-subgroup point in the last chunk."""
-    monkeypatch.setattr(PP, "TILE", 64)
-    monkeypatch.setattr(plane_agg, "_device_path", lambda n=0: True)
-    monkeypatch.setattr(plane_agg, "_PK_PLANE_CACHE", {})
+def _chunked_verify_drive() -> None:
+    """Body of test_rlc_verify_batch_chunks_past_tile, run in a COMPILE-LEAN
+    subprocess (the chunk-seam logic is host-side and schedule-agnostic;
+    the production window-4 interpret-mode graph cold-compiles for ~an hour
+    on one core, which even the nightly tier shouldn't pay)."""
+    PP.TILE = 64
+    plane_agg._device_path = lambda n=0: True
 
     n = 150  # 3 chunks at TILE=64: 64 + 64 + 22
     m1, m2 = b"\x61" * 32, b"\x62" * 32
@@ -210,3 +204,36 @@ def test_rlc_verify_batch_chunks_past_tile(monkeypatch):
     rogue = list(sigs)
     rogue[-1] = _g2_point_outside_subgroup()
     assert plane_agg.rlc_verify_batch(pks, msgs, rogue) is False
+
+
+_CHUNK_DRIVE = r"""
+import sys
+sys.path.insert(0, {repo!r})
+from tests.test_plane_agg_e2e import _chunked_verify_drive
+_chunked_verify_drive()
+print("CHUNKS-OK", flush=True)
+"""
+
+
+@pytest.mark.nightly
+def test_rlc_verify_batch_chunks_past_tile():
+    """Bursts past one plane tile verify via TILE-sized CHUNKS of the
+    already-compiled graphs (round-4 weak #2: the 2048-lane fused verify
+    graph exceeded the remote compile service's budget, so a >1024-sig
+    coalesced multi-peer burst could not verify in one flush). The chunks
+    dispatch back-to-back and their per-chunk RLC partial sums combine on
+    the host — this drives correctness ACROSS the chunk seam: validity,
+    a corruption isolated to a non-first chunk, per-chunk group masks for
+    two messages, and an out-of-subgroup point in the last chunk. Runs
+    the COMPILE-LEAN schedule in a fresh subprocess (see
+    _chunked_verify_drive)."""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    env["CHARON_TPU_COMPILE_LEAN"] = "1"
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.run(
+        [sys.executable, "-c", _CHUNK_DRIVE.format(repo=repo)],
+        env=env, cwd=repo, timeout=2400, text=True,
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+    assert proc.returncode == 0, proc.stdout[-4000:]
+    assert "CHUNKS-OK" in proc.stdout
