@@ -19,7 +19,7 @@ from .types import Duty, PubKey, SignedData, SignedDataSet
 _log = log.with_topic("aggsigdb")
 
 
-class MemDB:
+class MemDB:  # lint: implements=AggSigDB
     """reference aggsigdb.NewMemDB; Store memory.go:44, Await memory.go:86."""
 
     def __init__(self, deadliner: Deadliner | None = None):

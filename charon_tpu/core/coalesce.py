@@ -29,7 +29,7 @@ from __future__ import annotations
 import asyncio
 
 from .. import tbls
-from ..utils import log, metrics
+from ..utils import aio, log, metrics
 
 _log = log.with_topic("coalesce")
 
@@ -107,7 +107,9 @@ class _Window:
         reqs, self._q = self._q, []
         self._seen, self._expected, self._unkeyed = {}, {}, 0
         if reqs:
-            asyncio.ensure_future(self._run(reqs))
+            # aio.spawn, not ensure_future: the loop only weak-refs tasks,
+            # and a GC'd flush would strand every waiter in the window.
+            aio.spawn(self._run(reqs), name=f"coalesce-{self.kind}")
 
     async def _run(self, reqs, fail_budget: list | None = None) -> None:
         _flush_hist.observe(sum(s for s, _, _ in reqs), self.kind)

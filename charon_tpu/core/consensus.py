@@ -443,7 +443,7 @@ class _InstanceIO:
         return True
 
 
-class Component:
+class Component:  # lint: implements=Consensus
     """QBFT consensus component (reference consensus.New component.go:195).
 
     transport: object with `register(handler)` + `async broadcast(wire_dict)`

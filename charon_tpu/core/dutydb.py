@@ -55,7 +55,7 @@ class _AwaitMap:
         # cancels the caller (matching the reference's blocking queries).
 
 
-class MemDB:
+class MemDB:  # lint: implements=DutyDB
     """reference dutydb.NewMemDB (memory.go:20)."""
 
     def __init__(self, deadliner: Deadliner | None = None):

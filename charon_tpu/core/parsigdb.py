@@ -32,7 +32,7 @@ MULTI_ROOT_DUTIES = frozenset({
 })
 
 
-class MemDB:
+class MemDB:  # lint: implements=ParSigDB
     """reference parsigdb.NewMemDB (memory.go:18)."""
 
     def __init__(self, threshold: int, deadliner: Deadliner | None = None):

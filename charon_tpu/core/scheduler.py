@@ -207,5 +207,7 @@ class Scheduler:
     async def _emit_safe(fn, *args) -> None:
         try:
             await fn(*args)
+        except asyncio.CancelledError:
+            raise  # shutdown must propagate through the tick loop
         except Exception as exc:  # noqa: BLE001 — subscriber errors are logged
             _log.error("duty subscriber failed", err=exc)

@@ -160,7 +160,9 @@ class Tracker:
                 # (reference extractParSigs tracker.go:422)
                 try:
                     root = psd.message_root()
-                except Exception:  # noqa: BLE001 — unrooted test doubles
+                except Exception as exc:  # noqa: BLE001 — unrooted test doubles
+                    _log.debug("parsig message root unavailable",
+                               duty=str(duty), err=exc)
                     continue
                 rec.parsig_roots.setdefault(pubkey, {})[idx] = root
 
@@ -178,6 +180,8 @@ class Tracker:
             for fn in self._subs:
                 try:
                     await fn(report)
+                except asyncio.CancelledError:
+                    raise  # never swallow a cancellation as a subscriber error
                 except Exception as exc:  # noqa: BLE001 — subscriber isolation
                     _log.warn("tracker subscriber failed", err=exc)
 
@@ -305,7 +309,8 @@ class InclusionChecker:
             await asyncio.sleep(self._chain.seconds_per_slot / 2)
             try:
                 head = await self._beacon.head_slot()
-            except Exception:  # noqa: BLE001 — BN hiccup; retry next tick
+            except Exception as exc:  # noqa: BLE001 — BN hiccup; retry next tick
+                _log.debug("head slot poll failed", err=exc)
                 continue
             if seen_slot is None:
                 seen_slot = head - 1
@@ -317,7 +322,9 @@ class InclusionChecker:
     async def _check_block(self, slot: int) -> None:
         try:
             roots = await self._beacon.block_attestation_roots(slot)
-        except Exception:  # noqa: BLE001 — block may not exist
+        except Exception as exc:  # noqa: BLE001 — block may not exist
+            _log.debug("block attestation roots unavailable",
+                       slot=slot, err=exc)
             return
         for root in roots:
             sub_slot = self._pending.pop(root, None)

@@ -56,7 +56,7 @@ _submit_counter = metrics.counter(
     "core_validatorapi_submissions_total", "VC submissions", ("kind",))
 
 
-class Component:
+class Component:  # lint: implements=ValidatorAPI
     """reference validatorapi.NewComponent (validatorapi.go:49)."""
 
     def __init__(self, beacon: BeaconNode, dutydb: DutyDB, aggsigdb: AggSigDB,

@@ -13,7 +13,7 @@ from __future__ import annotations
 import asyncio
 from typing import Protocol, runtime_checkable
 
-from ..utils import errors, log, metrics
+from ..utils import aio, errors, log, metrics
 from .spec import (
     Attestation,
     AttestationData,
@@ -90,8 +90,11 @@ class MultiBeaconNode:
             return await self._one(0, attr, *args, **kwargs)
         # Parallel first-success-wins race across all nodes (the reference's
         # forkjoin fan-out); losers are cancelled once a winner returns.
+        # aio.spawn roots each task until it completes; quiet=True because
+        # this loop retrieves every exception itself and logs the losers.
         tasks = {
-            asyncio.ensure_future(self._one(i, attr, *args, **kwargs)): i
+            aio.spawn(self._one(i, attr, *args, **kwargs),
+                      name=f"bn-{self.nodes[i].name}-{attr}", quiet=True): i
             for i in range(len(self.nodes))
         }
         pending = set(tasks)

@@ -1,0 +1,29 @@
+"""Project-native static analysis for charon_tpu.
+
+The reference charon ships correctness tooling as first-class
+infrastructure (protonil, race-detector CI, custom linters) because a
+distributed validator that silently drops a duty loses real money. This
+package is the reproduction's equivalent: a small AST lint engine
+(`engine.py`) plus rules that mechanically enforce invariants the rest of
+the codebase states in prose —
+
+  LINT-AIO-001   spawned-task results must be retained (utils/aio.py)
+  LINT-EXC-002   no silent broad excepts in core/, dkg/, p2p/
+  LINT-TPU-003   big ints encode via fq_from_int/limbs_from_int before
+                 device arrays; no host syncs in @jax.jit bodies
+  LINT-IFACE-004 core/ components implement their claimed protocol
+
+Run `python -m charon_tpu.lints [paths]`; see docs/lints.md.
+"""
+
+from .engine import (  # noqa: F401
+    Engine,
+    Finding,
+    Rule,
+    SourceFile,
+    baseline_counts,
+    load_baseline,
+    new_findings,
+    write_baseline,
+)
+from .rules import default_rules  # noqa: F401

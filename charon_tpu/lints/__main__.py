@@ -1,0 +1,100 @@
+"""CLI: `python -m charon_tpu.lints [paths] [--json] [--baseline-update]`.
+
+Exit codes: 0 = no findings beyond the baseline, 1 = new findings,
+2 = usage error. `--json` emits a machine-readable report (per-rule counts
+plus every finding) so CI can diff finding counts across PRs the way
+bench.py's --json output is diffed.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+from . import engine
+
+DEFAULT_BASELINE = Path(__file__).parent / "baseline.json"
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="python -m charon_tpu.lints",
+        description="charon_tpu project-native static analysis")
+    p.add_argument("paths", nargs="*",
+                   help="files/directories to lint (default: the charon_tpu "
+                        "package)")
+    p.add_argument("--json", action="store_true", dest="as_json",
+                   help="emit a JSON report (counts + findings) for CI diffs")
+    p.add_argument("--baseline", default=str(DEFAULT_BASELINE),
+                   help="baseline file of grandfathered findings "
+                        "(default: charon_tpu/lints/baseline.json)")
+    p.add_argument("--no-baseline", action="store_true",
+                   help="ignore the baseline: every finding is new")
+    p.add_argument("--baseline-update", action="store_true",
+                   help="regenerate the baseline from current findings "
+                        "(deterministic: sorted keys, stable paths) and exit 0")
+    p.add_argument("--cache", default=None, metavar="PATH",
+                   help="persist the per-file result cache at PATH")
+    p.add_argument("--root", default=None,
+                   help="directory finding paths are made relative to "
+                        "(default: cwd; run from the repo root so baseline "
+                        "paths stay stable)")
+    return p
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = build_parser().parse_args(argv)
+
+    paths = [Path(p) for p in args.paths]
+    if not paths:
+        pkg = Path(__file__).resolve().parents[1]
+        paths = [pkg]
+        if args.root is None:
+            args.root = str(pkg.parent)
+    for p in paths:
+        if not p.exists():
+            print(f"error: no such path: {p}", file=sys.stderr)
+            return 2
+
+    eng = engine.Engine(cache_path=args.cache)
+    findings = eng.lint_paths(paths, root=args.root)
+
+    if args.baseline_update:
+        engine.write_baseline(args.baseline, findings)
+        print(f"baseline: wrote {len(findings)} finding(s) "
+              f"({len(engine.baseline_counts(findings))} key(s)) "
+              f"to {args.baseline}")
+        return 0
+
+    baseline = {} if args.no_baseline else engine.load_baseline(args.baseline)
+    new = engine.new_findings(findings, baseline)
+
+    if args.as_json:
+        counts: dict[str, int] = {}
+        for f in findings:
+            counts[f.rule] = counts.get(f.rule, 0) + 1
+        new_set = set(new)
+        report = {
+            "version": 1,
+            "total": len(findings),
+            "new": len(new),
+            "baselined": len(findings) - len(new),
+            "counts_by_rule": dict(sorted(counts.items())),
+            "findings": [{"path": f.path, "line": f.line, "rule": f.rule,
+                          "message": f.message,
+                          "new": f in new_set} for f in findings],
+        }
+        print(json.dumps(report, indent=2))
+    else:
+        for f in new:
+            print(f.render())
+        grandfathered = len(findings) - len(new)
+        tail = f" ({grandfathered} baselined)" if grandfathered else ""
+        print(f"lints: {len(new)} new finding(s){tail}")
+    return 1 if new else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

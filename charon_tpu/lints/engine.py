@@ -1,0 +1,277 @@
+"""AST lint engine: file discovery, directive parsing, caching, baseline.
+
+The engine is deliberately small: a `Rule` is any object with an `id`, a
+`description`, and a `check(SourceFile) -> Iterable[Finding]` method. The
+engine owns everything rules should not have to re-implement —
+
+  * parsing each file once into an AST with a parent map,
+  * `# lint:` comment directives (suppressions and protocol claims),
+  * content-hash keyed per-file caching (linting the whole tree twice in
+    one process, e.g. the CLI followed by the self-check test, parses each
+    file once; `--cache PATH` persists across runs),
+  * the baseline: grandfathered findings are identified by a line-free
+    `rule|path|message` key so unrelated edits above a finding don't churn
+    the baseline, and only counts *above* the baselined count are "new".
+
+Directives (parsed from comment tokens, so strings can't false-positive):
+
+  # lint: disable=LINT-AIO-001[,LINT-...]   suppress on this line; a comment
+                                            alone on its line also covers the
+                                            next line (like noqa-above)
+  # lint: disable-file=LINT-EXC-002         suppress for the whole file
+  # lint: disable=all                       suppress every rule
+  # lint: implements=Scheduler              class claims a core.interfaces
+                                            protocol (LINT-IFACE-004)
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import hashlib
+import io
+import json
+import re
+import tokenize
+from dataclasses import dataclass
+from pathlib import Path
+from typing import Iterable, Protocol, runtime_checkable
+
+# Bump when rule semantics change: invalidates persisted caches.
+RULES_VERSION = 1
+
+PARSE_RULE = "LINT-PARSE-000"
+
+_DIRECTIVE_RE = re.compile(
+    r"#\s*lint:\s*(disable-file|disable|implements)\s*=\s*([\w.,-]+)")
+
+
+@dataclass(frozen=True, order=True)
+class Finding:
+    """One lint finding. Ordering is (path, line, rule, message) so output
+    and baselines are deterministic."""
+
+    path: str  # posix path relative to the lint root
+    line: int
+    rule: str
+    message: str
+
+    @property
+    def key(self) -> str:
+        """Line-free identity used by the baseline (see module doc)."""
+        return f"{self.rule}|{self.path}|{self.message}"
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}: {self.rule}: {self.message}"
+
+
+class SourceFile:
+    """One parsed file handed to rules: AST + parent links + directives."""
+
+    def __init__(self, path: Path, rel: str, text: str):
+        self.path = path
+        self.rel = rel
+        self.text = text
+        self.tree = ast.parse(text)  # caller converts SyntaxError to a finding
+        self._parents: dict[ast.AST, ast.AST] = {}
+        for parent in ast.walk(self.tree):
+            for child in ast.iter_child_nodes(parent):
+                self._parents[child] = parent
+        # line -> rule ids suppressed there ("all" wildcards everything)
+        self.disabled_lines: dict[int, set[str]] = {}
+        self.disabled_file: set[str] = set()
+        # line -> protocol names claimed by a class defined on/under it
+        self.implements: dict[int, list[str]] = {}
+        self._scan_directives()
+
+    def parent(self, node: ast.AST) -> ast.AST | None:
+        return self._parents.get(node)
+
+    def in_dir(self, *names: str) -> bool:
+        """True if any directory segment of the file's path is in `names`
+        (so both `charon_tpu/core/x.py` and a fixture's `core/x.py` match)."""
+        return any(seg in names for seg in self.rel.split("/")[:-1])
+
+    def suppressed(self, rule: str, line: int) -> bool:
+        if "all" in self.disabled_file or rule in self.disabled_file:
+            return True
+        rules = self.disabled_lines.get(line, ())
+        return "all" in rules or rule in rules
+
+    def _scan_directives(self) -> None:
+        try:
+            tokens = list(tokenize.generate_tokens(
+                io.StringIO(self.text).readline))
+        except (tokenize.TokenError, IndentationError):  # partial files
+            tokens = []
+        for tok in tokens:
+            if tok.type != tokenize.COMMENT:
+                continue
+            m = _DIRECTIVE_RE.search(tok.string)
+            if m is None:
+                continue
+            kind, value = m.group(1), m.group(2)
+            names = [v for v in value.split(",") if v]
+            line = tok.start[0]
+            own_line = tok.line[:tok.start[1]].strip() == ""
+            if kind == "disable-file":
+                self.disabled_file.update(names)
+            elif kind == "disable":
+                self.disabled_lines.setdefault(line, set()).update(names)
+                if own_line:  # a standalone comment covers the next line too
+                    self.disabled_lines.setdefault(
+                        line + 1, set()).update(names)
+            elif kind == "implements":
+                self.implements.setdefault(line, []).extend(names)
+
+
+@runtime_checkable
+class Rule(Protocol):
+    id: str
+    description: str
+
+    def check(self, src: SourceFile) -> Iterable[Finding]: ...
+
+
+class Engine:
+    """Runs rules over files with per-file content-hash caching."""
+
+    def __init__(self, rules: list[Rule] | None = None,
+                 cache_path: Path | str | None = None):
+        if rules is None:
+            from .rules import default_rules
+
+            rules = default_rules()
+        self.rules: list[Rule] = list(rules)
+        self.cache_path = Path(cache_path) if cache_path else None
+        self._cache: dict[str, list[dict]] = {}
+        self._cache_dirty = False
+        if self.cache_path is not None and self.cache_path.exists():
+            try:
+                raw = json.loads(self.cache_path.read_text())
+                if raw.get("version") == RULES_VERSION:
+                    self._cache = raw.get("files", {})
+            except (ValueError, OSError):
+                self._cache = {}
+
+    # -- discovery ---------------------------------------------------------
+
+    @staticmethod
+    def discover(paths: Iterable[Path | str]) -> list[Path]:
+        files: list[Path] = []
+        for p in paths:
+            p = Path(p)
+            if p.is_dir():
+                files.extend(sorted(
+                    f for f in p.rglob("*.py")
+                    if not any(part.startswith(".") for part in f.parts)))
+            else:
+                files.append(p)
+        # dedupe, stable order
+        seen: set[Path] = set()
+        out = []
+        for f in files:
+            r = f.resolve()
+            if r not in seen:
+                seen.add(r)
+                out.append(f)
+        return out
+
+    # -- linting -----------------------------------------------------------
+
+    def lint_paths(self, paths: Iterable[Path | str],
+                   root: Path | str | None = None) -> list[Finding]:
+        """Lint files/directories; paths in findings are relative to `root`
+        (default: the current working directory). Run from the repo root —
+        or pass it — so baseline paths stay stable."""
+        root = Path(root) if root is not None else Path.cwd()
+        findings: list[Finding] = []
+        for path in self.discover(paths):
+            try:
+                rel = path.resolve().relative_to(root.resolve()).as_posix()
+            except ValueError:  # outside root: keep it lintable anyway
+                rel = path.as_posix()
+            findings.extend(self.lint_file(path, rel))
+        self._save_cache()
+        return sorted(findings)
+
+    def lint_file(self, path: Path, rel: str) -> list[Finding]:
+        text = Path(path).read_text()
+        key = hashlib.sha256(
+            f"{RULES_VERSION}|{rel}|".encode() + text.encode()).hexdigest()
+        cached = self._cache.get(key)
+        if cached is not None:
+            return [Finding(**d) for d in cached]
+        findings = self._run_rules(path, rel, text)
+        self._cache[key] = [dataclasses.asdict(f) for f in findings]
+        self._cache_dirty = True
+        return findings
+
+    def _run_rules(self, path: Path, rel: str, text: str) -> list[Finding]:
+        try:
+            src = SourceFile(Path(path), rel, text)
+        except SyntaxError as exc:
+            return [Finding(rel, exc.lineno or 0, PARSE_RULE,
+                            f"file does not parse: {exc.msg}")]
+        out: list[Finding] = []
+        for rule in self.rules:
+            for f in rule.check(src):
+                if not src.suppressed(f.rule, f.line):
+                    out.append(f)
+        return sorted(out)
+
+    def _save_cache(self) -> None:
+        if self.cache_path is None or not self._cache_dirty:
+            return
+        payload = {"version": RULES_VERSION, "files": self._cache}
+        try:
+            self.cache_path.parent.mkdir(parents=True, exist_ok=True)
+            self.cache_path.write_text(json.dumps(payload))
+            self._cache_dirty = False
+        except OSError:  # cache is best-effort
+            pass
+
+
+# -- baseline ---------------------------------------------------------------
+
+
+def baseline_counts(findings: Iterable[Finding]) -> dict[str, int]:
+    counts: dict[str, int] = {}
+    for f in findings:
+        counts[f.key] = counts.get(f.key, 0) + 1
+    return counts
+
+
+def load_baseline(path: Path | str) -> dict[str, int]:
+    path = Path(path)
+    if not path.exists():
+        return {}
+    raw = json.loads(path.read_text())
+    return {str(k): int(v) for k, v in raw.get("findings", {}).items()}
+
+
+def write_baseline(path: Path | str, findings: Iterable[Finding]) -> None:
+    """Deterministic regeneration: sorted keys, stable relative paths."""
+    payload = {
+        "version": 1,
+        "comment": "Grandfathered lint findings. Keys are rule|path|message; "
+                   "values are allowed counts. Regenerate with "
+                   "`python -m charon_tpu.lints --baseline-update` from the "
+                   "repo root; burn entries down, never add to them.",
+        "findings": dict(sorted(baseline_counts(findings).items())),
+    }
+    Path(path).write_text(json.dumps(payload, indent=2, sort_keys=False) + "\n")
+
+
+def new_findings(findings: Iterable[Finding],
+                 baseline: dict[str, int]) -> list[Finding]:
+    """Findings beyond the baselined count for their key, in sorted order
+    (the first N occurrences of a key are grandfathered, the rest are new)."""
+    seen: dict[str, int] = {}
+    out: list[Finding] = []
+    for f in sorted(findings):
+        n = seen.get(f.key, 0)
+        seen[f.key] = n + 1
+        if n >= baseline.get(f.key, 0):
+            out.append(f)
+    return out

@@ -1,0 +1,25 @@
+"""Rule registry: one module per rule, registered here."""
+
+from __future__ import annotations
+
+from .aio import UntrackedTaskRule
+from .exc import BroadExceptRule
+from .iface import ProtocolImplRule
+from .tpu import DeviceDtypeRule
+
+__all__ = [
+    "UntrackedTaskRule",
+    "BroadExceptRule",
+    "DeviceDtypeRule",
+    "ProtocolImplRule",
+    "default_rules",
+]
+
+
+def default_rules() -> list:
+    return [
+        UntrackedTaskRule(),
+        BroadExceptRule(),
+        DeviceDtypeRule(),
+        ProtocolImplRule(),
+    ]

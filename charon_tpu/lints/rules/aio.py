@@ -1,0 +1,51 @@
+"""LINT-AIO-001 — every spawned task must be retained.
+
+The event loop holds only *weak* references to tasks: a bare
+`asyncio.create_task(...)` / `asyncio.ensure_future(...)` statement whose
+result nobody keeps can be garbage-collected mid-flight, silently dropping
+the work — the exact failure mode `utils/aio.spawn` exists to prevent (it
+roots the task in a module-level set until completion and logs the
+exception). This rule flags task-creation calls whose result is discarded,
+i.e. the call is a bare expression statement. Results that are assigned,
+awaited, returned, collected into a container, or passed to another call
+count as retained.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable
+
+from ..engine import Finding, SourceFile
+
+_TASK_CALLS = ("create_task", "ensure_future")
+
+
+def _callee_name(func: ast.expr) -> str | None:
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    if isinstance(func, ast.Name):
+        return func.id
+    return None
+
+
+class UntrackedTaskRule:
+    id = "LINT-AIO-001"
+    description = ("create_task/ensure_future results must be retained "
+                   "or routed through utils.aio.spawn")
+
+    def check(self, src: SourceFile) -> Iterable[Finding]:
+        for node in ast.walk(src.tree):
+            if not isinstance(node, ast.Expr):
+                continue
+            call = node.value
+            if not isinstance(call, ast.Call):
+                continue
+            name = _callee_name(call.func)
+            if name in _TASK_CALLS:
+                yield Finding(
+                    src.rel, call.lineno, self.id,
+                    f"`{name}()` result is discarded; the event loop holds "
+                    "only weak task refs, so the task can be garbage-"
+                    "collected mid-flight — retain it or use "
+                    "utils.aio.spawn")

@@ -1,0 +1,85 @@
+"""LINT-EXC-002 — no silent broad exception handlers in the duty path.
+
+A distributed validator that swallows a duty failure loses real money, so
+under `core/`, `dkg/`, and `p2p/` a broad handler must make the failure
+observable:
+
+  * `except Exception` must log (any `.debug/.info/.warn/.warning/.error/
+    .exception/.critical` call in the handler body) or re-raise;
+  * a bare `except:` or `except BaseException` must contain a `raise` —
+    those two also catch `asyncio.CancelledError` (a BaseException since
+    3.8), and swallowing a cancellation wedges teardown.
+
+Handlers that intentionally drop exceptions carry a
+`# lint: disable=LINT-EXC-002` with a justification, or live in the
+baseline until burned down.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable
+
+from ..engine import Finding, SourceFile
+
+_SCOPE = ("core", "dkg", "p2p")
+_BROAD = ("Exception", "BaseException")
+_LOG_METHODS = ("debug", "info", "warn", "warning", "error", "exception",
+                "critical")
+
+
+def _broad_names(type_: ast.expr | None) -> list[str]:
+    """The broad exception names caught by this handler clause; a bare
+    `except:` reports as "<bare>"."""
+    if type_ is None:
+        return ["<bare>"]
+    exprs = type_.elts if isinstance(type_, ast.Tuple) else [type_]
+    out = []
+    for e in exprs:
+        name = e.attr if isinstance(e, ast.Attribute) else (
+            e.id if isinstance(e, ast.Name) else None)
+        if name in _BROAD:
+            out.append(name)
+    return out
+
+
+def _has_raise(handler: ast.ExceptHandler) -> bool:
+    return any(isinstance(n, ast.Raise) for n in ast.walk(handler))
+
+
+def _has_log_call(handler: ast.ExceptHandler) -> bool:
+    for n in ast.walk(handler):
+        if (isinstance(n, ast.Call) and isinstance(n.func, ast.Attribute)
+                and n.func.attr in _LOG_METHODS):
+            return True
+    return False
+
+
+class BroadExceptRule:
+    id = "LINT-EXC-002"
+    description = ("broad except handlers in core/, dkg/, p2p/ must log or "
+                   "re-raise; bare/BaseException handlers must re-raise")
+
+    def check(self, src: SourceFile) -> Iterable[Finding]:
+        if not src.in_dir(*_SCOPE):
+            return
+        for node in ast.walk(src.tree):
+            if not isinstance(node, ast.ExceptHandler):
+                continue
+            names = _broad_names(node.type)
+            if not names:
+                continue
+            if "<bare>" in names or "BaseException" in names:
+                if not _has_raise(node):
+                    yield Finding(
+                        src.rel, node.lineno, self.id,
+                        "bare/`BaseException` handler also catches "
+                        "asyncio.CancelledError; it must re-raise (narrow "
+                        "to `except Exception` if cancellation should "
+                        "propagate)")
+            elif not (_has_raise(node) or _has_log_call(node)):
+                yield Finding(
+                    src.rel, node.lineno, self.id,
+                    "broad `except Exception` with no log and no re-raise "
+                    "can silently swallow a duty failure; log it, re-raise, "
+                    "or narrow the exception type")

@@ -1,0 +1,125 @@
+"""LINT-IFACE-004 — concrete core/ components implement their protocol.
+
+`core/interfaces.py` defines the pipeline's component protocols and
+`wire()` stitches concrete components together through them — but the
+protocols are structural, so a component missing a method (or defining a
+sync method where the protocol is async) only fails at duty time, deep in
+the pipeline. This rule checks the claim statically.
+
+A class under `core/` claims a protocol two ways:
+
+  * implicitly, when its name equals a protocol name (`class Scheduler`
+    claims `core.interfaces.Scheduler`);
+  * explicitly, via a `# lint: implements=ParSigDB` comment on the
+    `class` line or the line above (used where the concrete name differs,
+    e.g. the `MemDB` components).
+
+Every protocol method must exist in the class body (a `def`, `async def`,
+or an attribute assignment), and `async def` protocol methods must be
+implemented as coroutines. Protocol specs are parsed from
+`core/interfaces.py` by AST — the rule never imports project code.
+"""
+
+from __future__ import annotations
+
+import ast
+from pathlib import Path
+from typing import Iterable
+
+from ..engine import Finding, SourceFile
+
+_INTERFACES = Path(__file__).resolve().parents[2] / "core" / "interfaces.py"
+
+
+def _is_protocol_class(node: ast.ClassDef) -> bool:
+    for base in node.bases:
+        name = base.attr if isinstance(base, ast.Attribute) else (
+            base.id if isinstance(base, ast.Name) else None)
+        if name == "Protocol":
+            return True
+    return False
+
+
+def _class_methods(node: ast.ClassDef) -> dict[str, str]:
+    """name -> "async" | "def" | "attr" for direct members of the class."""
+    out: dict[str, str] = {}
+    for stmt in node.body:
+        if isinstance(stmt, ast.AsyncFunctionDef):
+            out[stmt.name] = "async"
+        elif isinstance(stmt, ast.FunctionDef):
+            out[stmt.name] = "def"
+        elif isinstance(stmt, ast.Assign):
+            for tgt in stmt.targets:
+                if isinstance(tgt, ast.Name):
+                    out[tgt.id] = "attr"
+        elif isinstance(stmt, ast.AnnAssign) and isinstance(stmt.target,
+                                                            ast.Name):
+            out[stmt.target.id] = "attr"
+    return out
+
+
+def load_protocols(path: Path | str = _INTERFACES) -> dict[str, dict[str, str]]:
+    """protocol name -> {method name -> "async" | "def"}."""
+    tree = ast.parse(Path(path).read_text())
+    protos: dict[str, dict[str, str]] = {}
+    for node in tree.body:
+        if isinstance(node, ast.ClassDef) and _is_protocol_class(node):
+            protos[node.name] = {
+                name: kind for name, kind in _class_methods(node).items()
+                if kind in ("async", "def")}
+    return protos
+
+
+class ProtocolImplRule:
+    id = "LINT-IFACE-004"
+    description = ("core/ classes must structurally implement the "
+                   "core.interfaces protocol they claim")
+
+    def __init__(self, interfaces_path: Path | str | None = None):
+        self._interfaces_path = Path(interfaces_path or _INTERFACES)
+        self._protos: dict[str, dict[str, str]] | None = None
+
+    @property
+    def protocols(self) -> dict[str, dict[str, str]]:
+        if self._protos is None:
+            self._protos = load_protocols(self._interfaces_path)
+        return self._protos
+
+    def check(self, src: SourceFile) -> Iterable[Finding]:
+        if not src.in_dir("core") or src.rel.endswith("interfaces.py"):
+            return
+        for node in ast.walk(src.tree):
+            if not isinstance(node, ast.ClassDef) or _is_protocol_class(node):
+                continue
+            claims = list(src.implements.get(node.lineno, []))
+            claims += src.implements.get(node.lineno - 1, [])
+            if node.name in self.protocols and node.name not in claims:
+                claims.append(node.name)
+            if not claims:
+                continue
+            methods = _class_methods(node)
+            # inherited members: be permissive, only check direct bases we
+            # can't see aren't object/Protocol — AST-only, so a class with
+            # non-trivial bases gets missing methods reported all the same;
+            # suppress per-line where inheritance provides them.
+            for proto in claims:
+                spec = self.protocols.get(proto)
+                if spec is None:
+                    yield Finding(
+                        src.rel, node.lineno, self.id,
+                        f"class {node.name} claims unknown protocol "
+                        f"`{proto}` (not defined in core/interfaces.py)")
+                    continue
+                for meth, kind in sorted(spec.items()):
+                    have = methods.get(meth)
+                    if have is None:
+                        yield Finding(
+                            src.rel, node.lineno, self.id,
+                            f"class {node.name} claims core.interfaces."
+                            f"{proto} but does not define `{meth}`")
+                    elif kind == "async" and have == "def":
+                        yield Finding(
+                            src.rel, node.lineno, self.id,
+                            f"class {node.name}: core.interfaces.{proto}."
+                            f"{meth} is async but the implementation is a "
+                            "plain `def`")

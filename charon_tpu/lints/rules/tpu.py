@@ -1,0 +1,216 @@
+"""LINT-TPU-003 — dtype and host-sync invariants for the device planes.
+
+Two invariants under `ops/` and `tbls/`:
+
+1. **Big ints must be encoded before reaching the device.** The crypto
+   planes are int32 limb arrays; field elements are 381-bit Python ints.
+   Passing one (or a module constant like `P_INT`) straight into
+   `jnp.asarray`/`jnp.array` silently truncates or raises at trace time —
+   only `fq_from_int`/`limbs_from_int`/`fq2_from_ints` make that safe. The
+   rule flags int literals and module-level int constants ≥ 2**31 entering
+   a jax.numpy array constructor outside one of the safe encoders. Module
+   constants are const-evaluated (including `<<`/`*`/`%`/`**` of other
+   constants), so derived values like `R_MONT = 1 << 384` are caught too.
+
+2. **No host syncs inside `@jax.jit` bodies.** A `.block_until_ready()` or
+   `np.asarray(...)`/`np.array(...)` inside a jitted function forces a
+   device→host transfer at trace/replay time, serializing the dispatch
+   pipeline the plane exists to keep full. (Recognized decorator shapes:
+   `@jax.jit`, `@jit`, `@partial(jax.jit, ...)`, `@jax.jit(...)`.)
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable
+
+from ..engine import Finding, SourceFile
+
+_SCOPE = ("ops", "tbls")
+_INT32_MAX = 2 ** 31
+_SAFE_ENCODERS = ("fq_from_int", "limbs_from_int", "fq2_from_ints",
+                  "to_mont_int", "int_from_limbs",
+                  # host transforms: the int is turned into a string/digit
+                  # sequence on the host, it never reaches the array as a
+                  # single numeric value
+                  "bin", "hex", "oct", "str", "format", "len")
+_ARRAY_CTORS = ("asarray", "array", "full")
+_MAX_POW = 4096  # bound const-eval exponents; crypto consts stay below this
+
+
+def _callee_name(func: ast.expr) -> str | None:
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    if isinstance(func, ast.Name):
+        return func.id
+    return None
+
+
+def _aliases(tree: ast.Module) -> tuple[set[str], set[str], set[str]]:
+    """(numpy aliases, jax.numpy aliases, jax aliases) in this module."""
+    np_al: set[str] = set()
+    jnp_al: set[str] = set()
+    jax_al: set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                tgt = a.asname or a.name.split(".")[0]
+                if a.name == "numpy":
+                    np_al.add(tgt)
+                elif a.name == "jax.numpy":
+                    jnp_al.add(a.asname or "jax")
+                elif a.name == "jax":
+                    jax_al.add(tgt)
+        elif isinstance(node, ast.ImportFrom):
+            if node.module == "jax":
+                for a in node.names:
+                    if a.name == "numpy":
+                        jnp_al.add(a.asname or "numpy")
+    return np_al, jnp_al, jax_al
+
+
+def _const_int(node: ast.expr, env: dict[str, int]) -> int | None:
+    """Best-effort compile-time int evaluation over module constants."""
+    if isinstance(node, ast.Constant):
+        return node.value if isinstance(node.value, int) else None
+    if isinstance(node, ast.Name):
+        return env.get(node.id)
+    if isinstance(node, ast.UnaryOp) and isinstance(node.op, ast.USub):
+        v = _const_int(node.operand, env)
+        return None if v is None else -v
+    if isinstance(node, ast.BinOp):
+        lhs = _const_int(node.left, env)
+        rhs = _const_int(node.right, env)
+        if lhs is None or rhs is None:
+            return None
+        try:
+            if isinstance(node.op, ast.Add):
+                return lhs + rhs
+            if isinstance(node.op, ast.Sub):
+                return lhs - rhs
+            if isinstance(node.op, ast.Mult):
+                return lhs * rhs
+            if isinstance(node.op, ast.FloorDiv):
+                return lhs // rhs
+            if isinstance(node.op, ast.Mod):
+                return lhs % rhs
+            if isinstance(node.op, ast.LShift) and rhs <= _MAX_POW:
+                return lhs << rhs
+            if isinstance(node.op, ast.RShift):
+                return lhs >> rhs
+            if isinstance(node.op, ast.Pow) and rhs <= _MAX_POW:
+                return lhs ** rhs
+        except (ZeroDivisionError, ValueError, OverflowError):
+            return None
+    return None
+
+
+def _module_consts(tree: ast.Module) -> dict[str, int]:
+    env: dict[str, int] = {}
+    for node in tree.body:
+        if (isinstance(node, ast.Assign) and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)):
+            val = _const_int(node.value, env)
+            if val is not None:
+                env[node.targets[0].id] = val
+    return env
+
+
+def _is_jit_decorator(dec: ast.expr, jax_al: set[str]) -> bool:
+    def is_jit_ref(e: ast.expr) -> bool:
+        if isinstance(e, ast.Attribute):
+            return e.attr == "jit" and isinstance(e.value, ast.Name) \
+                and e.value.id in jax_al
+        return isinstance(e, ast.Name) and e.id == "jit"
+
+    if is_jit_ref(dec):
+        return True
+    if isinstance(dec, ast.Call):
+        if is_jit_ref(dec.func):  # @jax.jit(static_argnums=...)
+            return True
+        if _callee_name(dec.func) == "partial" and dec.args \
+                and is_jit_ref(dec.args[0]):
+            return True
+    return False
+
+
+class DeviceDtypeRule:
+    id = "LINT-TPU-003"
+    description = ("big Python ints must pass through fq_from_int/"
+                   "limbs_from_int before jnp arrays; no host syncs inside "
+                   "@jax.jit bodies")
+
+    def check(self, src: SourceFile) -> Iterable[Finding]:
+        if not src.in_dir(*_SCOPE):
+            return
+        np_al, jnp_al, jax_al = _aliases(src.tree)
+        env = _module_consts(src.tree)
+        yield from self._check_big_ints(src, jnp_al, env)
+        yield from self._check_jit_host_sync(src, np_al, jax_al)
+
+    # -- invariant 1: big ints entering device arrays -----------------------
+
+    def _check_big_ints(self, src: SourceFile, jnp_al: set[str],
+                        env: dict[str, int]) -> Iterable[Finding]:
+        for node in ast.walk(src.tree):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in _ARRAY_CTORS
+                    and isinstance(node.func.value, ast.Name)
+                    and node.func.value.id in jnp_al
+                    and node.args):
+                continue
+            for offender in self._big_int_refs(node.args[0], env):
+                label = (offender.id if isinstance(offender, ast.Name)
+                         else "int literal")
+                yield Finding(
+                    src.rel, node.lineno, self.id,
+                    f"`{label}` (≥ 2**31) flows into a jax.numpy array; "
+                    "int32 limb planes overflow — encode via fq_from_int/"
+                    "limbs_from_int first")
+
+    def _big_int_refs(self, node: ast.expr,
+                      env: dict[str, int]) -> Iterable[ast.expr]:
+        """Int literals / const names ≥ 2**31 in `node`, skipping subtrees
+        already wrapped in a safe encoder call."""
+        if isinstance(node, ast.Call) \
+                and _callee_name(node.func) in _SAFE_ENCODERS:
+            return
+        if isinstance(node, ast.Constant) and isinstance(node.value, int) \
+                and abs(node.value) >= _INT32_MAX:
+            yield node
+        if isinstance(node, ast.Name) \
+                and abs(env.get(node.id, 0)) >= _INT32_MAX:
+            yield node
+        for child in ast.iter_child_nodes(node):
+            yield from self._big_int_refs(child, env)
+
+    # -- invariant 2: host syncs inside jit bodies --------------------------
+
+    def _check_jit_host_sync(self, src: SourceFile, np_al: set[str],
+                             jax_al: set[str]) -> Iterable[Finding]:
+        for node in ast.walk(src.tree):
+            if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if not any(_is_jit_decorator(d, jax_al)
+                       for d in node.decorator_list):
+                continue
+            for sub in ast.walk(node):
+                if not isinstance(sub, ast.Call):
+                    continue
+                if isinstance(sub.func, ast.Attribute) \
+                        and sub.func.attr == "block_until_ready":
+                    yield Finding(
+                        src.rel, sub.lineno, self.id,
+                        f"`.block_until_ready()` inside @jax.jit body "
+                        f"`{node.name}` forces a host sync in the traced "
+                        "region; sync outside the jitted function")
+                elif (isinstance(sub.func, ast.Attribute)
+                      and sub.func.attr in ("asarray", "array")
+                      and isinstance(sub.func.value, ast.Name)
+                      and sub.func.value.id in np_al):
+                    yield Finding(
+                        src.rel, sub.lineno, self.id,
+                        f"`numpy.{sub.func.attr}()` inside @jax.jit body "
+                        f"`{node.name}` is a device→host transfer at trace "
+                        "time; use jax.numpy or move it out of the jit")

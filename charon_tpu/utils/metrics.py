@@ -172,9 +172,11 @@ class Registry:
                         acc = 0
                         for i, ub in enumerate(m.buckets):
                             acc += counts[i]
-                            lines.append(f'{m.name}_bucket{{{labelset(m, key, f"le=\"{ub}\"")}}} {acc}')
+                            le = f'le="{ub}"'
+                            lines.append(f'{m.name}_bucket{{{labelset(m, key, le)}}} {acc}')
                         acc += counts[-1]
-                        lines.append(f'{m.name}_bucket{{{labelset(m, key, "le=\"+Inf\"")}}} {acc}')
+                        le = 'le="+Inf"'
+                        lines.append(f'{m.name}_bucket{{{labelset(m, key, le)}}} {acc}')
                         lines.append(f"{m.name}_sum{{{labelset(m, key)}}} {m._sums.get(key, 0.0)}")
                         lines.append(f"{m.name}_count{{{labelset(m, key)}}} {acc}")
             else:

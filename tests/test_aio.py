@@ -1,0 +1,74 @@
+"""Task-retention semantics of utils/aio.spawn: the event loop only holds
+weak references to tasks, so spawn must root them until completion
+(LINT-AIO-001's runtime counterpart) and surface their exceptions."""
+
+import asyncio
+import gc
+import weakref
+
+from charon_tpu.utils import aio, log
+
+
+def test_spawned_task_survives_forced_gc():
+    async def main():
+        done = asyncio.Event()
+
+        async def work():
+            await asyncio.sleep(0.01)
+            done.set()
+
+        # Deliberately drop the returned task reference: spawn's module-level
+        # registry must be the thing keeping it alive.
+        ref = weakref.ref(aio.spawn(work(), name="gc-victim"))
+        for _ in range(3):
+            gc.collect()
+        assert ref() is not None, "spawned task was garbage-collected"
+        assert aio.pending_count() >= 1
+        await asyncio.wait_for(done.wait(), timeout=5)
+        await aio.drain()
+        assert aio.pending_count() == 0
+
+    asyncio.run(main())
+
+
+def test_spawned_task_exception_is_logged():
+    async def main():
+        async def boom():
+            raise RuntimeError("duty dropped")
+
+        before = log.log_error_total.get("aio", 0)
+        aio.spawn(boom(), name="boom")
+        await aio.drain()
+        await asyncio.sleep(0)  # let the done-callback run
+        assert log.log_error_total.get("aio", 0) == before + 1
+
+    asyncio.run(main())
+
+
+def test_spawned_quiet_task_is_retained_but_not_logged():
+    async def main():
+        async def boom():
+            raise RuntimeError("handled by caller")
+
+        before = log.log_error_total.get("aio", 0)
+        task = aio.spawn(boom(), name="quiet-boom", quiet=True)
+        await aio.drain()
+        await asyncio.sleep(0)
+        assert task.done() and isinstance(task.exception(), RuntimeError)
+        assert log.log_error_total.get("aio", 0) == before
+
+    asyncio.run(main())
+
+
+def test_drain_awaits_cancelled_tasks():
+    async def main():
+        async def forever():
+            await asyncio.Event().wait()
+
+        task = aio.spawn(forever(), name="forever")
+        task.cancel()
+        await aio.drain()
+        assert task.cancelled()
+        assert aio.pending_count() == 0
+
+    asyncio.run(main())

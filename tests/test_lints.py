@@ -1,0 +1,463 @@
+"""Tests for charon_tpu.lints: engine mechanics, fixture cases for every
+rule (violation + clean), suppressions, baseline workflow, CLI, and the
+tree-wide self-check that gates new findings against the checked-in
+baseline."""
+
+from __future__ import annotations
+
+import json
+import textwrap
+from pathlib import Path
+
+import charon_tpu
+from charon_tpu.lints import (
+    Engine,
+    baseline_counts,
+    load_baseline,
+    new_findings,
+    write_baseline,
+)
+from charon_tpu.lints.__main__ import DEFAULT_BASELINE, main as lint_main
+
+PKG_DIR = Path(charon_tpu.__file__).resolve().parent
+REPO_ROOT = PKG_DIR.parent
+
+
+def lint_source(tmp_path: Path, rel: str, source: str) -> list:
+    """Write `source` at tmp/rel and lint it; paths in findings are
+    relative to tmp, so `core/x.py` fixtures scope like the real tree."""
+    path = tmp_path / rel
+    path.parent.mkdir(parents=True, exist_ok=True)
+    path.write_text(textwrap.dedent(source))
+    return Engine().lint_paths([path], root=tmp_path)
+
+
+def rules_of(findings) -> list[str]:
+    return [f.rule for f in findings]
+
+
+# ---------------------------------------------------------------------------
+# LINT-AIO-001 — untracked tasks
+# ---------------------------------------------------------------------------
+
+
+def test_aio_rule_flags_discarded_task(tmp_path):
+    findings = lint_source(tmp_path, "core/x.py", """\
+        import asyncio
+
+        async def go(coro):
+            asyncio.ensure_future(coro)
+    """)
+    assert rules_of(findings) == ["LINT-AIO-001"]
+    assert "ensure_future" in findings[0].message
+    assert findings[0].line == 4
+
+
+def test_aio_rule_flags_loop_create_task_statement(tmp_path):
+    findings = lint_source(tmp_path, "eth2/x.py", """\
+        import asyncio
+
+        def go(loop, coro):
+            loop.create_task(coro)
+    """)
+    assert rules_of(findings) == ["LINT-AIO-001"]
+
+
+def test_aio_rule_accepts_retained_tasks(tmp_path):
+    findings = lint_source(tmp_path, "core/x.py", """\
+        import asyncio
+        from charon_tpu.utils import aio
+
+        async def go(coro, other):
+            t = asyncio.create_task(coro)          # assigned
+            tasks = {asyncio.ensure_future(other): 1}  # collected
+            aio.spawn(coro)                        # the blessed wrapper
+            await t
+            return tasks
+    """)
+    assert findings == []
+
+
+# ---------------------------------------------------------------------------
+# LINT-EXC-002 — broad excepts in core/, dkg/, p2p/
+# ---------------------------------------------------------------------------
+
+
+def test_exc_rule_flags_silent_broad_except(tmp_path):
+    findings = lint_source(tmp_path, "core/x.py", """\
+        def go():
+            try:
+                work()
+            except Exception:
+                pass
+    """)
+    assert rules_of(findings) == ["LINT-EXC-002"]
+
+
+def test_exc_rule_accepts_logged_or_reraised(tmp_path):
+    findings = lint_source(tmp_path, "dkg/x.py", """\
+        def go(_log):
+            try:
+                work()
+            except Exception as exc:
+                _log.warn("work failed", err=exc)
+            try:
+                work()
+            except Exception:
+                raise
+    """)
+    assert findings == []
+
+
+def test_exc_rule_bare_and_baseexception_need_reraise(tmp_path):
+    findings = lint_source(tmp_path, "p2p/x.py", """\
+        def go(_log):
+            try:
+                work()
+            except BaseException as exc:
+                _log.error("boom", err=exc)   # logging alone is NOT enough
+    """)
+    assert rules_of(findings) == ["LINT-EXC-002"]
+    assert "CancelledError" in findings[0].message
+
+    clean = lint_source(tmp_path, "p2p/y.py", """\
+        def go():
+            try:
+                work()
+            except BaseException:
+                cleanup()
+                raise
+    """)
+    assert clean == []
+
+
+def test_exc_rule_ignores_files_outside_scope(tmp_path):
+    findings = lint_source(tmp_path, "testutil/x.py", """\
+        def go():
+            try:
+                work()
+            except Exception:
+                pass
+    """)
+    assert findings == []
+
+
+# ---------------------------------------------------------------------------
+# LINT-TPU-003 — device dtype and host-sync invariants
+# ---------------------------------------------------------------------------
+
+
+def test_tpu_rule_flags_big_int_into_device_array(tmp_path):
+    findings = lint_source(tmp_path, "ops/x.py", """\
+        import jax.numpy as jnp
+
+        P_INT = 0x1A0111EA397FE69A4B1BA7B6434BACD764774B84F38512BF
+
+        def bad():
+            return jnp.asarray(P_INT, dtype=jnp.int32)
+    """)
+    assert rules_of(findings) == ["LINT-TPU-003"]
+    assert "P_INT" in findings[0].message
+
+
+def test_tpu_rule_const_evals_derived_constants(tmp_path):
+    findings = lint_source(tmp_path, "tbls/x.py", """\
+        import jax.numpy as jnp
+
+        LIMB_BITS = 12
+        LIMBS = 32
+        R_MONT = 1 << (LIMB_BITS * LIMBS)
+
+        def bad():
+            return jnp.asarray(R_MONT)
+    """)
+    assert rules_of(findings) == ["LINT-TPU-003"]
+
+
+def test_tpu_rule_accepts_encoded_and_host_transformed_ints(tmp_path):
+    findings = lint_source(tmp_path, "ops/x.py", """\
+        import jax.numpy as jnp
+        from .field import fq_from_int
+
+        P_INT = 1 << 380
+
+        def good():
+            a = jnp.asarray(fq_from_int(P_INT), dtype=jnp.int32)
+            bits = jnp.asarray([int(b) for b in bin(P_INT)[2:]])
+            small = jnp.asarray(42)
+            return a, bits, small
+    """)
+    assert findings == []
+
+
+def test_tpu_rule_flags_host_sync_inside_jit(tmp_path):
+    findings = lint_source(tmp_path, "ops/x.py", """\
+        import functools
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        @jax.jit
+        def bad1(x):
+            y = x + 1
+            y.block_until_ready()
+            return y
+
+        @functools.partial(jax.jit, static_argnums=(1,))
+        def bad2(x, k):
+            return jnp.sum(np.asarray(x))
+    """)
+    assert rules_of(findings) == ["LINT-TPU-003", "LINT-TPU-003"]
+    assert "block_until_ready" in findings[0].message
+    assert "numpy.asarray" in findings[1].message
+
+
+def test_tpu_rule_allows_host_calls_outside_jit(tmp_path):
+    findings = lint_source(tmp_path, "ops/x.py", """\
+        import jax
+        import numpy as np
+
+        def host_wrapper(kernel, x):
+            out = kernel(x)
+            out.block_until_ready()
+            return np.asarray(out)
+    """)
+    assert findings == []
+
+
+def test_tpu_rule_ignores_files_outside_scope(tmp_path):
+    findings = lint_source(tmp_path, "core/x.py", """\
+        import jax.numpy as jnp
+
+        BIG = 1 << 200
+
+        def fine():
+            return jnp.asarray(BIG)
+    """)
+    assert findings == []
+
+
+# ---------------------------------------------------------------------------
+# LINT-IFACE-004 — protocol implementation claims
+# ---------------------------------------------------------------------------
+
+
+def test_iface_rule_flags_missing_method(tmp_path):
+    # name-match claim: a core/ class named like the Scheduler protocol
+    findings = lint_source(tmp_path, "core/sched.py", """\
+        class Scheduler:
+            def subscribe_duties(self, fn):
+                pass
+    """)
+    assert set(rules_of(findings)) == {"LINT-IFACE-004"}
+    missing = {f.message.split("`")[1] for f in findings
+               if "does not define" in f.message}
+    assert missing == {"subscribe_slots", "run"}
+
+
+def test_iface_rule_flags_sync_impl_of_async_method(tmp_path):
+    findings = lint_source(tmp_path, "core/f.py", """\
+        class Fetcher:
+            def fetch(self, duty, defset):   # protocol says async def
+                pass
+
+            def subscribe(self, fn):
+                pass
+    """)
+    assert rules_of(findings) == ["LINT-IFACE-004"]
+    assert "async" in findings[0].message
+
+
+def test_iface_rule_accepts_complete_explicit_claim(tmp_path):
+    findings = lint_source(tmp_path, "core/db.py", """\
+        class MemDB:  # lint: implements=DutyDB
+            async def store(self, duty, unsigned):
+                pass
+    """)
+    assert findings == []
+
+
+def test_iface_rule_flags_unknown_protocol_claim(tmp_path):
+    findings = lint_source(tmp_path, "core/db.py", """\
+        class MemDB:  # lint: implements=NoSuchProto
+            pass
+    """)
+    assert rules_of(findings) == ["LINT-IFACE-004"]
+    assert "unknown protocol" in findings[0].message
+
+
+# ---------------------------------------------------------------------------
+# engine mechanics: suppressions, parse errors, caching
+# ---------------------------------------------------------------------------
+
+
+def test_suppression_same_line_and_line_above(tmp_path):
+    findings = lint_source(tmp_path, "core/x.py", """\
+        import asyncio
+
+        async def go(coro, other):
+            asyncio.ensure_future(coro)  # lint: disable=LINT-AIO-001
+            # lint: disable=LINT-AIO-001
+            asyncio.ensure_future(other)
+    """)
+    assert findings == []
+
+
+def test_suppression_file_level_and_wrong_rule(tmp_path):
+    findings = lint_source(tmp_path, "core/x.py", """\
+        # lint: disable-file=LINT-EXC-002
+        import asyncio
+
+        async def go(coro):
+            try:
+                await coro
+            except Exception:
+                pass
+            asyncio.ensure_future(coro)  # lint: disable=LINT-EXC-002
+    """)
+    # the EXC findings are suppressed; the AIO one is not (wrong rule id)
+    assert rules_of(findings) == ["LINT-AIO-001"]
+
+
+def test_parse_error_becomes_finding(tmp_path):
+    findings = lint_source(tmp_path, "core/x.py", "def broken(:\n")
+    assert rules_of(findings) == ["LINT-PARSE-000"]
+
+
+def test_engine_cache_roundtrip(tmp_path):
+    src = tmp_path / "core" / "x.py"
+    src.parent.mkdir(parents=True)
+    src.write_text("import asyncio\n\n"
+                   "async def go(c):\n    asyncio.ensure_future(c)\n")
+    cache = tmp_path / "cache.json"
+    first = Engine(cache_path=cache).lint_paths([src], root=tmp_path)
+    assert cache.exists()
+    second = Engine(cache_path=cache).lint_paths([src], root=tmp_path)
+    assert first == second and rules_of(second) == ["LINT-AIO-001"]
+    # content change invalidates the entry
+    src.write_text("x = 1\n")
+    third = Engine(cache_path=cache).lint_paths([src], root=tmp_path)
+    assert third == []
+
+
+# ---------------------------------------------------------------------------
+# baseline workflow
+# ---------------------------------------------------------------------------
+
+
+def test_baseline_grandfathers_and_detects_new(tmp_path):
+    findings = lint_source(tmp_path, "core/x.py", """\
+        import asyncio
+
+        async def go(a, b):
+            asyncio.ensure_future(a)
+    """)
+    baseline_path = tmp_path / "baseline.json"
+    write_baseline(baseline_path, findings)
+    baseline = load_baseline(baseline_path)
+    assert new_findings(findings, baseline) == []
+
+    # a SECOND identical violation in the same file exceeds the count
+    more = lint_source(tmp_path, "core/x.py", """\
+        import asyncio
+
+        async def go(a, b):
+            asyncio.ensure_future(a)
+            asyncio.ensure_future(b)
+    """)
+    assert len(new_findings(more, baseline)) == 1
+
+
+def test_baseline_update_is_deterministic(tmp_path):
+    findings = lint_source(tmp_path, "core/x.py", """\
+        import asyncio
+
+        async def go(a):
+            try:
+                await a
+            except Exception:
+                pass
+            asyncio.ensure_future(a)
+    """)
+    p1, p2 = tmp_path / "b1.json", tmp_path / "b2.json"
+    write_baseline(p1, findings)
+    write_baseline(p2, list(reversed(findings)))
+    assert p1.read_text() == p2.read_text()
+    assert sum(baseline_counts(findings).values()) == 2
+
+
+# ---------------------------------------------------------------------------
+# CLI
+# ---------------------------------------------------------------------------
+
+
+def test_cli_json_output_and_exit_codes(tmp_path, capsys):
+    bad = tmp_path / "core" / "x.py"
+    bad.parent.mkdir(parents=True)
+    bad.write_text("import asyncio\n\n"
+                   "async def go(c):\n    asyncio.ensure_future(c)\n")
+    rc = lint_main(["--json", "--no-baseline", "--root", str(tmp_path),
+                    str(bad)])
+    report = json.loads(capsys.readouterr().out)
+    assert rc == 1
+    assert report["counts_by_rule"] == {"LINT-AIO-001": 1}
+    assert report["new"] == 1
+    assert report["findings"][0]["path"] == "core/x.py"
+
+    bad.write_text("x = 1\n")
+    assert lint_main(["--no-baseline", "--root", str(tmp_path),
+                      str(bad)]) == 0
+    assert lint_main([str(tmp_path / "missing.py")]) == 2
+
+
+def test_cli_baseline_update_roundtrip(tmp_path, capsys):
+    bad = tmp_path / "p2p" / "x.py"
+    bad.parent.mkdir(parents=True)
+    bad.write_text("def go():\n    try:\n        w()\n"
+                   "    except Exception:\n        pass\n")
+    baseline = tmp_path / "baseline.json"
+    assert lint_main(["--baseline", str(baseline), "--baseline-update",
+                      "--root", str(tmp_path), str(bad)]) == 0
+    capsys.readouterr()
+    assert lint_main(["--baseline", str(baseline), "--root", str(tmp_path),
+                      str(bad)]) == 0
+
+
+# ---------------------------------------------------------------------------
+# tree-wide self-check: the whole package must be clean vs the baseline
+# ---------------------------------------------------------------------------
+
+
+def test_self_check_whole_tree_against_baseline():
+    """Lint all of charon_tpu/ against the checked-in baseline. This test
+    FAILS if any new finding — e.g. a fresh LINT-AIO-001 untracked task —
+    is introduced anywhere under the package."""
+    findings = Engine().lint_paths([PKG_DIR], root=REPO_ROOT)
+    baseline = load_baseline(DEFAULT_BASELINE)
+    new = new_findings(findings, baseline)
+    assert new == [], "new lint findings:\n" + "\n".join(
+        f.render() for f in new)
+
+
+def test_self_check_catches_injected_violation(tmp_path):
+    """The self-check actually has teeth: add one untracked-task file to
+    the scanned set and the baseline comparison reports exactly it."""
+    bad = tmp_path / "core" / "injected.py"
+    bad.parent.mkdir(parents=True)
+    bad.write_text("import asyncio\n\n"
+                   "async def go(c):\n    asyncio.ensure_future(c)\n")
+    findings = Engine().lint_paths([PKG_DIR, bad], root=REPO_ROOT)
+    baseline = load_baseline(DEFAULT_BASELINE)
+    new = new_findings(findings, baseline)
+    assert [f.rule for f in new] == ["LINT-AIO-001"]
+    assert new[0].path.endswith("core/injected.py")
+
+
+def test_checked_in_baseline_is_normalized():
+    """The baseline file must round-trip through --baseline-update
+    formatting (sorted keys, trailing newline) so CI diffs stay clean."""
+    raw = json.loads(DEFAULT_BASELINE.read_text())
+    keys = list(raw["findings"])
+    assert keys == sorted(keys)
+    assert all(isinstance(v, int) and v > 0 for v in raw["findings"].values())
+    assert DEFAULT_BASELINE.read_text().endswith("}\n")
