@@ -177,30 +177,44 @@ def _measure(cpu_only: bool) -> None:
         assert bytes(aggs[i]) == bytes(cpu_aggs[i]), "bit-identity violation"
 
     # Steady-state PIPELINED throughput: slot N+1's host parse overlaps
-    # slot N's device execution (plane_agg's dispatch/finish split; jax
-    # dispatch is async, at most two slots in flight). This is how sigagg
-    # consumes consecutive slots in production — the executor-side
-    # coalescer thread dispatches while the loop prepares the next duty.
+    # slot N's device execution (plane_agg.SigAggPipeline over the
+    # dispatch/finish split; jax dispatch is async, at most two slots in
+    # flight). This is how sigagg consumes consecutive slots in
+    # production — the executor-side coalescer thread dispatches while
+    # the loop prepares the next duty.
     from charon_tpu.ops import plane_agg
+    from charon_tpu.ops.plane_store import STORE
 
     byte_batches = [{i: bytes(s) for i, s in b.items()} for b in batches]
     pk_bytes = [bytes(pk) for pk in pubkeys]
     K = 6
+    base = STORE.stats()  # counters before the timed slots (cache is warm)
+    pipe = plane_agg.SigAggPipeline()
     t0 = time.time()
-    prev = plane_agg._fused_dispatch(
-        plane_agg._layout_slots(byte_batches), pk_bytes, datas)
-    for _ in range(K - 1):
-        nxt = plane_agg._fused_dispatch(
-            plane_agg._layout_slots(byte_batches), pk_bytes, datas)
-        aggs_p, ok_p = plane_agg._fused_finish(prev)
-        assert ok_p, "pipelined slot verification failed"
-        prev = nxt
-    aggs_p, ok_p = plane_agg._fused_finish(prev)
-    assert ok_p
+    done = []
+    for _ in range(K):
+        done += pipe.submit(byte_batches, pk_bytes, datas)
+    done += pipe.drain()
     t_pipe = (time.time() - t0) / K
+    for aggs_p, ok_p in done:
+        assert ok_p, "pipelined slot verification failed"
+    aggs_p, _ok = done[-1]
     assert aggs_p[:CPU_SAMPLE] == [bytes(a) for a in cpu_aggs[:CPU_SAMPLE]]
     print(f"# pipelined steady state: {K} slots, {t_pipe:.2f}s/slot "
           f"(single-call p50 {t_slot:.2f}s)", file=sys.stderr)
+
+    # PlaneStore steady state: a FIXED peer set must be pure cache hits
+    # after slot 1 — zero decompress dispatches across the timed slots.
+    steady = STORE.stats()
+    dd = steady["decompress_dispatches"] - base["decompress_dispatches"]
+    print(f"# planestore: hits={steady['hits']} misses={steady['misses']} "
+          f"evictions={steady['evictions']} "
+          f"decompress_dispatches={steady['decompress_dispatches']} "
+          f"entries={steady['entries']} pinned={steady['pinned_sets']} "
+          f"resident_mb={steady['resident_bytes'] / 1e6:.1f} "
+          f"(timed-slot decompress delta {dd})", file=sys.stderr)
+    assert dd == 0, \
+        "warm-cache steady state re-paid a pk decompress dispatch"
 
     device_throughput = N_VALIDATORS / min(t_pipe, t_slot)
     print(json.dumps({

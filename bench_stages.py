@@ -157,8 +157,13 @@ def main() -> None:
                                       t0)
     assert rc == 1, "verification failed"
 
+    from charon_tpu.ops.plane_store import STORE
+
     print(json.dumps({
         "stages": {k: round(v, 3) for k, v in stages.items()},
+        # hit/miss/decompress counters show whether ver.pk_plane_cached
+        # above was a PlaneStore hit (steady state) or paid a decode
+        "planestore": STORE.stats(),
         "throughput": round(N / (stages["agg.total"] + stages["ver.total"]),
                             1)}))
 

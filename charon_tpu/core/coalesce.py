@@ -233,8 +233,13 @@ class TblsCoalescer:
         batches = [b for p in payloads for b in p[0]]
         pks = [k for p in payloads for k in p[1]]
         roots = [r for p in payloads for r in p[2]]
+        # the OVERLAPPED facade: consecutive flushes run on different
+        # executor threads, and the TPU backend's dispatch pipeline locks
+        # only the host pack — so flush N+1 packs its buffers while flush
+        # N's fused graph executes on device (double-buffered dispatch)
         sigs, ok = await loop.run_in_executor(
-            None, tbls.threshold_aggregate_verify_batch, batches, pks, roots)
+            None, tbls.threshold_aggregate_verify_overlapped,
+            batches, pks, roots)
         off = 0
         slices = []
         for p in payloads:

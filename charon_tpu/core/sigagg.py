@@ -37,6 +37,18 @@ class SigAgg:
         # duties of a small cluster still reach the device batch threshold
         self._coalescer = coalescer
         self._subs = []
+        # The cluster's pubkey sets are fixed for the run (the share⇄root
+        # maps come from the cluster lock), so declare them long-lived up
+        # front: backends with a device-resident PlaneStore pin the sigagg
+        # root set and each per-peer share set (the parsigex verify shape)
+        # against cache eviction; CPU backends no-op (tbls.pin_pubkeys).
+        if keys.root_pubkeys:
+            tbls.pin_pubkeys([pubkey_to_bytes(pk) for pk in keys.root_pubkeys])
+            for idx in range(1, keys.num_shares + 1):
+                share_set = [bytes(shares[idx]) for shares
+                             in keys.share_pubkeys.values() if idx in shares]
+                if share_set:
+                    tbls.pin_pubkeys(share_set)
 
     def subscribe(self, fn) -> None:
         self._subs.append(fn)

@@ -70,6 +70,25 @@ def _decode(fn):
         raise ValueError(f"malformed body: {exc}") from exc
 
 
+def _ids_filter(body) -> list:
+    """Validator-filter ids from a POST /validators body. JSON null (or an
+    absent "ids") legitimately means "no filter"; any OTHER non-object body
+    (`[]`, `0`, `false`, a string) used to silently return the whole
+    cluster, and a string under "ids" iterated character-by-character into
+    garbage lookups. Raise TypeError so _decode's remap turns these into
+    400s instead."""
+    if body is None:
+        return []
+    if not isinstance(body, dict):
+        raise TypeError("request body must be a JSON object")
+    ids = body.get("ids")
+    if ids is None:
+        return []
+    if not isinstance(ids, list):
+        raise TypeError('"ids" must be a JSON array')
+    return ids
+
+
 def _hex_arg(request: web.Request, name: str) -> bytes:
     raw = request.query.get(name, "")
     if not raw:
@@ -279,7 +298,7 @@ class VapiRouter:
                 ids.extend(x.strip() for x in csv.split(",") if x.strip())
             if request.method == "POST" and request.can_read_body:
                 body = await request.json()
-                for x in _decode(lambda: (body or {}).get("ids") or []):
+                for x in _decode(lambda: _ids_filter(body)):
                     ids.append(str(x))
             vals = await self._comp.get_validators(ids)
             return _data([_encode_validator(v) for v, _share in vals])

@@ -233,19 +233,24 @@ def verify_share(my_index: int, share: int, commitments: list[bytes]) -> None:
         raise errors.new("share does not match commitments", index=my_index)
 
 
-# Measured on v5e (BASELINE config 4) — FINAL, round 5: the share
-# verification is one-shot-point bound. Round 4 measured the hybrid
-# (native decode + device sweep) at 0.4-0.7x native; round 5 built the
-# fully-FUSED one-dispatch graph (plane_agg._g1_decode_groups_sweep_jit:
-# device decompress + subgroup + sweep + reduces, no native decode, no
-# extra syncs — the same fusion that won sigagg) and it measures 0.48x
-# at the 4.8k-point ceremony shape (1.53 s device vs 0.73 s native for
-# 1000 checks): the native C++ per-item lincomb at ~0.7 ms/check is
-# simply faster than shipping fresh one-shot points through the remote
-# tunnel and paying the decompress sqrt scans for a single use. The
-# device equation stays correct, bit-tested, and gated to batches large
-# enough that the sweep's linear win could overtake the fixed
-# scan/transfer cost; ceremony sizes use native, by measurement.
+# Measured on v5e (BASELINE config 4) — round 5: the share verification
+# is one-shot-point bound. Round 4 measured the hybrid (native decode +
+# device sweep) at 0.4-0.7x native; round 5 built the fully-FUSED
+# one-dispatch graph (plane_agg._g1_decode_groups_sweep_jit: device
+# decompress + subgroup + sweep + reduces, no native decode, no extra
+# syncs — the same fusion that won sigagg) and it measures 0.48x at the
+# 4.8k-point ceremony shape (1.53 s device vs 0.73 s native for 1000
+# checks): the native C++ per-item lincomb at ~0.7 ms/check is simply
+# faster than shipping fresh one-shot points through the remote tunnel
+# and paying the decompress sqrt scans for a single use. The gate below
+# keeps ceremony sizes native, by measurement. This threshold sits far
+# above the 1024-lane (TILE) compile ceiling, which used to make it
+# UNREACHABLE: the fused graph could never compile at the shapes the
+# gate admitted (ADVICE round 5). g1_groups_msm now splits its device
+# path into TILE-sized chunked dispatches of the already-compiled graph
+# (plane_agg._groups_msm_chunk), so batches past the gate genuinely run
+# on device — the chunks pipeline asynchronously and the per-group
+# partial sums combine on the host.
 _DEVICE_MIN_POINTS = 16384
 
 

@@ -5,12 +5,13 @@ from __future__ import annotations
 from .aio import UntrackedTaskRule
 from .exc import BroadExceptRule
 from .iface import ProtocolImplRule
-from .tpu import DeviceDtypeRule
+from .tpu import DeviceDtypeRule, PlaneStoreRoutingRule
 
 __all__ = [
     "UntrackedTaskRule",
     "BroadExceptRule",
     "DeviceDtypeRule",
+    "PlaneStoreRoutingRule",
     "ProtocolImplRule",
     "default_rules",
 ]
@@ -21,5 +22,6 @@ def default_rules() -> list:
         UntrackedTaskRule(),
         BroadExceptRule(),
         DeviceDtypeRule(),
+        PlaneStoreRoutingRule(),
         ProtocolImplRule(),
     ]

@@ -1,6 +1,6 @@
-"""LINT-TPU-003 — dtype and host-sync invariants for the device planes.
+"""LINT-TPU-003 / LINT-TPU-005 — device-plane invariants under ops/ and tbls/.
 
-Two invariants under `ops/` and `tbls/`:
+LINT-TPU-003 (DeviceDtypeRule) — two dtype/sync invariants:
 
 1. **Big ints must be encoded before reaching the device.** The crypto
    planes are int32 limb arrays; field elements are 381-bit Python ints.
@@ -17,6 +17,16 @@ Two invariants under `ops/` and `tbls/`:
    device→host transfer at trace/replay time, serializing the dispatch
    pipeline the plane exists to keep full. (Recognized decorator shapes:
    `@jax.jit`, `@jit`, `@partial(jax.jit, ...)`, `@jax.jit(...)`.)
+
+LINT-TPU-005 (PlaneStoreRoutingRule) — pubkey bytes route through the
+PlaneStore. Compressed public-key sets are static per cluster; decoding
+them per call (`g1_plane_from_compressed` / `_parse_compressed` straight
+from a `pks`-like argument) re-pays the sqrt-scan decompress and subgroup
+sweep that `ops.plane_store.STORE` exists to amortize. The rule flags
+plane-builder calls whose first argument mentions a pubkey-hinted name,
+except inside the store itself, inside the decode layer the store calls
+(`g1_plane_from_compressed` and its device half), or inside a callback
+handed to `STORE.host_entry` (that IS the sanctioned routing).
 """
 
 from __future__ import annotations
@@ -214,3 +224,76 @@ class DeviceDtypeRule:
                         f"`numpy.{sub.func.attr}()` inside @jax.jit body "
                         f"`{node.name}` is a device→host transfer at trace "
                         "time; use jax.numpy or move it out of the jit")
+
+
+_PLANE_BUILDERS = ("g1_plane_from_compressed", "_parse_compressed")
+_PK_HINTS = ("pk", "pubkey", "public_key")
+# the decode layer the PlaneStore itself dispatches through — a pk-named
+# argument HERE is the implementation of the sanctioned path, not a bypass
+_SANCTIONED_DEFS = ("g1_plane_from_compressed", "_g1_plane_device")
+
+
+class PlaneStoreRoutingRule:
+    id = "LINT-TPU-005"
+    description = ("compressed pubkey bytes must reach plane construction "
+                   "through ops.plane_store.STORE (full_plane/chunk_planes/"
+                   "host_entry), not ad-hoc decompress calls")
+
+    def check(self, src: SourceFile) -> Iterable[Finding]:
+        if not src.in_dir(*_SCOPE):
+            return
+        if src.rel.split("/")[-1] == "plane_store.py":
+            return  # the store IS the sanctioned decode entry
+        cb_names = self._host_entry_callbacks(src.tree)
+        for node in ast.walk(src.tree):
+            if not (isinstance(node, ast.Call)
+                    and _callee_name(node.func) in _PLANE_BUILDERS
+                    and node.args):
+                continue
+            hint = self._pk_hint(node.args[0])
+            if hint is None:
+                continue
+            encl = self._enclosing_defs(src, node)
+            if any(n in _SANCTIONED_DEFS or n in cb_names for n in encl):
+                continue
+            yield Finding(
+                src.rel, node.lineno, self.id,
+                f"`{hint}` (compressed pubkey bytes) fed straight into "
+                f"`{_callee_name(node.func)}` re-decodes a static set every "
+                "call; route through plane_store.STORE (full_plane/"
+                "chunk_planes/host_entry) so steady-state slots hit the "
+                "device-resident cache")
+
+    @staticmethod
+    def _host_entry_callbacks(tree: ast.Module) -> set[str]:
+        """Names of functions passed as arguments to `...host_entry(...)` —
+        those run exactly once per (digest, key) under the store's lock."""
+        names: set[str] = set()
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Call) \
+                    and _callee_name(node.func) == "host_entry":
+                names.update(a.id for a in node.args
+                             if isinstance(a, ast.Name))
+        return names
+
+    @staticmethod
+    def _enclosing_defs(src: SourceFile, node: ast.AST) -> list[str]:
+        out: list[str] = []
+        cur = src.parent(node)
+        while cur is not None:
+            if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                out.append(cur.name)
+            cur = src.parent(cur)
+        return out
+
+    @staticmethod
+    def _pk_hint(node: ast.expr) -> str | None:
+        for sub in ast.walk(node):
+            name = None
+            if isinstance(sub, ast.Name):
+                name = sub.id
+            elif isinstance(sub, ast.Attribute):
+                name = sub.attr
+            if name and any(h in name.lower() for h in _PK_HINTS):
+                return name
+        return None

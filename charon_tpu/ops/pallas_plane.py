@@ -909,6 +909,12 @@ class PlanePoint:
     def coords(self):
         return self.X, self.Y, self.Z
 
+    @property
+    def nbytes(self) -> int:
+        """Device bytes of the three coordinate planes (PlaneStore
+        residency accounting; jnp and np arrays both expose nbytes)."""
+        return int(sum(getattr(c, "nbytes", 0) for c in self.coords()))
+
 
 def pt_double(p: PlanePoint) -> PlanePoint:
     X, Y, Z = _double_call(p.X, p.Y, p.Z, p.E)

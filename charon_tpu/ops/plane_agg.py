@@ -35,6 +35,8 @@ from __future__ import annotations
 
 import ctypes
 import functools
+import threading
+from collections import deque
 
 import jax
 import jax.numpy as jnp
@@ -786,6 +788,66 @@ def _fused_finish(state, hash_fn=None):
     return out, _pairing_finish(S, pts, hash_fn)
 
 
+class SigAggPipeline:
+    """Double-buffered fused-sigagg dispatcher over the
+    _fused_dispatch/_fused_finish split.
+
+    The serial loop pays pack → dispatch → WAIT per slot, leaving the host
+    idle while the device runs and the device idle while the host packs.
+    Here slot N+1's message/signature buffers are packed and transferred
+    while slot N's fused aggregate+verify graph executes on device — jax
+    dispatch is async, so the only blocking point is the readback
+    (jax.device_get inside _fused_finish, the jax.block_until_ready
+    equivalent for this path). Two usage shapes:
+
+      * submit()/drain() — an explicit FIFO of at most `depth` in-flight
+        slots for single-threaded consumers (bench.py's steady-state loop).
+      * aggregate_verify() — dispatch-then-block for THIS slot, with only
+        the host pack+dispatch under the pipeline lock: a concurrent
+        caller (the coalescer's executor threads) packs its slot while
+        this one's graph runs, which is the overlap the serial
+        tbls.threshold_aggregate_verify_batch call cannot express.
+    """
+
+    def __init__(self, depth: int = 2):
+        # depth 2 = classic double buffering: one slot executing, one
+        # packing; deeper queues only add readback latency
+        self._depth = max(1, depth)
+        self._lock = threading.Lock()
+        self._pending: deque = deque()
+
+    def submit(self, batches, pks, msgs, hash_fn=None) -> list:
+        """Pack + async-dispatch one slot. Returns the results of any slots
+        completed to keep at most `depth` in flight (oldest first); pair
+        with drain() for the tail."""
+        with self._lock:
+            state = _fused_dispatch(_layout_slots(batches), pks, msgs)
+            self._pending.append((state, hash_fn))
+            over = (self._pending.popleft()
+                    if len(self._pending) > self._depth else None)
+        # readback OUTSIDE the lock: a concurrent submit packs meanwhile
+        return [_fused_finish(*over)] if over is not None else []
+
+    def drain(self) -> list:
+        """Finish every in-flight slot, oldest first."""
+        out = []
+        while True:
+            with self._lock:
+                if not self._pending:
+                    return out
+                state, hash_fn = self._pending.popleft()
+            out.append(_fused_finish(state, hash_fn))
+
+    def aggregate_verify(self, batches, pks, msgs, hash_fn=None):
+        """Dispatch this slot and block for ITS result (the tbls
+        threshold_aggregate_verify shape). Only the pack+dispatch holds
+        the lock; the readback runs outside it, so concurrent callers
+        overlap their host pack with this slot's device execution."""
+        with self._lock:
+            state = _fused_dispatch(_layout_slots(batches), pks, msgs)
+        return _fused_finish(state, hash_fn)
+
+
 @jax.jit
 def _g2_affine_std_jit(X, Y, Z):
     """Jacobian G2 plane -> affine standard-form coordinate planes + sign
@@ -970,14 +1032,9 @@ def _g2_jacs_to_bytes(jacs: list) -> list[bytes]:
 # ---------------------------------------------------------------------------
 
 
-_PK_PLANE_CACHE: dict[tuple, PP.PlanePoint] = {}
-# sized to cover num_peers share-pubkey sets (parsigex, one per peer) plus
-# the sigagg root-pubkey set for the largest supported cluster (10 peers)
-_PK_PLANE_CACHE_MAX = 12
-
-
 def _pk_plane_cached(pks: list[bytes], Bp: int) -> PP.PlanePoint:
-    """Load + subgroup-check the pubkey plane, memoized by content digest.
+    """Load + subgroup-check the pubkey plane through the device-resident
+    PlaneStore (ops/plane_store.py), memoized by full-set content digest.
 
     A charon cluster's validator set is static between reconfigurations
     (the share⇄root maps are built once from the cluster lock, reference
@@ -985,23 +1042,9 @@ def _pk_plane_cached(pks: list[bytes], Bp: int) -> PP.PlanePoint:
     decompressing and subgroup-checking them once per process, not once
     per slot, is the steady-state behavior. Raises ValueError like the
     plane loaders on any invalid/out-of-subgroup pubkey."""
-    import hashlib
+    from . import plane_store
 
-    key = (hashlib.sha256(b"".join(bytes(p) for p in pks)).digest(), Bp)
-    plane = _PK_PLANE_CACHE.get(key)
-    if plane is None:
-        plane = g1_plane_from_compressed(pks, Bp, reject_infinity=True)
-        if not g1_subgroup_ok(plane):
-            raise ValueError("G1 pubkey not in subgroup")
-        if len(_PK_PLANE_CACHE) >= _PK_PLANE_CACHE_MAX:
-            _PK_PLANE_CACHE.pop(next(iter(_PK_PLANE_CACHE)))
-    else:
-        # true LRU: refresh on hit so a working set larger than insertion
-        # order suggests (per-peer share-pubkey lists + the sigagg root set)
-        # doesn't evict its hottest entry
-        _PK_PLANE_CACHE.pop(key)
-    _PK_PLANE_CACHE[key] = plane
-    return plane
+    return plane_store.STORE.full_plane([bytes(p) for p in pks], Bp)
 
 
 _PK_VALID_CACHE: dict[bytes, bool] = {}
@@ -1107,6 +1150,29 @@ def g1_groups_msm(points: list[bytes], scalars: list[int],
     n = len(points)
     if not (n == len(scalars) == len(groups)):
         raise ValueError("length mismatch")
+
+    if _device_path(n):
+        # TILE-sized chunked dispatches of the fused decompress + subgroup
+        # + sweep + reduces graph. The fused graph at >TILE lanes exceeds
+        # the remote compile service's budget (the same ceiling that
+        # chunked rlc_verify_dispatch), which made the FROST device gate
+        # (_DEVICE_MIN_POINTS=16384) unreachable: it only fired at shapes
+        # that could never compile. K chunks of the already-compiled
+        # ≤TILE-lane graph dispatch back-to-back — jax dispatch is async,
+        # so they pipeline on the device — and the per-group partial sums
+        # combine on the host (group masks use GLOBAL group ids, so every
+        # chunk's g-row means the same group). Nothing compiles at >TILE.
+        spans = ([(0, n)] if n <= PP.TILE else
+                 [(s, min(s + PP.TILE, n)) for s in range(0, n, PP.TILE)])
+        finishers = [_groups_msm_chunk(points, scalars, groups, n_groups,
+                                       s, e) for s, e in spans]
+        sums: list = [None] * n_groups
+        for fin in finishers:
+            for g, part in enumerate(fin()):
+                sums[g] = part if sums[g] is None else jac_add(
+                    FqOps, sums[g], part)
+        return sums
+
     Bp = _bucket(n)
     rdig = jnp.asarray(PP.scalars_to_digitplanes(scalars, Bp,
                                                  nbits=RLC_BITS))
@@ -1114,22 +1180,6 @@ def g1_groups_msm(points: list[bytes], scalars: list[int],
     gmask = np.zeros((n_groups, PP.SUB, W), bool)
     for i, g in enumerate(groups):
         gmask[g, i // W, i % W] = True
-
-    if _device_path(n):
-        # ONE fused dispatch: decompress + subgroup + sweep + reduces.
-        # Parse rejects infinity commitments up front (an ∞ commitment is
-        # a degenerate dealer polynomial; the reference's per-item check
-        # fails it too since kryptology rejects identity points).
-        body, _fin, sgn, loaded = _parse_compressed(
-            [bytes(p) for p in points], 48, "G1", True, Bp)
-        reds, ok, sub_ok = _g1_decode_groups_sweep_jit(
-            jnp.asarray(_raw_to_plane(body, Bp)), jnp.asarray(sgn),
-            jnp.asarray(loaded), rdig, jnp.asarray(gmask), G=n_groups)
-        if not bool(ok):
-            raise ValueError("invalid G1 point encoding")
-        if not bool(sub_ok):
-            raise ValueError("G1 point not in subgroup")
-        return [PP._host_fold(*red, 1) for red in reds]
 
     # off-device: native bulk decode + (interpret-mode) sweep.
     # reject_infinity matches the device branch above: an ∞ commitment is
@@ -1143,6 +1193,41 @@ def g1_groups_msm(points: list[bytes], scalars: list[int],
     if not bool(sub_ok):  # checked inside the same dispatch as the sweep
         raise ValueError("G1 point not in subgroup")
     return [PP._host_fold(*red, 1) for red in reds]
+
+
+def _groups_msm_chunk(points, scalars, groups, n_groups: int,
+                      s: int, e: int):
+    """Parse + ASYNC-dispatch one ≤TILE-lane chunk [s:e) of the fused
+    groups-MSM graph; returns a finisher that blocks on the chunk and
+    yields its n_groups host partial Jacobians (groups absent from the
+    chunk fold to infinity, which jac_add absorbs). Split out as the chunk
+    seam so tests can stub it with a host oracle — the fused graph itself
+    only compiles at device/nightly shapes. Parse rejects infinity
+    commitments up front (an ∞ commitment is a degenerate dealer
+    polynomial; the reference's per-item check fails it too since
+    kryptology rejects identity points)."""
+    nc = e - s
+    Bc = _bucket(nc)
+    rdig = jnp.asarray(PP.scalars_to_digitplanes(scalars[s:e], Bc,
+                                                 nbits=RLC_BITS))
+    W = Bc // PP.SUB
+    gmask = np.zeros((n_groups, PP.SUB, W), bool)
+    for i, g in enumerate(groups[s:e]):
+        gmask[g, i // W, i % W] = True
+    body, _fin, sgn, loaded = _parse_compressed(
+        [bytes(p) for p in points[s:e]], 48, "G1", True, Bc)
+    reds, ok, sub_ok = _g1_decode_groups_sweep_jit(
+        jnp.asarray(_raw_to_plane(body, Bc)), jnp.asarray(sgn),
+        jnp.asarray(loaded), rdig, jnp.asarray(gmask), G=n_groups)
+
+    def finish():
+        if not bool(ok):
+            raise ValueError("invalid G1 point encoding")
+        if not bool(sub_ok):
+            raise ValueError("G1 point not in subgroup")
+        return [PP._host_fold(*red, 1) for red in reds]
+
+    return finish
 
 
 def g1_lincomb_is_infinity(points: list[bytes], scalars: list[int]) -> bool:
@@ -1234,12 +1319,20 @@ def rlc_verify_dispatch(pks, msgs, sigs):
     _gidx, G, group_msgs = index
     pending = []
     try:
-        for s, e in chunks:
+        # every chunk's plane is keyed on the FULL-set digest + span in the
+        # PlaneStore — a fixed peer set decodes once per process, not once
+        # per slot (the old per-chunk `pks[s:e]` content keys churned the
+        # whole-set-sized LRU every slot, ADVICE round 5)
+        from . import plane_store
+
+        pk_planes = plane_store.STORE.chunk_planes(
+            [bytes(p) for p in pks], chunks)
+        for ci, (s, e) in enumerate(chunks):
             nc = e - s
             Bc = _bucket(nc)
             body, _fin, sgn, loaded = _parse_compressed(
                 sigs[s:e], 96, "G2", True, Bc)
-            pk_plane = _pk_plane_cached([bytes(p) for p in pks[s:e]], Bc)
+            pk_plane = pk_planes[ci]
             X0r = jnp.asarray(_raw_to_plane(body[:, 48:], Bc))
             X1r = jnp.asarray(_raw_to_plane(body[:, :48], Bc))
             rs = [sample_randomizer() for _ in range(nc)]

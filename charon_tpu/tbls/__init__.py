@@ -36,6 +36,9 @@ __all__ = [
     "recover_secret",
     "threshold_aggregate",
     "threshold_aggregate_batch",
+    "threshold_aggregate_verify_batch",
+    "threshold_aggregate_verify_overlapped",
+    "pin_pubkeys",
     "sign",
     "verify",
     "verify_batch",
@@ -151,6 +154,31 @@ def threshold_aggregate_verify_batch(
         datas: list[bytes]) -> tuple[list[Signature], bool]:
     return get_implementation().threshold_aggregate_verify_batch(
         batches, public_keys, datas)
+
+
+def threshold_aggregate_verify_overlapped(
+        batches: list[dict[int, Signature]], public_keys: list[PublicKey],
+        datas: list[bytes]) -> tuple[list[Signature], bool]:
+    """threshold_aggregate_verify_batch through the backend's overlapped
+    dispatch pipeline when it has one (the TPU backend double-buffers:
+    slot N+1's host pack overlaps slot N's device execution); identical
+    semantics otherwise. Same trust precondition as the serial call."""
+    impl = get_implementation()
+    fn = getattr(impl, "threshold_aggregate_verify_overlapped", None)
+    if fn is None:  # backend predates the pipeline seam: serial call
+        return impl.threshold_aggregate_verify_batch(
+            batches, public_keys, datas)
+    return fn(batches, public_keys, datas)
+
+
+def pin_pubkeys(public_keys: list[PublicKey]) -> None:
+    """Declare a pubkey set long-lived (the cluster's own share/root sets,
+    fixed at DKG time): backends with device-resident pk caches pin its
+    decoded planes against eviction; CPU backends no-op."""
+    impl = get_implementation()
+    fn = getattr(impl, "pin_pubkeys", None)
+    if fn is not None:
+        fn(public_keys)
 
 
 def aggregate(sigs: list[Signature]) -> Signature:

@@ -160,6 +160,21 @@ class NativeImpl(FrScalarOps):
         sigs = self.threshold_aggregate_batch(batches)
         return sigs, self.verify_batch(public_keys, datas, sigs)
 
+    def threshold_aggregate_verify_overlapped(self, batches, public_keys,
+                                              datas):
+        """Overlapped-dispatch variant: the CPU path has no async device
+        queue to overlap with, so it IS the serial call. The TPU backend
+        overrides this with the double-buffered pipeline
+        (plane_agg.SigAggPipeline)."""
+        return self.threshold_aggregate_verify_batch(
+            batches, public_keys, datas)
+
+    def pin_pubkeys(self, public_keys) -> None:
+        """Mark a pubkey set as long-lived (the cluster's own share/root
+        sets). CPU backends keep no device-resident planes — no-op seam;
+        the TPU backend pins the set in the PlaneStore."""
+        return None
+
     # -- signing / verification ------------------------------------------------
 
     def sign(self, private_key: PrivateKey, data: bytes) -> Signature:

@@ -34,6 +34,8 @@ backends behind tbls.SetImplementation + app/featureset
 
 from __future__ import annotations
 
+import threading
+
 import numpy as np
 
 from .native_impl import NativeImpl
@@ -68,6 +70,22 @@ def _on_device() -> bool:
     import jax
 
     return jax.default_backend() != "cpu"
+
+
+_PIPELINE = None
+_PIPELINE_LOCK = threading.Lock()
+
+
+def _shared_pipeline():
+    """Process-wide SigAggPipeline: one device, one dispatch queue — every
+    TPUImpl instance overlaps through the same double buffer."""
+    global _PIPELINE
+    with _PIPELINE_LOCK:
+        if _PIPELINE is None:
+            from ..ops import plane_agg
+
+            _PIPELINE = plane_agg.SigAggPipeline()
+        return _PIPELINE
 
 
 class TPUImpl(NativeImpl):
@@ -159,6 +177,45 @@ class TPUImpl(NativeImpl):
             return NativeImpl.threshold_aggregate_verify_batch(
                 self, batches, public_keys, datas)
         return [Signature(r) for r in raw], ok
+
+    def threshold_aggregate_verify_overlapped(self, batches, public_keys,
+                                              datas):
+        """Double-buffered fused sigagg: identical inputs/outputs to
+        threshold_aggregate_verify_batch, but the slot dispatches through
+        the process-wide SigAggPipeline, whose lock covers only the host
+        pack+dispatch — a CONCURRENT call (the coalescer's executor
+        threads on back-to-back flushes) packs its buffers while this
+        slot's fused graph executes on device, instead of serializing
+        pack→dispatch→wait end to end."""
+        n = len(batches)
+        if not (n == len(public_keys) == len(datas)):
+            raise ValueError("length mismatch")
+        if n < self.min_device_batch or not _on_device():
+            return NativeImpl.threshold_aggregate_verify_batch(
+                self, batches, public_keys, datas)
+        for b in batches:
+            if not b:
+                raise ValueError("no partial signatures to aggregate")
+        try:
+            raw, ok = _shared_pipeline().aggregate_verify(
+                [{i: bytes(s) for i, s in b.items()} for b in batches],
+                [bytes(pk) for pk in public_keys], [bytes(d) for d in datas])
+        except _DEVICE_RUNTIME_ERRORS as exc:
+            if not self.fallback_on_device_error:
+                raise
+            _warn_device_fallback("threshold_aggregate_verify_overlapped",
+                                  exc)
+            return NativeImpl.threshold_aggregate_verify_batch(
+                self, batches, public_keys, datas)
+        return [Signature(r) for r in raw], ok
+
+    def pin_pubkeys(self, public_keys) -> None:
+        """Pin the set's decoded planes in the device PlaneStore so cache
+        pressure from transient sets can never evict the cluster's own
+        share/root pubkeys (core/sigagg pins at construction)."""
+        from ..ops import plane_store
+
+        plane_store.STORE.pin([bytes(pk) for pk in public_keys])
 
     def verify_batch_each(self, public_keys: list[PublicKey],
                           datas: list[bytes],

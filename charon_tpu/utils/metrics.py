@@ -153,6 +153,25 @@ class Registry:
         with self._lock:
             return dict(self._metrics)
 
+    def snapshot(self, prefix: str = "") -> dict[str, float]:
+        """Flat {name or name{label="v",...}: value} view of every counter
+        and gauge whose name starts with `prefix` — the programmatic hook
+        bench.py and the PlaneStore diagnostics read (histograms expose
+        via expose_text; their bucket vectors don't flatten to one value)."""
+        out: dict[str, float] = {}
+        for m in self.gather().values():
+            if not m.name.startswith(prefix) or isinstance(m, Histogram):
+                continue
+            with m._lock:
+                children = dict(m._children)
+            if not children and not m.label_names:
+                children = {(): 0.0}
+            for key, value in children.items():
+                lbl = ",".join(f'{n}="{v}"'
+                               for n, v in zip(m.label_names, key))
+                out[f"{m.name}{{{lbl}}}" if lbl else m.name] = value
+        return out
+
     def expose_text(self) -> str:
         """Prometheus text exposition format."""
         const_parts = [f'{k}="{v}"' for k, v in sorted(self.const_labels.items())]

@@ -30,8 +30,13 @@ REPO = Path(__file__).resolve().parents[1]
 # interpret-mode graphs plus two sharded executions, not XLA — disabling
 # XLA optimization made it WORSE, >19 min). The cap guards against
 # regression from this floor; the driver's margin comes from the warm
-# machine-keyed persistent cache it shares with this filesystem.
-BUDGET_S = 650
+# machine-keyed persistent cache it shares with this filesystem. 650 s
+# was ~1.2x the measured floor — thin enough that ordinary host jitter
+# (a concurrent tier-1 run, cold page cache) produced spurious rc=124s.
+# Hold ~1.4-1.5x instead: still inside the driver's kill window, and a
+# genuine graph addition (the +352 s class of regression this test
+# exists to catch) still blows through it unambiguously.
+BUDGET_S = 780
 
 
 @pytest.mark.scale

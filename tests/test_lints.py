@@ -238,6 +238,59 @@ def test_tpu_rule_ignores_files_outside_scope(tmp_path):
 
 
 # ---------------------------------------------------------------------------
+# LINT-TPU-005 — pubkey planes route through the PlaneStore
+# ---------------------------------------------------------------------------
+
+
+def test_planestore_rule_flags_direct_pk_decode(tmp_path):
+    findings = lint_source(tmp_path, "ops/x.py", """\
+        from . import plane_agg
+
+        def verify(pks, Bp):
+            return plane_agg.g1_plane_from_compressed(
+                [bytes(p) for p in pks], Bp)
+
+        def verify2(pubkeys, Bc):
+            return _parse_compressed(pubkeys, 48, "G1", True, Bc)
+    """)
+    assert rules_of(findings) == ["LINT-TPU-005", "LINT-TPU-005"]
+    assert "pks" in findings[0].message
+    assert "plane_store.STORE" in findings[0].message
+
+
+def test_planestore_rule_accepts_sanctioned_paths(tmp_path):
+    findings = lint_source(tmp_path, "ops/x.py", """\
+        def chunk(points, Bp):
+            # non-pubkey plane loads (sig planes, FROST commitments) are
+            # per-batch data, not cacheable sets
+            return g1_plane_from_compressed([bytes(p) for p in points], Bp)
+
+        def _parse_pk_chunks(pks):
+            return _parse_compressed([bytes(p) for p in pks], 48, "G1",
+                                     False, 64)
+
+        def outer(pks):
+            from . import plane_store
+            return plane_store.STORE.host_entry(
+                pks, ("sharded",), _parse_pk_chunks)
+
+        def _g1_plane_device(pks, Bp, reject_infinity):
+            # the decode layer the store itself dispatches through
+            return _parse_compressed(pks, 48, "G1", reject_infinity, Bp)
+    """)
+    assert findings == []
+
+
+def test_planestore_rule_exempts_the_store_and_other_dirs(tmp_path):
+    src = """\
+        def load(pks, Bp):
+            return g1_plane_from_compressed(pks, Bp)
+    """
+    assert lint_source(tmp_path, "ops/plane_store.py", src) == []
+    assert lint_source(tmp_path, "core/x.py", src) == []
+
+
+# ---------------------------------------------------------------------------
 # LINT-IFACE-004 — protocol implementation claims
 # ---------------------------------------------------------------------------
 

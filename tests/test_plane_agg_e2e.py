@@ -34,6 +34,7 @@ from charon_tpu.crypto import fields as PF
 from charon_tpu.crypto.serialize import g2_affine_to_bytes
 from charon_tpu.ops import pallas_plane as PP
 from charon_tpu.ops import plane_agg
+from charon_tpu.ops import plane_store
 from charon_tpu.tbls.native_impl import NativeImpl, NativeUnavailable
 
 try:
@@ -170,7 +171,7 @@ def test_fused_aggregate_verify_device_pipeline(monkeypatch):
     hosts without a TPU; see module docstring for why nightly)."""
     monkeypatch.setattr(PP, "TILE", 64)
     monkeypatch.setattr(plane_agg, "_device_path", lambda n=0: True)
-    monkeypatch.setattr(plane_agg, "_PK_PLANE_CACHE", {})
+    monkeypatch.setattr(plane_store, "STORE", plane_store.PlaneStore())
     run_pipeline_drive()
 
 

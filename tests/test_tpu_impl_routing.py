@@ -153,12 +153,12 @@ def test_bulk_uncompress_roundtrip_and_subgroup_flag():
 
 def test_pk_plane_cache_is_lru(monkeypatch):
     """A hot pubkey set refreshed on every hit must survive more distinct
-    working-set keys than the cache holds (parsigex per-peer share sets +
-    the sigagg root set) — insertion-order eviction would drop it."""
-    from charon_tpu.ops import plane_agg
+    working-set keys than the PlaneStore holds (parsigex per-peer share sets
+    + the sigagg root set) — insertion-order eviction would drop it."""
+    from charon_tpu.ops import plane_agg, plane_store
 
-    monkeypatch.setattr(plane_agg, "_PK_PLANE_CACHE", {})
-    monkeypatch.setattr(plane_agg, "_PK_PLANE_CACHE_MAX", 3)
+    monkeypatch.setattr(plane_store, "STORE",
+                        plane_store.PlaneStore(max_entries=3))
     loads = []
     monkeypatch.setattr(plane_agg, "g1_plane_from_compressed",
                         lambda pks, Bp, **kw: loads.append(bytes(pks[0])) or object())

@@ -227,7 +227,10 @@ class TestProxyEdges:
         _run(run())
 
 
-class TestErrorMapping:
+class TestBadRequestMapping:
+    # NB: this class was named TestErrorMapping, same as the one further
+    # down — the later definition shadowed it at module scope, so pytest
+    # never collected these tests
     def test_bad_request_is_beacon_api_error(self):
         async def run():
             sim = new_simnet(num_validators=1, threshold=2, num_nodes=3,
@@ -269,6 +272,46 @@ class TestErrorMapping:
                     with pytest.raises(VapiHTTPError) as exc_info:
                         await client.raw(method, path, json_body=body)
                     assert exc_info.value.status == 400, path
+            finally:
+                await client.close()
+                await router.stop()
+
+        _run(run())
+
+    def test_validators_filter_body_shape_is_enforced(self):
+        """POST /states/{id}/validators: a JSON `null` body (or no body at
+        all) means "no filter" and returns the whole cluster; any other
+        non-object body or a non-array "ids" used to be silently ignored
+        (`[]`/`0`/`false` returned the whole cluster, a string "ids"
+        iterated character-by-character into garbage lookups) — all of
+        those are 400s now (_ids_filter)."""
+
+        async def run():
+            import aiohttp
+
+            sim = new_simnet(num_validators=1, threshold=2, num_nodes=3,
+                             use_vmock=False, genesis_delay=30.0)
+            router = VapiRouter(sim.nodes[0].vapi)
+            await router.start()
+            client = HTTPValidatorClient(router.base_url)
+            path = "/eth/v1/beacon/states/head/validators"
+            try:
+                for bad in ([], 0, False, "0xabcd",
+                            {"ids": "0xabcd"}, {"ids": 7}):
+                    with pytest.raises(VapiHTTPError) as exc_info:
+                        await client.raw("POST", path, json_body=bad)
+                    assert exc_info.value.status == 400, repr(bad)
+
+                whole = await client.raw("GET", path)
+                assert len(whole["data"]) == 1
+                # a literal JSON null body is the spec'd "no filter"
+                async with aiohttp.ClientSession() as sess:
+                    async with sess.post(
+                            router.base_url + path, data=b"null",
+                            headers={"Content-Type": "application/json"},
+                    ) as resp:
+                        assert resp.status == 200
+                        assert await resp.json() == whole
             finally:
                 await client.close()
                 await router.stop()
