@@ -85,6 +85,31 @@ def _log_micro(t_slot: float, times: list[float], cpu_throughput:
           f"({rec['val_per_s']} val/s) @ {commit}", file=sys.stderr)
 
 
+def _flight_recorder_dump(trace_path: str = "bench-trace.json") -> None:
+    """Emit the run's flight-recorder artifacts: ONE Chrome-trace file of
+    every span the run produced (loadable in Perfetto / chrome://tracing)
+    and per-step p50/p99 read straight from the SAME production registry
+    histograms /metrics serves — no bench-local timing paths."""
+    from charon_tpu.utils import metrics
+    from charon_tpu.utils import tracer as tracer_mod
+
+    try:
+        path = tracer_mod.write_chrome_trace(trace_path)
+        print(f"# trace: {path} ({len(tracer_mod.finished_spans())} spans; "
+              "load in Perfetto or chrome://tracing)", file=sys.stderr)
+    except OSError as exc:
+        print(f"# trace write failed: {exc}", file=sys.stderr)
+    wanted = ("core_step_latency_seconds", "ops_device_dispatch_seconds",
+              "core_duty_e2e_latency_seconds",
+              "core_sigagg_duration_seconds")
+    for name, stats in sorted(metrics.snapshot_quantiles().items()):
+        if not name.startswith(wanted) or not stats["count"]:
+            continue
+        print(f"# latency {name}: p50 {stats['p50'] * 1e3:.1f}ms "
+              f"p99 {stats['p99'] * 1e3:.1f}ms n={stats['count']:.0f}",
+              file=sys.stderr)
+
+
 def _gen_cluster(native):
     """The FIXED probe inputs (seed 99, 1000×4-of-6): shared by the
     official bench and the --micro probe so MICROBENCH.jsonl records stay
@@ -215,6 +240,8 @@ def _measure(cpu_only: bool) -> None:
           f"(timed-slot decompress delta {dd})", file=sys.stderr)
     assert dd == 0, \
         "warm-cache steady state re-paid a pk decompress dispatch"
+
+    _flight_recorder_dump()
 
     device_throughput = N_VALIDATORS / min(t_pipe, t_slot)
     print(json.dumps({

@@ -158,12 +158,31 @@ def main() -> None:
     assert rc == 1, "verification failed"
 
     from charon_tpu.ops.plane_store import STORE
+    from charon_tpu.utils import metrics, tracer
+
+    # Flight-recorder artifacts: one Chrome-trace file per run plus the
+    # production registry's latency quantiles (same histograms /metrics
+    # serves — no bench-local timing paths).
+    trace_path = tracer.write_chrome_trace("bench-stages-trace.json")
+    print(f"# trace: {trace_path} ({len(tracer.finished_spans())} spans)",
+          file=sys.stderr)
+    quantiles = {
+        name: {k: round(v, 4) for k, v in stats.items()}
+        for name, stats in metrics.snapshot_quantiles().items()
+        if name.startswith(("ops_device_dispatch_seconds",
+                            "core_step_latency_seconds")) and stats["count"]}
+    for name, stats in sorted(quantiles.items()):
+        print(f"# latency {name}: p50 {stats['p50'] * 1e3:.1f}ms "
+              f"p99 {stats['p99'] * 1e3:.1f}ms n={stats['count']:.0f}",
+              file=sys.stderr)
 
     print(json.dumps({
         "stages": {k: round(v, 3) for k, v in stages.items()},
         # hit/miss/decompress counters show whether ver.pk_plane_cached
         # above was a PlaneStore hit (steady state) or paid a decode
         "planestore": STORE.stats(),
+        "latency_quantiles": quantiles,
+        "trace_file": trace_path,
         "throughput": round(N / (stages["agg.total"] + stages["ver.total"]),
                             1)}))
 

@@ -381,7 +381,7 @@ async def assemble(config: Config) -> App:
     quorum = keys.threshold
     monitoring = MonitoringAPI(config.monitoring_host, config.monitoring_port,
                                ping_service=ping, beacon=beacon, quorum=quorum,
-                               sniffer=consensus.sniffer)
+                               sniffer=consensus.sniffer, tracker=track)
     health = Checker(quorum_peers=quorum)
 
     app = App(config=config, node=node, sched=sched, vapi=vapi,

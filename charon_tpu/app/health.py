@@ -37,12 +37,14 @@ class MetricWindow:
     def __init__(self, max_scrapes: int = 60) -> None:
         from collections import deque
 
-        # (counters, gauges) snapshots, oldest first
-        self._snaps: "deque[tuple[dict, dict]]" = deque(maxlen=max(2, max_scrapes))
+        # (counters, gauges, histogram quantiles) snapshots, oldest first
+        self._snaps: "deque[tuple[dict, dict, dict]]" = deque(
+            maxlen=max(2, max_scrapes))
 
     def scrape(self) -> None:
         counters: dict[tuple, float] = {}
         gauges: dict[tuple, float] = {}
+        hists: dict[tuple, dict[str, float]] = {}
         for m in metrics.default_registry.gather().values():
             if isinstance(m, metrics.Counter):
                 with m._lock:
@@ -52,12 +54,36 @@ class MetricWindow:
                 with m._lock:
                     for key, val in m._children.items():
                         gauges[(m.name, key)] = val
-        self._snaps.append((counters, gauges))
+            elif isinstance(m, metrics.Histogram):
+                with m._lock:
+                    keys = [(k, sum(c)) for k, c in m._counts.items()]
+                for key, count in keys:
+                    # quantile() re-acquires the metric lock, so outside it
+                    hists[(m.name, key)] = {
+                        "count": float(count),
+                        "p50": m.quantile(0.5, *key),
+                        "p99": m.quantile(0.99, *key),
+                    }
+        self._snaps.append((counters, gauges, hists))
 
     @property
     def gauges(self) -> dict[tuple, float]:
         """Latest gauge snapshot (gauges are point-in-time state)."""
         return self._snaps[-1][1] if self._snaps else {}
+
+    @property
+    def hists(self) -> dict[tuple, dict[str, float]]:
+        """Latest histogram-quantile snapshot ({(name, labels): {p50, p99,
+        count}}) — latency rules read point-in-time percentiles."""
+        return self._snaps[-1][2] if self._snaps else {}
+
+    def histogram_quantile(self, name: str, *label_filter: str,
+                           stat: str = "p99") -> float:
+        """Worst (max) quantile across the latest snapshot's series matching
+        `name` + label values; 0.0 when the histogram has no observations."""
+        vals = [h[stat] for (mname, key), h in self.hists.items()
+                if mname == name and all(lbl in key for lbl in label_filter)]
+        return max(vals) if vals else 0.0
 
     def counter_delta(self, name: str, *label_filter: str) -> float:
         """Counter increase over the buffered window. A series appearing
@@ -78,10 +104,24 @@ class MetricWindow:
         return [v for (mname, _k), v in self.gauges.items() if mname == name]
 
 
-def default_checks(quorum_peers: int) -> list[Check]:
+def default_checks(quorum_peers: int,
+                   slot_seconds: float = 12.0) -> list[Check]:
     """The reference's check set (checks.go): error rate, insufficient peers,
-    BN syncing, failed duties."""
+    BN syncing, failed duties — plus the flight-recorder latency rules fed
+    by the pipeline histograms (docs/observability.md): sigagg eating more
+    than a third of the slot, or whole duties overrunning the slot time,
+    both read as p99 of the same histograms /metrics serves."""
+    sigagg_budget = slot_seconds / 3
     return [
+        Check("sigagg_latency_high",
+              f"sigagg step p99 above {sigagg_budget:.1f}s "
+              "(a third of slot time)",
+              lambda w: w.histogram_quantile(
+                  "core_step_latency_seconds", "sigagg") > sigagg_budget),
+        Check("duty_e2e_overrun",
+              f"duty end-to-end p99 above the {slot_seconds:.0f}s slot time",
+              lambda w: w.histogram_quantile(
+                  "core_duty_e2e_latency_seconds") > slot_seconds),
         Check("high_error_log_rate", "more than 5 error logs in the window",
               lambda w: w.counter_delta("log_messages_total", "error") > 5),
         Check("high_warning_log_rate", "more than 10 warning logs in the window",

@@ -6,6 +6,12 @@
                 recent validatorapi traffic (reference monitoringapi.go:107)
   /debug/qbft   sniffed consensus instances as JSON (reference
                 app/qbftdebug.go:22 serves them gzipped)
+  /debug/traces recent finished spans as JSON; ?fmt=chrome downloads the
+                buffer as a Chrome-trace file loadable in Perfetto /
+                chrome://tracing (docs/observability.md)
+  /debug/duty/{slot}/{type}
+                one duty's flight: the span-assembled latency timeline plus
+                the tracker's verdict for that duty, if analysed
 """
 
 from __future__ import annotations
@@ -15,7 +21,8 @@ import time
 
 from aiohttp import web
 
-from ..utils import log, metrics
+from ..core import tracker as tracker_mod
+from ..utils import log, metrics, tracer
 
 _log = log.with_topic("monitoring")
 
@@ -25,11 +32,13 @@ READY_OK = "ok"
 class MonitoringAPI:
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
                  ping_service=None, beacon=None, quorum: int = 0,
-                 sniffer=None, vapi_activity_window: float = 0.0):
+                 sniffer=None, vapi_activity_window: float = 0.0,
+                 tracker=None):
         self._ping = ping_service
         self._beacon = beacon
         self._quorum = quorum
         self._sniffer = sniffer
+        self._tracker = tracker
         self._vapi_window = vapi_activity_window
         self._vapi_last_seen = 0.0
         self.host = host
@@ -40,6 +49,8 @@ class MonitoringAPI:
         app.router.add_get("/livez", self._livez)
         app.router.add_get("/readyz", self._readyz)
         app.router.add_get("/debug/qbft", self._qbft)
+        app.router.add_get("/debug/traces", self._traces)
+        app.router.add_get("/debug/duty/{slot}/{type}", self._duty)
         self._app = app
 
     def note_vapi_activity(self) -> None:
@@ -105,3 +116,63 @@ class MonitoringAPI:
         return web.Response(body=payload,
                             content_type="application/json",
                             headers={"Content-Encoding": "gzip"})
+
+    async def _traces(self, request: web.Request) -> web.Response:
+        """The flight-recorder buffer. Default: recent spans as plain JSON
+        (newest last, ?limit=N caps the count). ?fmt=chrome: the whole
+        buffer rendered as a downloadable Chrome-trace file that loads in
+        Perfetto / chrome://tracing."""
+        spans = tracer.finished_spans()
+        fmt = request.query.get("fmt", "json")
+        if fmt == "chrome":
+            body = json.dumps(tracer.to_chrome_trace(spans))
+            return web.Response(
+                text=body, content_type="application/json",
+                headers={"Content-Disposition":
+                         'attachment; filename="charon-trace.json"'})
+        try:
+            limit = int(request.query.get("limit", 1000))
+        except ValueError:
+            return web.Response(status=400, text="limit must be an integer")
+        out = [{
+            "trace_id": s.trace_id,
+            "span_id": s.span_id,
+            "parent_id": s.parent_id,
+            "name": s.name,
+            "start": s.start,
+            "end": s.end,
+            "attrs": {k: str(v) for k, v in s.attrs.items()},
+            "events": [{"name": ev.name, "ts": ev.ts} for ev in s.events],
+        } for s in spans[-limit:]]
+        return web.json_response({"spans": out, "total_buffered": len(spans)})
+
+    async def _duty(self, request: web.Request) -> web.Response:
+        """One duty's assembled latency timeline + the tracker's verdict.
+        {type} accepts the DutyType value string ("attester", "proposer",
+        ...); the timeline exists as soon as any step spanned the duty, the
+        verdict only after the tracker analysed it at its deadline."""
+        try:
+            slot = int(request.match_info["slot"])
+        except ValueError:
+            return web.Response(status=400, text="slot must be an integer")
+        duty_type = request.match_info["type"]
+        timeline = tracker_mod.duty_timeline(slot, duty_type)
+        verdict = None
+        if self._tracker is not None:
+            for r in reversed(self._tracker.reports):
+                if r.duty.slot == slot and str(r.duty.type) == duty_type:
+                    verdict = {
+                        "success": r.success,
+                        "failed_step": r.failed_step,
+                        "reason": r.reason,
+                        "reason_code": r.reason_code,
+                        "participation": sorted(r.participation),
+                    }
+                    break
+        return web.json_response({
+            "slot": slot,
+            "type": duty_type,
+            "trace_id": tracer.duty_trace_id(slot, duty_type),
+            "timeline": timeline,
+            "verdict": verdict,
+        })

@@ -10,7 +10,7 @@ import time
 
 from ..eth2.beacon import BeaconNode
 from ..eth2.spec import ChainSpec, SignedBeaconBlock
-from ..utils import errors, log, metrics
+from ..utils import errors, log, metrics, tracer
 from .signeddata import (
     SignedAggregateAndProof,
     SignedAttestation,
@@ -128,9 +128,15 @@ class Recaster:
         """sigagg/bcast subscriber: remember registrations as they flow."""
         if duty.type != DutyType.BUILDER_REGISTRATION:
             return
-        for pk, d in signed.items():
-            if isinstance(d, SignedRegistration):
-                self._regs[pk] = _to_spec_reg(d)
+        # not behind wire()'s WithTracing, so the flight recorder needs an
+        # explicit span here (LINT-OBS-006)
+        with tracer.start_span("core/bcast_recast", duty=str(duty)) as span:
+            count = 0
+            for pk, d in signed.items():
+                if isinstance(d, SignedRegistration):
+                    self._regs[pk] = _to_spec_reg(d)
+                    count += 1
+            span.attrs["registrations"] = count
 
     async def on_slot(self, slot) -> None:
         """Scheduler slot subscriber: replay at each epoch head

@@ -17,7 +17,7 @@ from __future__ import annotations
 
 from typing import Any, Awaitable, Callable, Protocol, runtime_checkable
 
-from ..utils import aio, log, retry, tracer
+from ..utils import aio, log, metrics, retry, tracer
 from .types import (
     Duty,
     DutyDefinitionSet,
@@ -29,6 +29,10 @@ from .types import (
 )
 
 _log = log.with_topic("wire")
+
+_step_latency = metrics.histogram(
+    "core_step_latency_seconds",
+    "Wall time spent inside each pipeline step's boundary call", ("step",))
 
 # Subscriber callback shapes.
 DutiesSub = Callable[[Duty, DutyDefinitionSet], Awaitable[None]]
@@ -115,7 +119,8 @@ class WithTracing(WireOption):
     def wrap(self, component, fn):
         async def traced(duty: Duty, *args):
             tracer.rooted_ctx(duty.slot, str(duty.type))
-            with tracer.start_span(f"core/{component}", duty=str(duty)):
+            with tracer.start_span(f"core/{component}", duty=str(duty)), \
+                    _step_latency.observe_time(component):
                 await fn(duty, *args)
         return traced
 

@@ -22,7 +22,7 @@ def resolve_leader(duty: Duty, num_nodes: int) -> int:
     return (duty.slot + int(duty.type)) % num_nodes
 
 
-class LeaderCast:
+class LeaderCast:  # lint: implements=Consensus
     """reference leadercast.New (leadercast.go:18)."""
 
     def __init__(self, transport, peer_idx: int, num_nodes: int):

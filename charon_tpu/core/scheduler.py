@@ -16,7 +16,7 @@ from dataclasses import dataclass
 from typing import Callable
 
 from ..eth2.beacon import BeaconNode, ValidatorCache
-from ..utils import aio, log, metrics
+from ..utils import aio, log, metrics, tracer
 from .types import (
     Duty,
     DutyDefinitionSet,
@@ -151,8 +151,15 @@ class Scheduler:
                 await asyncio.sleep(delay)
             _duty_counter.inc(str(duty.type))
             _log.debug("emitting duty", duty=str(duty), validators=len(defset))
-            for fn in self._duty_subs:
-                await self._emit_safe(fn, duty, dict(defset))
+            # The scheduler is the root of every duty trace: wire() doesn't
+            # wrap it (it has no upstream boundary), so it opens the duty's
+            # deterministic trace itself — tracker.STEPS expects a
+            # "core/scheduler" span on every flight.
+            tracer.rooted_ctx(duty.slot, str(duty.type))
+            with tracer.start_span("core/scheduler", duty=str(duty),
+                                   validators=len(defset)):
+                for fn in self._duty_subs:
+                    await self._emit_safe(fn, duty, dict(defset))
 
     async def _resolve_epoch_duties(self, epoch: int) -> None:
         """Resolve all duty definitions for an epoch from the BN

@@ -14,7 +14,7 @@ import asyncio
 from collections import defaultdict
 from dataclasses import dataclass, field
 
-from ..utils import aio, errors, log, metrics
+from ..utils import aio, errors, log, metrics, tracer
 from .deadline import Deadliner
 from .types import Duty, DutyType, ParSignedDataSet
 
@@ -47,6 +47,9 @@ _inclusion_delay_gauge = metrics.gauge(
     "core_tracker_inclusion_delay", "Blocks until attestation inclusion")
 _inclusion_missed_counter = metrics.counter(
     "core_tracker_inclusion_missed_total", "Submitted duties never included")
+_e2e_hist = metrics.histogram(
+    "core_duty_e2e_latency_seconds",
+    "End-to-end duty latency, first span start to last span end", ("type",))
 
 
 @dataclass(frozen=True)
@@ -104,6 +107,31 @@ _STEP_REASONS = {
 }
 
 
+def duty_timeline(slot: int, duty_type: str) -> list[dict]:
+    """Assemble a duty's latency timeline from its finished tracer spans
+    (the flight-recorder view /debug/duty and FailureReport serve): every
+    span sharing the duty's deterministic trace id, in start order, with
+    offsets relative to the first span."""
+    spans = tracer.spans_for_trace(tracer.duty_trace_id(slot, duty_type))
+    if not spans:
+        return []
+    t0 = min(s.start for s in spans)
+    out = []
+    for s in spans:
+        end = s.end if s.end else s.start
+        out.append({
+            "step": s.name,
+            "span_id": s.span_id,
+            "parent_id": s.parent_id,
+            "offset": s.start - t0,
+            "duration": end - s.start,
+            "attrs": {k: str(v) for k, v in s.attrs.items()},
+            "events": [{"name": ev.name, "offset": ev.ts - t0}
+                       for ev in s.events],
+        })
+    return out
+
+
 @dataclass
 class _DutyEvents:
     events: list[tuple[str, object, BaseException | None]] = field(default_factory=list)
@@ -122,6 +150,8 @@ class FailureReport:
     reason_code: str | None = None
     # share indices whose partials diverged from the cluster-majority root
     inconsistent: set[int] = field(default_factory=set)
+    # per-step latency timeline assembled from the duty's tracer spans
+    timeline: list[dict] = field(default_factory=list)
 
 
 class Tracker:
@@ -200,10 +230,14 @@ class Tracker:
         success = any(c == "bcast" and e is None for c, _d, e in rec.events)
         self._report_participation(duty, rec, success)
         inconsistent, any_divergence = self._analyse_inconsistent(duty, rec)
+        timeline = duty_timeline(duty.slot, str(duty.type))
+        if timeline:
+            e2e = max(t["offset"] + t["duration"] for t in timeline)
+            _e2e_hist.observe(e2e, str(duty.type))
         if success:
             _success_counter.inc(str(duty.type))
             return FailureReport(duty, True, participation=set(rec.share_indices),
-                                 inconsistent=inconsistent)
+                                 inconsistent=inconsistent, timeline=timeline)
         # root cause: the first step AFTER the furthest successful one; prefer
         # a recorded error at or after that step (reference reason.go mapping)
         failed_idx = min(furthest + 1, len(STEPS) - 1)
@@ -227,7 +261,7 @@ class Tracker:
                   reason=reason, reason_code=cause.code)
         return FailureReport(duty, False, failed_step, reason,
                              set(rec.share_indices), reason_code=cause.code,
-                             inconsistent=inconsistent)
+                             inconsistent=inconsistent, timeline=timeline)
 
     def _analyse_inconsistent(self, duty: Duty,
                               rec: _DutyEvents) -> tuple[set[int], bool]:

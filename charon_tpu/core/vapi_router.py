@@ -40,7 +40,7 @@ from aiohttp import ClientSession, ClientTimeout, web
 
 from ..eth2 import json_codec as jc
 from ..eth2 import spec
-from ..utils import errors, log, metrics, version
+from ..utils import errors, log, metrics, tracer, version
 from .validatorapi import Component
 
 _log = log.with_topic("vapi")
@@ -165,6 +165,7 @@ class VapiRouter:
         app.router.add_get("/teku_proposer_config", self._proposer_config)
         app.router.add_route("*", "/{tail:.*}", self._proxy)
         app.middlewares.append(_error_middleware)
+        app.middlewares.append(_tracing_middleware)
         self._app = app
 
     # -- lifecycle -----------------------------------------------------------
@@ -417,6 +418,19 @@ class VapiRouter:
         except (OSError, asyncio.TimeoutError) as exc:
             _log.warn("BN proxy failed", url=url, err=exc)
             return _err(502, f"upstream beacon node unreachable: {exc}")
+
+
+# Span per VC request, named by the matched route pattern so slot/epoch
+# params don't explode the span-name (trace thread-row) cardinality. Runs
+# inside the error middleware so error responses are spanned too.
+@web.middleware
+async def _tracing_middleware(request: web.Request, handler):
+    resource = request.match_info.route.resource
+    pattern = resource.canonical if resource is not None else request.path
+    with tracer.start_span(f"vapi{pattern}", method=request.method) as span:
+        resp = await handler(request)
+        span.attrs["status"] = resp.status
+        return resp
 
 
 # aiohttp handlers raise; convert component errors to beacon-API error JSON.

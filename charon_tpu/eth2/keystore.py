@@ -15,10 +15,13 @@ import os
 import uuid
 from pathlib import Path
 
-from cryptography.hazmat.primitives.ciphers import Cipher, algorithms, modes
+try:
+    from cryptography.hazmat.primitives.ciphers import Cipher, algorithms, modes
+except ModuleNotFoundError:  # gated dep: pure-python AES-CTR fallback below
+    Cipher = None
 
 from .. import tbls
-from ..utils import errors
+from ..utils import errors, pureaes
 
 
 def _scrypt_params(insecure: bool) -> dict:
@@ -28,9 +31,11 @@ def _scrypt_params(insecure: bool) -> dict:
 
 
 def _aes128ctr(key16: bytes, iv16: bytes, data: bytes) -> bytes:
-    cipher = Cipher(algorithms.AES(key16), modes.CTR(iv16))
-    enc = cipher.encryptor()
-    return enc.update(data) + enc.finalize()
+    if Cipher is not None:
+        cipher = Cipher(algorithms.AES(key16), modes.CTR(iv16))
+        enc = cipher.encryptor()
+        return enc.update(data) + enc.finalize()
+    return pureaes.aes128ctr(key16, iv16, data)
 
 
 def encrypt(secret: tbls.PrivateKey, password: str, *, insecure: bool = False,

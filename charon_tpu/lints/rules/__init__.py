@@ -5,6 +5,7 @@ from __future__ import annotations
 from .aio import UntrackedTaskRule
 from .exc import BroadExceptRule
 from .iface import ProtocolImplRule
+from .obs import DutySpanRule
 from .tpu import DeviceDtypeRule, PlaneStoreRoutingRule
 
 __all__ = [
@@ -13,6 +14,7 @@ __all__ = [
     "DeviceDtypeRule",
     "PlaneStoreRoutingRule",
     "ProtocolImplRule",
+    "DutySpanRule",
     "default_rules",
 ]
 
@@ -24,4 +26,5 @@ def default_rules() -> list:
         DeviceDtypeRule(),
         PlaneStoreRoutingRule(),
         ProtocolImplRule(),
+        DutySpanRule(),
     ]

@@ -1,0 +1,88 @@
+"""LINT-OBS-006 — core duty handlers must emit a flight-recorder span.
+
+The duty flight recorder (docs/observability.md) assembles per-duty latency
+timelines from tracer spans, and `tracker.duty_timeline` / the
+`/debug/duty/{slot}/{type}` endpoint are only as complete as the span
+coverage. Components on the wire()d pipeline get their `core/<step>` span
+for free from `interfaces.WithTracing`; everything *else* in `core/` that
+handles a `Duty` — subscribers, recasters, side-channel consumers — must
+open its own span (or at least record a `tracer.event`) so the duty's
+recording has no blind spots.
+
+Flags: a public `async def` method of a `core/` class whose first
+non-self parameter is named `duty` and whose body never calls
+`tracer.start_span(...)`, `tracer.event(...)`, or `<span>.add_event(...)`.
+
+Exempt:
+
+  * classes covered by wire()'s tracing boundary — the class name matches a
+    `core/interfaces.py` protocol (`class Fetcher`) or the class carries an
+    explicit `# lint: implements=<Protocol>` claim (LINT-IFACE-004 then
+    checks the claim is structurally honest);
+  * underscore-prefixed methods (internal helpers run inside the public
+    handler's span);
+  * `core/interfaces.py` itself (protocol stubs have no bodies to span).
+"""
+
+from __future__ import annotations
+
+import ast
+from pathlib import Path
+from typing import Iterable
+
+from ..engine import Finding, SourceFile
+from .iface import _INTERFACES, load_protocols
+
+_SPAN_CALLS = ("start_span", "event", "add_event")
+
+
+def _emits_span(fn: ast.AsyncFunctionDef) -> bool:
+    for node in ast.walk(fn):
+        if (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in _SPAN_CALLS):
+            return True
+    return False
+
+
+class DutySpanRule:
+    id = "LINT-OBS-006"
+    description = ("core/ duty handlers outside wire()'s tracing boundary "
+                   "must emit a tracer span")
+
+    def __init__(self, interfaces_path: Path | str | None = None):
+        self._interfaces_path = Path(interfaces_path or _INTERFACES)
+        self._protos: set[str] | None = None
+
+    @property
+    def protocols(self) -> set[str]:
+        if self._protos is None:
+            self._protos = set(load_protocols(self._interfaces_path))
+        return self._protos
+
+    def check(self, src: SourceFile) -> Iterable[Finding]:
+        if not src.in_dir("core") or src.rel.endswith("interfaces.py"):
+            return
+        for node in ast.walk(src.tree):
+            if not isinstance(node, ast.ClassDef):
+                continue
+            claims = list(src.implements.get(node.lineno, []))
+            claims += src.implements.get(node.lineno - 1, [])
+            if node.name in self.protocols or claims:
+                continue  # wire() wraps these calls in WithTracing spans
+            for stmt in node.body:
+                if (not isinstance(stmt, ast.AsyncFunctionDef)
+                        or stmt.name.startswith("_")):
+                    continue
+                args = stmt.args.posonlyargs + stmt.args.args
+                if len(args) < 2 or args[1].arg != "duty":
+                    continue
+                if not _emits_span(stmt):
+                    yield Finding(
+                        src.rel, stmt.lineno, self.id,
+                        f"duty handler `{node.name}.{stmt.name}` never "
+                        "emits a tracer span, leaving a blind spot in the "
+                        "duty's flight recording — open tracer.start_span"
+                        "(...) (or record tracer.event(...)), or claim the "
+                        "wire()d protocol it implements with `# lint: "
+                        "implements=`")

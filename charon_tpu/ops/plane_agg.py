@@ -43,6 +43,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..crypto import fields as PF
+from ..utils import metrics, tracer
 from ..crypto.curve import (g1_generator, jac_add, jac_is_infinity, FqOps,
                             Fq2Ops)
 from ..crypto.rlc import RLC_BITS, sample_randomizer
@@ -51,6 +52,18 @@ from . import field as F
 from . import pallas_plane as PP
 
 _MONT_ONE = F.fq_from_int(1)
+
+# Dispatch-phase latency split of the fused sigagg slot: "pack" is host
+# parse + async dispatch (_fused_dispatch), "execute" is the explicit
+# block_until_ready fence on the device graph, "drain" is the readback
+# transfer + host fold/emit/pairing after the fence. Sub-second buckets —
+# a steady-state slot is ~0.1-0.3 s end to end.
+_dispatch_hist = metrics.histogram(
+    "ops_device_dispatch_seconds",
+    "Fused sigagg dispatch phases: host pack, device execute, drain-side "
+    "readback + host fold", ("phase",),
+    buckets=(0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25,
+             0.5, 1, 2.5, 5))
 
 
 @functools.lru_cache(maxsize=4096)
@@ -748,7 +761,18 @@ def _fused_dispatch(layout, pks, msgs):
     """Host parse + async device dispatch of one fused slot; returns the
     pending state for _fused_finish. Callers overlap the NEXT slot's host
     parse with this slot's device execution (the jax dispatch is async —
-    nothing blocks until _fused_finish's device_get)."""
+    nothing blocks until _fused_finish's device_get). The whole body is the
+    "pack" phase of ops_device_dispatch_seconds: everything here is host
+    work + enqueue."""
+    with tracer.start_span("ops/fused_dispatch",
+                           validators=layout[2]) as span, \
+            _dispatch_hist.observe_time("pack"):
+        state = _fused_dispatch_impl(layout, pks, msgs)
+        span.attrs["outcome"] = state[0]
+        return state
+
+
+def _fused_dispatch_impl(layout, pks, msgs):
     sigs_all, scalars_all, V, Vp, T, Wv = layout
     body, _fin, sgn, loaded = _parse_compressed(
         sigs_all, 96, "G2", False, Vp * T)
@@ -770,22 +794,32 @@ def _fused_dispatch(layout, pks, msgs):
 
 
 def _fused_finish(state, hash_fn=None):
-    """Block on the slot's single device transfer, emit the aggregate
-    bytes, fold the RLC sums and run the multi-pairing."""
-    if state[0] == "bad_pk":
-        _tag, layout = state
-        sigs_all, scalars_all, V, Vp, T, Wv = layout
-        RX, RY, RZ, V, Vp = _aggregate_plane(None, layout)
-        return _serialize_aggregates(RX, RY, RZ, V), False
-    _tag, V, group_msgs, outs = state
-    ok, xs, sign, inf, sig_red, pk_reds = jax.device_get(outs)
-    if not ok.all():
-        _raise_bad(ok, "G2")
-    out = _g2_emit_bytes(xs, sign.reshape(-1), inf.reshape(-1), V)
-    S = PP._host_fold(*sig_red, 2)
-    pts = [(m, _unembed_g1(PP._host_fold(*pk_reds[g], 2)))
-           for g, m in enumerate(group_msgs)]
-    return out, _pairing_finish(S, pts, hash_fn)
+    """Block on the slot's device work, emit the aggregate bytes, fold the
+    RLC sums and run the multi-pairing. Phase split: an explicit
+    jax.block_until_ready fence is the "execute" phase (pure device wait —
+    on a pipelined caller this is where overlap shows up as ~0), and
+    everything after it (the readback transfer + host fold/emit/pairing) is
+    "drain"."""
+    with tracer.start_span("ops/fused_finish") as span:
+        if state[0] == "bad_pk":
+            span.attrs["outcome"] = "bad_pk"
+            _tag, layout = state
+            sigs_all, scalars_all, V, Vp, T, Wv = layout
+            RX, RY, RZ, V, Vp = _aggregate_plane(None, layout)
+            return _serialize_aggregates(RX, RY, RZ, V), False
+        _tag, V, group_msgs, outs = state
+        with _dispatch_hist.observe_time("execute"):
+            jax.block_until_ready(outs)
+        span.add_event("device_fence")
+        with _dispatch_hist.observe_time("drain"):
+            ok, xs, sign, inf, sig_red, pk_reds = jax.device_get(outs)
+            if not ok.all():
+                _raise_bad(ok, "G2")
+            out = _g2_emit_bytes(xs, sign.reshape(-1), inf.reshape(-1), V)
+            S = PP._host_fold(*sig_red, 2)
+            pts = [(m, _unembed_g1(PP._host_fold(*pk_reds[g], 2)))
+                   for g, m in enumerate(group_msgs)]
+            return out, _pairing_finish(S, pts, hash_fn)
 
 
 class SigAggPipeline:
@@ -820,32 +854,39 @@ class SigAggPipeline:
         """Pack + async-dispatch one slot. Returns the results of any slots
         completed to keep at most `depth` in flight (oldest first); pair
         with drain() for the tail."""
-        with self._lock:
-            state = _fused_dispatch(_layout_slots(batches), pks, msgs)
-            self._pending.append((state, hash_fn))
-            over = (self._pending.popleft()
-                    if len(self._pending) > self._depth else None)
-        # readback OUTSIDE the lock: a concurrent submit packs meanwhile
-        return [_fused_finish(*over)] if over is not None else []
+        with tracer.start_span("ops/sigagg_pipeline/submit",
+                               slots=len(batches)) as span:
+            with self._lock:
+                state = _fused_dispatch(_layout_slots(batches), pks, msgs)
+                self._pending.append((state, hash_fn))
+                over = (self._pending.popleft()
+                        if len(self._pending) > self._depth else None)
+                span.attrs["in_flight"] = len(self._pending)
+            # readback OUTSIDE the lock: a concurrent submit packs meanwhile
+            return [_fused_finish(*over)] if over is not None else []
 
     def drain(self) -> list:
         """Finish every in-flight slot, oldest first."""
         out = []
-        while True:
-            with self._lock:
-                if not self._pending:
-                    return out
-                state, hash_fn = self._pending.popleft()
-            out.append(_fused_finish(state, hash_fn))
+        with tracer.start_span("ops/sigagg_pipeline/drain") as span:
+            while True:
+                with self._lock:
+                    if not self._pending:
+                        span.attrs["drained"] = len(out)
+                        return out
+                    state, hash_fn = self._pending.popleft()
+                out.append(_fused_finish(state, hash_fn))
 
     def aggregate_verify(self, batches, pks, msgs, hash_fn=None):
         """Dispatch this slot and block for ITS result (the tbls
         threshold_aggregate_verify shape). Only the pack+dispatch holds
         the lock; the readback runs outside it, so concurrent callers
         overlap their host pack with this slot's device execution."""
-        with self._lock:
-            state = _fused_dispatch(_layout_slots(batches), pks, msgs)
-        return _fused_finish(state, hash_fn)
+        with tracer.start_span("ops/sigagg_pipeline/aggregate_verify",
+                               slots=len(batches)):
+            with self._lock:
+                state = _fused_dispatch(_layout_slots(batches), pks, msgs)
+            return _fused_finish(state, hash_fn)
 
 
 @jax.jit

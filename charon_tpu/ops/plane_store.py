@@ -42,7 +42,7 @@ from __future__ import annotations
 import hashlib
 import threading
 
-from ..utils import metrics
+from ..utils import metrics, tracer
 
 _hits = metrics.counter(
     "ops_planestore_hits_total",
@@ -175,13 +175,16 @@ class PlaneStore:
         from . import plane_agg
 
         planes = []
-        for _i, s, e, Bc in missing:
-            _decompress.inc()
-            plane = plane_agg.g1_plane_from_compressed(
-                [bytes(p) for p in pks[s:e]], Bc, reject_infinity=True)
-            if not plane_agg.g1_subgroup_ok(plane):
-                raise ValueError("G1 pubkey not in subgroup")
-            planes.append(plane)
+        with tracer.start_span("ops/planestore/decode_chunks",
+                               chunks=len(missing)) as span:
+            for _i, s, e, Bc in missing:
+                _decompress.inc()
+                span.add_event("decompress_dispatch", start=s, end=e)
+                plane = plane_agg.g1_plane_from_compressed(
+                    [bytes(p) for p in pks[s:e]], Bc, reject_infinity=True)
+                if not plane_agg.g1_subgroup_ok(plane):
+                    raise ValueError("G1 pubkey not in subgroup")
+                planes.append(plane)
         return planes
 
     # ---- host-side entries (sharded plane) -------------------------------

@@ -74,7 +74,14 @@ def _build_steps(mesh, G: int, T: int, Wv: int):
     in graph size and the pieces compile (and persistent-cache)
     independently; intermediates stay sharded on the devices between them.
     """
-    from jax import shard_map
+    try:  # jax >= 0.6 promoted shard_map to the top level
+        from jax import shard_map
+    except ImportError:  # jax 0.4.x: experimental module, `check_rep` kwarg
+        from jax.experimental.shard_map import shard_map as _exp_shard_map
+
+        def shard_map(f, *, mesh, in_specs, out_specs, check_vma=True):
+            return _exp_shard_map(f, mesh=mesh, in_specs=in_specs,
+                                  out_specs=out_specs, check_rep=check_vma)
     from jax.sharding import PartitionSpec as P
 
     D = mesh.devices.size

@@ -31,7 +31,14 @@ import hmac
 import os
 import struct
 
-from cryptography.hazmat.primitives.ciphers.aead import AESGCM
+try:
+    from cryptography.hazmat.primitives.ciphers.aead import AESGCM
+except ModuleNotFoundError:
+    # Gated dep: hashlib-backed AEAD with the same call signature. Pure-python
+    # AES-GCM is ~30 KiB/s — unusable for consensus traffic — so the fallback
+    # trades wire compatibility (fallback peers only talk to fallback peers)
+    # for wire speed. See utils/pureaes.HashAEAD.
+    from ..utils.pureaes import HashAEAD as AESGCM
 
 from ..utils import errors, k1util
 

@@ -191,8 +191,10 @@ class TPUImpl(NativeImpl):
         if not (n == len(public_keys) == len(datas)):
             raise ValueError("length mismatch")
         if n < self.min_device_batch or not _on_device():
-            return NativeImpl.threshold_aggregate_verify_batch(
-                self, batches, public_keys, datas)
+            # degrade to the serial entry point, which owns the
+            # device-vs-native decision (and is the seam callers spy on)
+            return self.threshold_aggregate_verify_batch(
+                batches, public_keys, datas)
         for b in batches:
             if not b:
                 raise ValueError("no partial signatures to aggregate")

@@ -11,7 +11,7 @@ from __future__ import annotations
 
 import threading
 import time
-from bisect import bisect_right
+from bisect import bisect_left
 from typing import Iterable
 
 
@@ -76,7 +76,9 @@ class Histogram(_Metric):
         key = self.labels(*label_values)
         with self._lock:
             counts = self._counts.setdefault(key, [0] * (len(self.buckets) + 1))
-            counts[bisect_right(self.buckets, value)] += 1
+            # bisect_left honours prometheus `le` (≤) semantics: a value
+            # exactly on a bucket bound counts in THAT bucket, not the next.
+            counts[bisect_left(self.buckets, value)] += 1
             self._sums[key] = self._sums.get(key, 0.0) + value
 
     def observe_time(self, *label_values: str):
@@ -172,6 +174,33 @@ class Registry:
                 out[f"{m.name}{{{lbl}}}" if lbl else m.name] = value
         return out
 
+    def snapshot_quantiles(self, prefix: str = "",
+                           quantiles: tuple[float, ...] = (0.5, 0.99),
+                           ) -> dict[str, dict[str, float]]:
+        """Flat {name{label="v",...}: {"p50": v, "p99": v, "count": n,
+        "sum": s}} view of every histogram whose name starts with `prefix` —
+        the programmatic hook bench.py and the health checker read latency
+        percentiles through (snapshot() covers counters/gauges only)."""
+        out: dict[str, dict[str, float]] = {}
+        for m in self.gather().values():
+            if not m.name.startswith(prefix) or not isinstance(m, Histogram):
+                continue
+            with m._lock:
+                keys = list(m._counts)
+                sums = dict(m._sums)
+                counts = {k: sum(m._counts[k]) for k in keys}
+            for key in keys:
+                lbl = ",".join(f'{n}="{v}"'
+                               for n, v in zip(m.label_names, key))
+                stats: dict[str, float] = {
+                    "count": float(counts[key]),
+                    "sum": sums.get(key, 0.0),
+                }
+                for q in quantiles:
+                    stats[f"p{int(q * 100)}"] = m.quantile(q, *key)
+                out[f"{m.name}{{{lbl}}}" if lbl else m.name] = stats
+        return out
+
     def expose_text(self) -> str:
         """Prometheus text exposition format."""
         const_parts = [f'{k}="{v}"' for k, v in sorted(self.const_labels.items())]
@@ -214,3 +243,4 @@ default_registry = Registry()
 counter = default_registry.counter
 gauge = default_registry.gauge
 histogram = default_registry.histogram
+snapshot_quantiles = default_registry.snapshot_quantiles

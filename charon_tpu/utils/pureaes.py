@@ -1,0 +1,241 @@
+"""Pure-python AES-128 (CTR and GCM) — the fallback when the
+`cryptography` package is absent.
+
+The only AES consumers in this codebase are EIP-2335 keystores
+(eth2/keystore.py, 32-byte secrets) and the p2p secure-channel framing
+(p2p/channel.py, duty-sized frames), so a table-driven python
+implementation is plenty; it is bit-compatible with the OpenSSL-backed
+`cryptography` primitives (FIPS-197 / SP800-38A / SP800-38D vectors in
+tests/test_pureaes.py)."""
+
+from __future__ import annotations
+
+import hashlib
+import hmac
+
+
+def _gf_mul(a: int, b: int) -> int:
+    """GF(2^8) multiply, AES polynomial x^8+x^4+x^3+x+1."""
+    r = 0
+    for _ in range(8):
+        if b & 1:
+            r ^= a
+        hi = a & 0x80
+        a = (a << 1) & 0xFF
+        if hi:
+            a ^= 0x1B
+        b >>= 1
+    return r
+
+
+def _make_sbox() -> list[int]:
+    exp, log = [0] * 256, [0] * 256
+    x = 1
+    for i in range(255):
+        exp[i] = x
+        log[x] = i
+        x = _gf_mul(x, 3)
+    sbox = [0] * 256
+    for i in range(256):
+        # inverse of i is 3^(255 - log i); the exponent is taken mod 255
+        # because exp[] only covers 3^0..3^254 (3^255 wraps to 3^0 = 1)
+        q = 0 if i == 0 else exp[(255 - log[i]) % 255]
+        s = q
+        for sh in (1, 2, 3, 4):
+            s ^= ((q << sh) | (q >> (8 - sh))) & 0xFF
+        sbox[i] = s ^ 0x63
+    return sbox
+
+
+_sbox: list[int] | None = None
+
+
+def _ensure_tables() -> list[int]:
+    global _sbox
+    if _sbox is None:
+        _sbox = _make_sbox()
+    return _sbox
+
+
+def _expand_key(key16: bytes) -> list[list[int]]:
+    """AES-128 key schedule: 11 round keys of 16 bytes each."""
+    sbox = _ensure_tables()
+    words = [list(key16[i:i + 4]) for i in range(0, 16, 4)]
+    rcon = 1
+    for i in range(4, 44):
+        t = list(words[i - 1])
+        if i % 4 == 0:
+            t = t[1:] + t[:1]
+            t = [sbox[b] for b in t]
+            t[0] ^= rcon
+            rcon = _gf_mul(rcon, 2)
+        words.append([a ^ b for a, b in zip(words[i - 4], t)])
+    return [sum(words[r * 4:r * 4 + 4], []) for r in range(11)]
+
+
+def _encrypt_block(rks: list[list[int]], block: bytes) -> bytes:
+    sbox = _ensure_tables()
+    s = [b ^ k for b, k in zip(block, rks[0])]
+    for rnd in range(1, 11):
+        s = [sbox[b] for b in s]
+        # ShiftRows on the column-major state layout
+        s = [s[(i + 4 * (i % 4)) % 16] for i in range(16)]
+        if rnd < 10:
+            mixed = []
+            for c in range(4):
+                a = s[c * 4:c * 4 + 4]
+                mixed += [
+                    _gf_mul(a[0], 2) ^ _gf_mul(a[1], 3) ^ a[2] ^ a[3],
+                    a[0] ^ _gf_mul(a[1], 2) ^ _gf_mul(a[2], 3) ^ a[3],
+                    a[0] ^ a[1] ^ _gf_mul(a[2], 2) ^ _gf_mul(a[3], 3),
+                    _gf_mul(a[0], 3) ^ a[1] ^ a[2] ^ _gf_mul(a[3], 2),
+                ]
+            s = mixed
+        s = [b ^ k for b, k in zip(s, rks[rnd])]
+    return bytes(s)
+
+
+def aes128ctr(key16: bytes, iv16: bytes, data: bytes) -> bytes:
+    """AES-128-CTR with a full 128-bit big-endian counter (the semantics of
+    cryptography's modes.CTR). Encryption and decryption are the same op."""
+    rks = _expand_key(key16)
+    counter = int.from_bytes(iv16, "big")
+    out = bytearray()
+    for off in range(0, len(data), 16):
+        stream = _encrypt_block(
+            rks, (counter & ((1 << 128) - 1)).to_bytes(16, "big"))
+        chunk = data[off:off + 16]
+        out += bytes(c ^ s for c, s in zip(chunk, stream))
+        counter += 1
+    return bytes(out)
+
+
+# -- GCM (SP800-38D) --------------------------------------------------------
+
+
+_R = 0xE1000000000000000000000000000000
+
+
+def _gf128_mul(x: int, y: int) -> int:
+    """GF(2^128) multiply, bits msb-first (SP800-38D algorithm 1)."""
+    z, v = 0, x
+    for i in range(127, -1, -1):
+        if (y >> i) & 1:
+            z ^= v
+        v = (v >> 1) ^ _R if v & 1 else v >> 1
+    return z
+
+
+def _ghash(h: int, data: bytes) -> int:
+    y = 0
+    for off in range(0, len(data), 16):
+        block = int.from_bytes(data[off:off + 16], "big")
+        y = _gf128_mul(y ^ block, h)
+    return y
+
+
+def _pad16(b: bytes) -> bytes:
+    return b + bytes(-len(b) % 16)
+
+
+class AESGCM128:
+    """Drop-in for cryptography's AESGCM (128-bit keys, 96-bit nonces,
+    16-byte tag appended to the ciphertext). decrypt raises ValueError on
+    tag mismatch."""
+
+    def __init__(self, key16: bytes):
+        if len(key16) != 16:
+            raise ValueError("AESGCM128 fallback supports 16-byte keys only")
+        self._rks = _expand_key(key16)
+        self._h = int.from_bytes(_encrypt_block(self._rks, bytes(16)), "big")
+
+    def _gctr(self, j0: int, data: bytes) -> bytes:
+        out = bytearray()
+        ctr = j0
+        for off in range(0, len(data), 16):
+            ctr = (ctr & ~0xFFFFFFFF) | ((ctr + 1) & 0xFFFFFFFF)  # inc32
+            stream = _encrypt_block(self._rks, ctr.to_bytes(16, "big"))
+            chunk = data[off:off + 16]
+            out += bytes(c ^ s for c, s in zip(chunk, stream))
+        return bytes(out)
+
+    def _tag(self, j0: int, aad: bytes, ct: bytes) -> bytes:
+        lens = (len(aad) * 8).to_bytes(8, "big") + (len(ct) * 8).to_bytes(8, "big")
+        s = _ghash(self._h, _pad16(aad) + _pad16(ct) + lens)
+        ek = int.from_bytes(_encrypt_block(self._rks, j0.to_bytes(16, "big")),
+                            "big")
+        return (s ^ ek).to_bytes(16, "big")
+
+    @staticmethod
+    def _j0(nonce: bytes) -> int:
+        if len(nonce) != 12:
+            raise ValueError("AESGCM128 fallback supports 12-byte nonces only")
+        return (int.from_bytes(nonce, "big") << 32) | 1
+
+    def encrypt(self, nonce: bytes, data: bytes, aad: bytes | None) -> bytes:
+        j0 = self._j0(nonce)
+        ct = self._gctr(j0, data)
+        return ct + self._tag(j0, aad or b"", ct)
+
+    def decrypt(self, nonce: bytes, data: bytes, aad: bytes | None) -> bytes:
+        if len(data) < 16:
+            raise ValueError("ciphertext shorter than the GCM tag")
+        j0 = self._j0(nonce)
+        ct, tag = data[:-16], data[-16:]
+        if not hmac.compare_digest(self._tag(j0, aad or b"", ct), tag):
+            raise ValueError("GCM authentication tag mismatch")
+        return self._gctr(j0, ct)
+
+
+class HashAEAD:
+    """Fast AEAD with the AESGCM call signature, built from hashlib (which
+    is C-speed) — the p2p channel fallback when `cryptography` is absent.
+
+    Pure-python AES-GCM (AESGCM128 above) runs ~30 KiB/s, far too slow for
+    consensus traffic; this encrypt-then-MAC scheme (SHA-256 CTR keystream,
+    truncated HMAC-SHA256 tag) keeps the channel's confidentiality +
+    integrity properties at wire speed. It is NOT bit-compatible with
+    AES-GCM: fallback peers interoperate only with fallback peers, which
+    holds whenever a whole cluster runs in an environment without the
+    `cryptography` package.
+    """
+
+    def __init__(self, key16: bytes):
+        if len(key16) != 16:
+            raise ValueError("HashAEAD expects a 16-byte key")
+        self._enc = hashlib.sha256(b"charon/hashaead/enc/1" + key16).digest()
+        self._mac = hashlib.sha256(b"charon/hashaead/mac/1" + key16).digest()
+
+    def _keystream(self, nonce: bytes, n: int) -> bytes:
+        base = hashlib.sha256(self._enc + nonce)
+        out = bytearray()
+        ctr = 0
+        while len(out) < n:
+            h = base.copy()
+            h.update(ctr.to_bytes(8, "big"))
+            out += h.digest()
+            ctr += 1
+        return bytes(out[:n])
+
+    def _xor(self, nonce: bytes, data: bytes) -> bytes:
+        if not data:
+            return b""
+        ks = self._keystream(nonce, len(data))
+        x = int.from_bytes(data, "big") ^ int.from_bytes(ks, "big")
+        return x.to_bytes(len(data), "big")
+
+    def _tag(self, nonce: bytes, aad: bytes, ct: bytes) -> bytes:
+        msg = nonce + len(aad).to_bytes(8, "big") + aad + ct
+        return hmac.new(self._mac, msg, hashlib.sha256).digest()[:16]
+
+    def encrypt(self, nonce: bytes, data: bytes, aad: bytes | None) -> bytes:
+        ct = self._xor(nonce, data)
+        return ct + self._tag(nonce, aad or b"", ct)
+
+    def decrypt(self, nonce: bytes, data: bytes, aad: bytes | None) -> bytes:
+        if len(data) < 16:
+            raise ValueError("ciphertext shorter than the tag")
+        ct, tag = data[:-16], data[-16:]
+        if not hmac.compare_digest(self._tag(nonce, aad or b"", ct), tag):
+            raise ValueError("AEAD authentication tag mismatch")
+        return self._xor(nonce, ct)

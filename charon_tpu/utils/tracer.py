@@ -5,22 +5,41 @@ every duty gets a trace ID derived deterministically from {slot, type} so all
 peers' spans join into one cluster-wide trace. Spans are recorded in-process
 (inspectable in tests, dumpable as JSON) rather than exported to Jaeger; the
 exporter seam is a callback.
+
+The in-process buffer doubles as the duty flight recorder: spans carry point
+*events* (phase markers inside a span), overflow is counted in
+`tracer_dropped_spans_total`, and the whole buffer exports as Chrome
+trace-event JSON (`to_chrome_trace`/`write_chrome_trace`) loadable in
+Perfetto or chrome://tracing — one process row per trace (duty), one thread
+row per span name (pipeline step). See docs/observability.md.
 """
 
 from __future__ import annotations
 
 import contextvars
 import hashlib
+import json
 import threading
 import time
 from contextlib import contextmanager
 from dataclasses import dataclass, field
-from typing import Any, Callable
+from typing import Any, Callable, Iterable
+
+from . import metrics
 
 _current_trace: contextvars.ContextVar[str | None] = contextvars.ContextVar(
     "charon_trace_id", default=None)
-_current_span: contextvars.ContextVar[str | None] = contextvars.ContextVar(
-    "charon_span_id", default=None)
+_current_span: contextvars.ContextVar["Span | None"] = contextvars.ContextVar(
+    "charon_span", default=None)
+
+
+@dataclass
+class SpanEvent:
+    """A point-in-time marker inside a span (phase transitions, fences)."""
+
+    name: str
+    ts: float
+    attrs: dict[str, Any] = field(default_factory=dict)
 
 
 @dataclass
@@ -32,17 +51,36 @@ class Span:
     start: float
     end: float = 0.0
     attrs: dict[str, Any] = field(default_factory=dict)
+    events: list[SpanEvent] = field(default_factory=list)
+
+    def add_event(self, name: str, **attrs: Any) -> SpanEvent:
+        ev = SpanEvent(name, time.time(), dict(attrs))
+        self.events.append(ev)
+        return ev
 
 
 _lock = threading.Lock()
 _finished: list[Span] = []
 _exporter: Callable[[Span], None] | None = None
-_MAX_BUFFER = 10_000
+_DEFAULT_MAX_BUFFER = 10_000
+_max_buffer = _DEFAULT_MAX_BUFFER
+
+_dropped_counter = metrics.counter(
+    "tracer_dropped_spans_total",
+    "Finished spans evicted from the in-process ring buffer")
 
 
 def set_exporter(exporter: Callable[[Span], None] | None) -> None:
     global _exporter
     _exporter = exporter
+
+
+def set_max_buffer(size: int) -> None:
+    """Resize the finished-span ring buffer (default 10k spans)."""
+    global _max_buffer
+    if size < 2:
+        raise ValueError(f"buffer size must be >= 2, got {size}")
+    _max_buffer = int(size)
 
 
 def rooted_ctx(duty_slot: int, duty_type: str) -> str:
@@ -55,6 +93,14 @@ def rooted_ctx(duty_slot: int, duty_type: str) -> str:
     return trace_id
 
 
+def duty_trace_id(duty_slot: int, duty_type: str) -> str:
+    """The trace id `rooted_ctx` would set, without touching the context —
+    for consumers that only need to FIND a duty's spans (tracker timelines,
+    the /debug/duty endpoint)."""
+    h = hashlib.sha256(f"charon/duty/{duty_slot}/{duty_type}".encode()).hexdigest()
+    return h[:32]
+
+
 @contextmanager
 def start_span(name: str, **attrs: Any):
     trace_id = _current_trace.get()
@@ -62,10 +108,11 @@ def start_span(name: str, **attrs: Any):
         trace_id = hashlib.sha256(f"{name}{time.time_ns()}".encode()).hexdigest()[:32]
         _current_trace.set(trace_id)
     parent = _current_span.get()
+    parent_id = parent.span_id if parent is not None else None
     span_id = hashlib.sha256(
-        f"{trace_id}{parent}{name}{time.monotonic_ns()}".encode()).hexdigest()[:16]
-    span = Span(trace_id, span_id, parent, name, time.time(), attrs=dict(attrs))
-    token = _current_span.set(span_id)
+        f"{trace_id}{parent_id}{name}{time.monotonic_ns()}".encode()).hexdigest()[:16]
+    span = Span(trace_id, span_id, parent_id, name, time.time(), attrs=dict(attrs))
+    token = _current_span.set(span)
     try:
         yield span
     finally:
@@ -73,10 +120,20 @@ def start_span(name: str, **attrs: Any):
         _current_span.reset(token)
         with _lock:
             _finished.append(span)
-            if len(_finished) > _MAX_BUFFER:
-                del _finished[: _MAX_BUFFER // 2]
+            if len(_finished) > _max_buffer:
+                drop = _max_buffer // 2
+                del _finished[:drop]
+                _dropped_counter.inc(amount=drop)
         if _exporter is not None:
             _exporter(span)
+
+
+def event(name: str, **attrs: Any) -> SpanEvent | None:
+    """Attach a point event to the currently-open span (no-op outside one)."""
+    span = _current_span.get()
+    if span is None:
+        return None
+    return span.add_event(name, **attrs)
 
 
 def finished_spans() -> list[Span]:
@@ -84,6 +141,84 @@ def finished_spans() -> list[Span]:
         return list(_finished)
 
 
-def reset_for_t() -> None:
+def spans_for_trace(trace_id: str) -> list[Span]:
+    """All finished spans of one trace, in start order."""
+    with _lock:
+        spans = [s for s in _finished if s.trace_id == trace_id]
+    return sorted(spans, key=lambda s: s.start)
+
+
+def reset_for_testing() -> None:
+    global _max_buffer
     with _lock:
         _finished.clear()
+    _max_buffer = _DEFAULT_MAX_BUFFER
+
+
+# Back-compat alias (pre-rename API used throughout older tests).
+reset_for_t = reset_for_testing
+
+
+# -- Chrome trace-event / Perfetto export -----------------------------------
+#
+# The Chrome trace-event JSON object format ({"traceEvents": [...]}) loads in
+# both chrome://tracing and Perfetto. Rows: each trace id becomes a process
+# (pid) so one duty's flight is one horizontal band; each span name becomes a
+# thread (tid) inside it so pipeline steps stack in wiring order. Complete
+# events use ph="X" with microsecond ts/dur; span events export as ph="i"
+# thread-scoped instants.
+
+
+def to_chrome_trace(spans: Iterable[Span] | None = None) -> dict:
+    """Render spans as a Chrome trace-event JSON object (dict)."""
+    if spans is None:
+        spans = finished_spans()
+    pids: dict[str, int] = {}
+    tids: dict[str, int] = {}
+    out: list[dict] = []
+    for span in spans:
+        pid = pids.setdefault(span.trace_id, len(pids) + 1)
+        tid = tids.setdefault(span.name, len(tids) + 1)
+        args = {k: str(v) for k, v in span.attrs.items()}
+        args["trace_id"] = span.trace_id
+        args["span_id"] = span.span_id
+        if span.parent_id:
+            args["parent_id"] = span.parent_id
+        end = span.end if span.end else span.start
+        out.append({
+            "name": span.name,
+            "cat": "charon",
+            "ph": "X",
+            "ts": span.start * 1e6,
+            "dur": max(end - span.start, 0.0) * 1e6,
+            "pid": pid,
+            "tid": tid,
+            "args": args,
+        })
+        for ev in span.events:
+            out.append({
+                "name": ev.name,
+                "cat": "charon",
+                "ph": "i",
+                "s": "t",
+                "ts": ev.ts * 1e6,
+                "pid": pid,
+                "tid": tid,
+                "args": {k: str(v) for k, v in ev.attrs.items()},
+            })
+    # Row labels: trace id on the process, span name on the thread.
+    for trace_id, pid in pids.items():
+        out.append({"name": "process_name", "ph": "M", "ts": 0, "pid": pid,
+                    "tid": 0, "args": {"name": f"trace {trace_id}"}})
+    for name, tid in tids.items():
+        for pid in pids.values():
+            out.append({"name": "thread_name", "ph": "M", "ts": 0, "pid": pid,
+                        "tid": tid, "args": {"name": name}})
+    return {"traceEvents": out, "displayTimeUnit": "ms"}
+
+
+def write_chrome_trace(path: str, spans: Iterable[Span] | None = None) -> str:
+    """Write one Chrome-trace JSON file (one file per run) and return path."""
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(to_chrome_trace(spans), f)
+    return path

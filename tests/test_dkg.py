@@ -149,6 +149,7 @@ class TestFrostMath:
         scs[0] = (scs[0] + 1) % (2**256 - 1)
         assert not plane_agg.g1_lincomb_is_infinity(pts, scs)
 
+    @pytest.mark.slow  # g1_groups_msm cold-compiles >15 min on CPU
     def test_same_x_device_equation_matches_per_item(self):
         """The factored same-x device path (one short-digit sweep + per-k
         reduces + host x^k fold) must accept exactly the batches the
@@ -167,6 +168,7 @@ class TestFrostMath:
         assert not frost._verify_shares_device(bad)
 
 
+    @pytest.mark.slow  # drives the uncached device decode+RLC graphs
     def test_device_rlc_rejects_small_order_commitment(self):
         """Advisor round-4 HIGH regression: an off-subgroup commitment with
         a small-order component passes the 64-bit-randomizer RLC with
@@ -228,6 +230,7 @@ class TestFrostMath:
         with pytest.raises(CharonError):
             frost.verify_share(2, shares[2], commitments)
 
+    @pytest.mark.slow  # the same-x leg reaches the g1_groups_msm graph
     def test_infinity_commitment_rejected_everywhere(self):
         """An INFINITY commitment (zero polynomial coefficient) is a
         degenerate dealer: kryptology rejects identity points, and the RLC
@@ -254,6 +257,7 @@ class TestFrostMath:
         with pytest.raises(ValueError):
             frost._verify_shares_device(items)
 
+    @pytest.mark.slow  # fixed-base keygen graph cold-compiles on CPU
     def test_g1_mul_gen_batch_bit_identity(self):
         """The batched fixed-base device serializer must be bit-identical
         to the serial generator multiplication (keygen path)."""
@@ -369,6 +373,8 @@ class TestCeremony:
 
 
 @pytest.mark.nightly
+@pytest.mark.slow  # interpret-mode fused graph; nightly alone does not
+                   # shield it from the verify tier's -m "not slow"
 def test_share_verify_fused_device_decode_path(monkeypatch):
     """Drive the round-5 FUSED device graph (plane_agg.
     _g1_decode_groups_sweep_jit: batched G1 decompression + subgroup check

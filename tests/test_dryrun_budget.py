@@ -40,6 +40,8 @@ BUDGET_S = 780
 
 
 @pytest.mark.scale
+@pytest.mark.slow  # deliberately-cold ~550 s subprocess; cannot share the
+                   # timed verify tier's budget with the rest of the suite
 def test_dryrun_multichip_cold_budget():
     sys.path.insert(0, str(REPO))
     import __graft_entry__ as entry

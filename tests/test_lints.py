@@ -340,6 +340,63 @@ def test_iface_rule_flags_unknown_protocol_claim(tmp_path):
 
 
 # ---------------------------------------------------------------------------
+# LINT-OBS-006 — core duty handlers must emit a flight-recorder span
+# ---------------------------------------------------------------------------
+
+
+def test_obs_rule_flags_spanless_duty_handler(tmp_path):
+    findings = lint_source(tmp_path, "core/x.py", """\
+        class Replayer:
+            async def on_broadcast(self, duty, signed):
+                self._regs.update(signed)
+    """)
+    assert rules_of(findings) == ["LINT-OBS-006"]
+    assert "Replayer.on_broadcast" in findings[0].message
+    assert findings[0].line == 2
+
+
+def test_obs_rule_accepts_spans_events_and_exemptions(tmp_path):
+    findings = lint_source(tmp_path, "core/x.py", """\
+        from charon_tpu.utils import tracer
+
+        class Replayer:
+            async def on_broadcast(self, duty, signed):
+                with tracer.start_span("core/replay", duty=str(duty)):
+                    self._regs.update(signed)
+
+            async def on_decided(self, duty, value):
+                tracer.event("decided", duty=str(duty))
+
+            async def _helper(self, duty):
+                pass                     # underscore: runs inside a span
+
+            async def on_slot(self, slot):
+                pass                     # first arg is not a duty
+
+        class Fetcher:                   # name-matches a wire()d protocol
+            async def fetch(self, duty, defset):
+                pass
+
+            def subscribe(self, fn):
+                pass
+
+        class RegDB:  # lint: implements=Broadcaster
+            async def broadcast(self, duty, signed):
+                pass
+    """)
+    assert findings == []
+
+
+def test_obs_rule_ignores_files_outside_core(tmp_path):
+    findings = lint_source(tmp_path, "p2p/x.py", """\
+        class Gossip:
+            async def on_duty(self, duty, payload):
+                pass
+    """)
+    assert findings == []
+
+
+# ---------------------------------------------------------------------------
 # engine mechanics: suppressions, parse errors, caching
 # ---------------------------------------------------------------------------
 

@@ -23,6 +23,8 @@ import __graft_entry__ as graft
 
 @pytest.mark.scale
 @pytest.mark.nightly
+@pytest.mark.slow  # production window-4 graphs cold-compile for tens of
+                   # minutes; nightly alone is overridden by -m "not slow"
 @pytest.mark.skipif(len(jax.devices()) < 8, reason="needs 8 virtual devices")
 def test_dryrun_multichip_in_process():
     # conftest provisioned 8 CPU devices, so this runs the shard_map path

@@ -1,0 +1,109 @@
+"""The pure-python AES fallback (utils/pureaes.py, used when the
+`cryptography` package is absent) must be bit-compatible with the real
+thing: FIPS-197 block / NIST SP800-38A CTR / NIST SP800-38D GCM vectors,
+plus an EIP-2335 keystore roundtrip forced through the pure path."""
+
+import pytest
+
+from charon_tpu import tbls
+from charon_tpu.eth2 import keystore as ks
+from charon_tpu.utils import pureaes
+
+
+def test_fips197_single_block():
+    key = bytes.fromhex("000102030405060708090a0b0c0d0e0f")
+    pt = bytes.fromhex("00112233445566778899aabbccddeeff")
+    ct = pureaes._encrypt_block(pureaes._expand_key(key), pt)
+    assert ct.hex() == "69c4e0d86a7b0430d8cdb78070b4c55a"
+
+
+def test_sp800_38a_ctr_vectors_and_symmetry():
+    key = bytes.fromhex("2b7e151628aed2a6abf7158809cf4f3c")
+    iv = bytes.fromhex("f0f1f2f3f4f5f6f7f8f9fafbfcfdfeff")
+    pt = bytes.fromhex("6bc1bee22e409f96e93d7e117393172a"
+                       "ae2d8a571e03ac9c9eb76fac45af8e51"
+                       "30c81c46a35ce411e5fbc1191a0a52ef"
+                       "f69f2445df4f9b17ad2b417be66c3710")
+    want = ("874d6191b620e3261bef6864990db6ce"
+            "9806f66b7970fdff8617187bb9fffdff"
+            "5ae4df3edbd5d35e5b4f09020db03eab"
+            "1e031dda2fbe03d1792170a0f3009cee")
+    got = pureaes.aes128ctr(key, iv, pt)
+    assert got.hex() == want
+    assert pureaes.aes128ctr(key, iv, got) == pt  # CTR decrypt == encrypt
+    # partial final block (CTR is a stream cipher)
+    assert pureaes.aes128ctr(key, iv, pt[:20]) == got[:20]
+
+
+# SP800-38D / GCM spec test case 3/4 key, IV, and plaintext.
+_GCM_KEY = bytes.fromhex("feffe9928665731c6d6a8f9467308308")
+_GCM_IV = bytes.fromhex("cafebabefacedbaddecaf888")
+_GCM_PT = bytes.fromhex(
+    "d9313225f88406e5a55909c5aff5269a"
+    "86a7a9531534f7da2e4c303d8a318a72"
+    "1c3c0c95956809532fcf0e2449a6b525"
+    "b16aedf5aa0de657ba637b391aafd255")
+_GCM_CT = bytes.fromhex(
+    "42831ec2217774244b7221b784d0d49c"
+    "e3aa212f2c02a4e035c17e2329aca12e"
+    "21d514b25466931c7d8f6a5aac84aa05"
+    "1ba30b396a0aac973d58e091473f5985")
+
+
+def test_gcm_spec_vector_no_aad():
+    aead = pureaes.AESGCM128(_GCM_KEY)
+    out = aead.encrypt(_GCM_IV, _GCM_PT, b"")
+    assert out[:-16] == _GCM_CT
+    assert out[-16:].hex() == "4d5c2af327cd64a62cf35abd2ba6fab4"
+    assert aead.decrypt(_GCM_IV, out, b"") == _GCM_PT
+
+
+def test_gcm_spec_vector_with_aad_and_partial_block():
+    aad = bytes.fromhex("feedfacedeadbeeffeedfacedeadbeefabaddad2")
+    aead = pureaes.AESGCM128(_GCM_KEY)
+    out = aead.encrypt(_GCM_IV, _GCM_PT[:60], aad)
+    assert out[:-16] == _GCM_CT[:60]
+    assert out[-16:].hex() == "5bc94fbc3221a5db94fae95ae7121a47"
+    assert aead.decrypt(_GCM_IV, out, aad) == _GCM_PT[:60]
+
+
+def test_gcm_rejects_tampering_and_bad_params():
+    aead = pureaes.AESGCM128(b"k" * 16)
+    ct = aead.encrypt(b"n" * 12, b"secret frame", b"aad")
+    with pytest.raises(ValueError):
+        aead.decrypt(b"n" * 12, ct[:-1] + bytes([ct[-1] ^ 1]), b"aad")
+    with pytest.raises(ValueError):
+        aead.decrypt(b"n" * 12, ct, b"wrong aad")
+    with pytest.raises(ValueError):
+        aead.decrypt(b"n" * 12, b"short", b"")
+    with pytest.raises(ValueError):
+        pureaes.AESGCM128(b"k" * 32)  # 256-bit keys need the real backend
+    with pytest.raises(ValueError):
+        aead.encrypt(b"n" * 8, b"", b"")  # 96-bit nonces only
+
+
+def test_hash_aead_roundtrip_and_tampering():
+    aead = pureaes.HashAEAD(b"k" * 16)
+    for size in (0, 1, 31, 32, 33, 4096):
+        pt = bytes(range(256)) * (size // 256 + 1)
+        pt = pt[:size]
+        ct = aead.encrypt(b"n" * 12, pt, b"aad")
+        assert len(ct) == size + 16
+        assert aead.decrypt(b"n" * 12, ct, b"aad") == pt
+    ct = aead.encrypt(b"n" * 12, b"frame", b"")
+    # different nonce -> different ciphertext (keystream is nonce-bound)
+    assert aead.encrypt(b"m" * 12, b"frame", b"")[:5] != ct[:5]
+    with pytest.raises(ValueError):
+        aead.decrypt(b"n" * 12, ct[:-1] + bytes([ct[-1] ^ 1]), b"")
+    with pytest.raises(ValueError):
+        aead.decrypt(b"n" * 12, ct, b"wrong aad")
+    with pytest.raises(ValueError):
+        pureaes.HashAEAD(b"short")
+
+
+def test_keystore_roundtrip_through_pure_path(monkeypatch):
+    monkeypatch.setattr(ks, "Cipher", None)  # force the fallback
+    sk = tbls.generate_secret_key()
+    store = ks.encrypt(sk, "hunter2", insecure=True)
+    assert store["version"] == 4
+    assert bytes(ks.decrypt(store, "hunter2")) == bytes(sk)

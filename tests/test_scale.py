@@ -90,6 +90,8 @@ def test_40_validator_dkg(tmp_path):
 
 @pytest.mark.scale
 @pytest.mark.nightly
+@pytest.mark.slow  # >3 min of 4-process epoch wall clock; the verify
+                   # tier's -m "not slow" overrides the nightly exclusion
 def test_1000_validator_4_process_epoch_success_rate(tmp_path):
     """1000 DVs, 4 REAL node processes (multi-process compose — one Python
     process per node, the production deployment shape), one epoch with the
@@ -134,6 +136,7 @@ def test_1000_validator_4_process_epoch_success_rate(tmp_path):
 
 @pytest.mark.scale
 @pytest.mark.nightly
+@pytest.mark.slow  # same budget reasoning as the 1000-validator run above
 def test_2000_validator_4_process_epoch_success_rate(tmp_path):
     """BASELINE config 5 at its STATED scale (round-4 verdict item 5):
     2000 DVs, 4 real node processes, one epoch with the production
