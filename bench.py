@@ -85,6 +85,48 @@ def _log_micro(t_slot: float, times: list[float], cpu_throughput:
           f"({rec['val_per_s']} val/s) @ {commit}", file=sys.stderr)
 
 
+def _enable_compile_cache() -> None:
+    """Persistent JAX compilation cache (utils/jaxcache): BENCH_r05 paid
+    11-14 s of setup per attempt re-compiling the same fused graphs; with
+    the cache warm only the first attempt compiles."""
+    from charon_tpu.utils import jaxcache
+
+    cache = jaxcache.enable()
+    if cache:
+        print(f"# compile cache: {cache}", file=sys.stderr)
+
+
+def _phase_quantiles() -> dict[str, dict[str, float]]:
+    """Per-phase (pack/execute/finish/drain) p50/p99/count of the fused
+    dispatch histogram, read from the SAME production registry /metrics
+    serves. Keys are the phase labels; values round to ms resolution."""
+    import re
+
+    from charon_tpu.utils import metrics
+
+    out: dict[str, dict[str, float]] = {}
+    for name, stats in metrics.snapshot_quantiles(
+            "ops_device_dispatch_seconds").items():
+        m = re.search(r'phase="([^"]+)"', name)
+        if m is None or not stats["count"]:
+            continue
+        out[m.group(1)] = {"p50_s": round(stats["p50"], 4),
+                           "p99_s": round(stats["p99"], 4),
+                           "count": stats["count"]}
+    return out
+
+
+def _print_phases(phases: dict[str, dict[str, float]]) -> None:
+    """One phase-breakdown line next to the steady-state number: shows
+    WHERE a slot is bound (pipelined runs should show finish overlapped —
+    its p50 no longer added to the per-slot critical path)."""
+    if not phases:
+        return
+    parts = [f"{ph} p50 {s['p50_s'] * 1e3:.0f}ms/p99 {s['p99_s'] * 1e3:.0f}ms"
+             for ph, s in sorted(phases.items())]
+    print("# dispatch phases: " + ", ".join(parts), file=sys.stderr)
+
+
 def _flight_recorder_dump(trace_path: str = "bench-trace.json") -> None:
     """Emit the run's flight-recorder artifacts: ONE Chrome-trace file of
     every span the run produced (loadable in Perfetto / chrome://tracing)
@@ -145,6 +187,7 @@ def _warm_and_median3(tpu, batches, pubkeys, datas):
 
 
 def _measure(cpu_only: bool) -> None:
+    _enable_compile_cache()
     from charon_tpu.tbls.native_impl import NativeImpl
     from charon_tpu.tbls.tpu_impl import TPUImpl
 
@@ -221,12 +264,15 @@ def _measure(cpu_only: bool) -> None:
         done += pipe.submit(byte_batches, pk_bytes, datas)
     done += pipe.drain()
     t_pipe = (time.time() - t0) / K
+    pipe.close()
     for aggs_p, ok_p in done:
         assert ok_p, "pipelined slot verification failed"
     aggs_p, _ok = done[-1]
     assert aggs_p[:CPU_SAMPLE] == [bytes(a) for a in cpu_aggs[:CPU_SAMPLE]]
     print(f"# pipelined steady state: {K} slots, {t_pipe:.2f}s/slot "
           f"(single-call p50 {t_slot:.2f}s)", file=sys.stderr)
+    phases = _phase_quantiles()
+    _print_phases(phases)
 
     # PlaneStore steady state: a FIXED peer set must be pure cache hits
     # after slot 1 — zero decompress dispatches across the timed slots.
@@ -250,6 +296,11 @@ def _measure(cpu_only: bool) -> None:
         "value": round(device_throughput, 2),
         "unit": "validators/sec",
         "vs_baseline": round(device_throughput / cpu_throughput, 2),
+        # where each run is bound: per-phase latency next to the headline
+        # number so the trajectory files capture pack/execute/finish/drain
+        "slot_s": round(t_slot, 4),
+        "pipelined_slot_s": round(t_pipe, 4),
+        "phases": phases,
     }))
 
 
@@ -258,6 +309,7 @@ def _micro() -> None:
     1000×4-of-6 fused dispatch the official bench medians, without the
     pipelined protocol or subprocess wrapper — ~1 min warm, for per-commit
     regression points between official rounds."""
+    _enable_compile_cache()
     from charon_tpu.tbls.native_impl import NativeImpl
     from charon_tpu.tbls.tpu_impl import TPUImpl
 
@@ -267,11 +319,14 @@ def _micro() -> None:
     datas = [msg] * N_VALIDATORS
     t_slot, times, _aggs = _warm_and_median3(tpu, batches, pubkeys, datas)
     _log_micro(t_slot, times, None, tag="micro")
+    phases = _phase_quantiles()
+    _print_phases(phases)
     print(json.dumps({
         "metric": "micro: fused 1k-validator aggregate+verify dispatch",
         "value": round(t_slot, 4),
         "unit": "seconds",
         "vs_baseline": round(N_VALIDATORS / t_slot, 1),
+        "phases": phases,
     }))
 
 

@@ -31,6 +31,12 @@ def main() -> None:
     import jax
     import jax.numpy as jnp
 
+    from charon_tpu.utils import jaxcache
+
+    cache_dir = jaxcache.enable()
+    if cache_dir:
+        print(f"# jax compile cache: {cache_dir}", file=sys.stderr)
+
     from charon_tpu.tbls.native_impl import NativeImpl
     from charon_tpu.ops import pallas_plane as PP
     from charon_tpu.ops import plane_agg as PA
@@ -176,12 +182,24 @@ def main() -> None:
               f"p99 {stats['p99'] * 1e3:.1f}ms n={stats['count']:.0f}",
               file=sys.stderr)
 
+    # per-phase view of the fused-slot dispatch histogram (pack / execute /
+    # drain / finish), same shape as bench.py's "phases" JSON key
+    import re as _re
+    phases = {}
+    for name, stats in quantiles.items():
+        m = _re.search(r'phase="([^"]+)"', name)
+        if m and name.startswith("ops_device_dispatch_seconds"):
+            phases[m.group(1)] = {"p50_s": stats["p50"],
+                                  "p99_s": stats["p99"],
+                                  "count": stats["count"]}
+
     print(json.dumps({
         "stages": {k: round(v, 3) for k, v in stages.items()},
         # hit/miss/decompress counters show whether ver.pk_plane_cached
         # above was a PlaneStore hit (steady state) or paid a decode
         "planestore": STORE.stats(),
         "latency_quantiles": quantiles,
+        "phases": phases,
         "trace_file": trace_path,
         "throughput": round(N / (stages["agg.total"] + stages["ver.total"]),
                             1)}))

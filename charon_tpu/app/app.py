@@ -77,6 +77,9 @@ class Config:
     consensus_type: str = "qbft"
     loki_endpoint: str = ""  # push logs to Loki when set (utils/loki.py)
     otlp_endpoint: str = ""  # export trace spans via OTLP/HTTP (utils/otlp.py)
+    # persistent JAX compilation cache location (utils/jaxcache.enable);
+    # None/"" -> JAX_COMPILATION_CACHE_DIR or <repo>/.jax_cache
+    jax_cache_dir: str | None = None
     test: TestConfig = field(default_factory=TestConfig)
 
 
@@ -201,6 +204,11 @@ def _select_tbls_backend(config: Config) -> None:
 
 async def assemble(config: Config) -> App:
     """Build (but do not start) a node from config + disk state."""
+    # persistent compile cache BEFORE any device work: the fused sigagg
+    # graphs cost 20s-4min to compile and are identical run to run
+    from ..utils import jaxcache
+
+    jaxcache.enable(config.jax_cache_dir or None)
     _select_tbls_backend(config)
     test = config.test
     privkey_lock = None

@@ -122,6 +122,11 @@ def default_checks(quorum_peers: int,
               f"duty end-to-end p99 above the {slot_seconds:.0f}s slot time",
               lambda w: w.histogram_quantile(
                   "core_duty_e2e_latency_seconds") > slot_seconds),
+        Check("sigagg_finish_backlog_high",
+              "sigagg stage-3 host-finish backlog persistently above the "
+              "pipeline depth (finish stage is the pipeline bound — widen "
+              "CHARON_TPU_FINISH_WORKERS or profile the finish phase)",
+              lambda w: w.gauge_sum("ops_sigagg_finish_backlog") > 4),
         Check("high_error_log_rate", "more than 5 error logs in the window",
               lambda w: w.counter_delta("log_messages_total", "error") > 5),
         Check("high_warning_log_rate", "more than 10 warning logs in the window",

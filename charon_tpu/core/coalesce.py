@@ -233,13 +233,16 @@ class TblsCoalescer:
         batches = [b for p in payloads for b in p[0]]
         pks = [k for p in payloads for k in p[1]]
         roots = [r for p in payloads for r in p[2]]
-        # the OVERLAPPED facade: consecutive flushes run on different
-        # executor threads, and the TPU backend's dispatch pipeline locks
-        # only the host pack — so flush N+1 packs its buffers while flush
-        # N's fused graph executes on device (double-buffered dispatch)
-        sigs, ok = await loop.run_in_executor(
-            None, tbls.threshold_aggregate_verify_overlapped,
+        # the SUBMIT facade: the executor hop covers only the host pack —
+        # threshold_aggregate_verify_submit returns a Future once the slot
+        # is dispatched, and the pipeline's stage-3 worker resolves it
+        # after device execute + host finish. The default-executor thread
+        # is back in the pool while the device runs, so flush N+1 packs
+        # (and N's finish computes) while flush N's fused graph executes.
+        pipe_fut = await loop.run_in_executor(
+            None, tbls.threshold_aggregate_verify_submit,
             batches, pks, roots)
+        sigs, ok = await asyncio.wrap_future(pipe_fut)
         off = 0
         slices = []
         for p in payloads:

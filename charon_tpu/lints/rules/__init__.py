@@ -6,13 +6,14 @@ from .aio import UntrackedTaskRule
 from .exc import BroadExceptRule
 from .iface import ProtocolImplRule
 from .obs import DutySpanRule
-from .tpu import DeviceDtypeRule, PlaneStoreRoutingRule
+from .tpu import DeviceDtypeRule, PipelineLockSyncRule, PlaneStoreRoutingRule
 
 __all__ = [
     "UntrackedTaskRule",
     "BroadExceptRule",
     "DeviceDtypeRule",
     "PlaneStoreRoutingRule",
+    "PipelineLockSyncRule",
     "ProtocolImplRule",
     "DutySpanRule",
     "default_rules",
@@ -25,6 +26,7 @@ def default_rules() -> list:
         BroadExceptRule(),
         DeviceDtypeRule(),
         PlaneStoreRoutingRule(),
+        PipelineLockSyncRule(),
         ProtocolImplRule(),
         DutySpanRule(),
     ]

@@ -27,6 +27,16 @@ plane-builder calls whose first argument mentions a pubkey-hinted name,
 except inside the store itself, inside the decode layer the store calls
 (`g1_plane_from_compressed` and its device half), or inside a callback
 handed to `STORE.host_entry` (that IS the sanctioned routing).
+
+LINT-TPU-007 (PipelineLockSyncRule) — no device sync while holding
+`SigAggPipeline._lock`. The pipeline lock covers ONLY the host
+pack+dispatch; a `jax.device_get(...)` or `jax.block_until_ready(...)`
+(or method-form `.block_until_ready()`) lexically inside a
+`with ..._lock:` body of a SigAggPipeline class would serialize every
+concurrent submitter's pack behind one slot's device wait — exactly the
+stall the three-stage pipeline exists to remove. Code inside nested
+function definitions/lambdas is exempt (it runs later, off the lock —
+the stage-3 executor scheduling shape).
 """
 
 from __future__ import annotations
@@ -297,3 +307,77 @@ class PlaneStoreRoutingRule:
             if name and any(h in name.lower() for h in _PK_HINTS):
                 return name
         return None
+
+
+_PIPELINE_CLASS = "SigAggPipeline"
+_DEVICE_SYNCS = ("device_get", "block_until_ready")
+
+
+def _walk_same_frame(node: ast.AST) -> Iterable[ast.AST]:
+    """ast.walk that does NOT descend into nested function definitions or
+    lambdas — their bodies run later, off the current lock."""
+    for child in ast.iter_child_nodes(node):
+        if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.Lambda)):
+            continue
+        yield child
+        yield from _walk_same_frame(child)
+
+
+class PipelineLockSyncRule:
+    id = "LINT-TPU-007"
+    description = ("no jax.device_get/jax.block_until_ready while holding "
+                   "SigAggPipeline._lock — the lock covers host "
+                   "pack+dispatch only; device waits run outside it")
+
+    def check(self, src: SourceFile) -> Iterable[Finding]:
+        if not src.in_dir(*_SCOPE):
+            return
+        _np_al, _jnp_al, jax_al = _aliases(src.tree)
+        for cls in ast.walk(src.tree):
+            if not (isinstance(cls, ast.ClassDef)
+                    and cls.name == _PIPELINE_CLASS):
+                continue
+            for w in ast.walk(cls):
+                if not isinstance(w, ast.With):
+                    continue
+                if not any(self._is_lock_expr(i.context_expr)
+                           for i in w.items):
+                    continue
+                yield from self._sync_calls(src, w, jax_al)
+
+    @staticmethod
+    def _is_lock_expr(expr: ast.expr) -> bool:
+        for sub in ast.walk(expr):
+            name = None
+            if isinstance(sub, ast.Attribute):
+                name = sub.attr
+            elif isinstance(sub, ast.Name):
+                name = sub.id
+            if name is not None and name.endswith("_lock"):
+                return True
+        return False
+
+    def _sync_calls(self, src: SourceFile, with_node: ast.With,
+                    jax_al: set[str]) -> Iterable[Finding]:
+        for stmt in with_node.body:
+            for sub in [stmt, *_walk_same_frame(stmt)]:
+                if not (isinstance(sub, ast.Call)
+                        and isinstance(sub.func, ast.Attribute)):
+                    continue
+                attr = sub.func.attr
+                if attr not in _DEVICE_SYNCS:
+                    continue
+                is_jax_mod = (isinstance(sub.func.value, ast.Name)
+                              and sub.func.value.id in jax_al)
+                # jax.device_get/jax.block_until_ready, or the method form
+                # x.block_until_ready() on any array handle
+                if not is_jax_mod and attr != "block_until_ready":
+                    continue
+                callee = (f"jax.{attr}" if is_jax_mod else f".{attr}")
+                yield Finding(
+                    src.rel, sub.lineno, self.id,
+                    f"`{callee}(...)` while holding {_PIPELINE_CLASS}._lock "
+                    "serializes every concurrent submit's pack behind this "
+                    "slot's device wait; fence/readback must run after the "
+                    "lock is released (the stage-2→3 seam)")

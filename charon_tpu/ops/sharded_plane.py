@@ -230,10 +230,10 @@ def threshold_aggregate_and_verify_sharded(
     # RLC randomizers: global per validator, chunked per device; padding
     # lanes carry zero (infinity contributions)
     if rs is None:
-        rs = [PA.sample_randomizer() for _ in range(V)]
+        rs = PA.sample_randomizers(V)
     rdig = np.stack([
         PP.scalars_to_digitplanes(
-            list(rs[d * Vd:(d + 1) * Vd]), Vp, nbits=PA.RLC_BITS)
+            rs[d * Vd:(d + 1) * Vd], Vp, nbits=PA.RLC_BITS)
         for d in range(D)])
 
     # distinct-message groups (global, static per compile, padded to a

@@ -38,6 +38,7 @@ __all__ = [
     "threshold_aggregate_batch",
     "threshold_aggregate_verify_batch",
     "threshold_aggregate_verify_overlapped",
+    "threshold_aggregate_verify_submit",
     "pin_pubkeys",
     "sign",
     "verify",
@@ -169,6 +170,32 @@ def threshold_aggregate_verify_overlapped(
         return impl.threshold_aggregate_verify_batch(
             batches, public_keys, datas)
     return fn(batches, public_keys, datas)
+
+
+def threshold_aggregate_verify_submit(
+        batches: list[dict[int, Signature]], public_keys: list[PublicKey],
+        datas: list[bytes]):
+    """Future-returning threshold_aggregate_verify: returns a
+    concurrent.futures.Future resolving to (aggregates, ok) — on the TPU
+    backend the call returns once the slot is PACKED and dispatched, and
+    the future resolves from the pipeline's stage-3 finish worker, so the
+    calling thread is free while the device executes and the host finish
+    runs. Backends without a pipeline run the serial call inline and hand
+    back an already-resolved future (identical results, no extra threads).
+    Exceptions (including input validation) surface through the future."""
+    import concurrent.futures as _cf
+
+    impl = get_implementation()
+    fn = getattr(impl, "threshold_aggregate_verify_submit", None)
+    if fn is not None:
+        return fn(batches, public_keys, datas)
+    fut: _cf.Future = _cf.Future()
+    try:
+        fut.set_result(threshold_aggregate_verify_overlapped(
+            batches, public_keys, datas))
+    except Exception as exc:  # noqa: BLE001 — future carries the error
+        fut.set_exception(exc)
+    return fut
 
 
 def pin_pubkeys(public_keys: list[PublicKey]) -> None:

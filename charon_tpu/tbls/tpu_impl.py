@@ -34,6 +34,7 @@ backends behind tbls.SetImplementation + app/featureset
 
 from __future__ import annotations
 
+import concurrent.futures as futures
 import threading
 
 import numpy as np
@@ -78,7 +79,10 @@ _PIPELINE_LOCK = threading.Lock()
 
 def _shared_pipeline():
     """Process-wide SigAggPipeline: one device, one dispatch queue — every
-    TPUImpl instance overlaps through the same double buffer."""
+    TPUImpl instance overlaps through the same three-stage pipeline (depth
+    and stage-3 executor width from plane_agg.PIPELINE_DEPTH /
+    FINISH_WORKERS, env-overridable via CHARON_TPU_PIPELINE_DEPTH /
+    CHARON_TPU_FINISH_WORKERS)."""
     global _PIPELINE
     with _PIPELINE_LOCK:
         if _PIPELINE is None:
@@ -210,6 +214,73 @@ class TPUImpl(NativeImpl):
             return NativeImpl.threshold_aggregate_verify_batch(
                 self, batches, public_keys, datas)
         return [Signature(r) for r in raw], ok
+
+    def _resolved(self, call) -> futures.Future:
+        """Run `call` inline and wrap its outcome in a resolved Future —
+        the no-pipeline shape of the submit path."""
+        fut: futures.Future = futures.Future()
+        try:
+            fut.set_result(call())
+        except Exception as exc:  # noqa: BLE001 — future carries the error
+            fut.set_exception(exc)
+        return fut
+
+    def threshold_aggregate_verify_submit(self, batches, public_keys,
+                                          datas) -> futures.Future:
+        """Future-returning fused sigagg: pack + dispatch on the CALLING
+        thread (so input-validation surfaces eagerly through the future
+        and ordering follows call order), then resolve from the pipeline's
+        stage-3 finish worker. Sub-threshold/deviceless batches run the
+        serial entry point inline and return an already-resolved future.
+        The device-fault fallback policy matches the blocking entry
+        points: a _DEVICE_RUNTIME_ERRORS failure (at dispatch OR surfacing
+        through the finish) degrades to the native CPU path instead of
+        failing the duty."""
+        n = len(batches)
+        if not (n == len(public_keys) == len(datas)):
+            raise ValueError("length mismatch")
+        if n < self.min_device_batch or not _on_device():
+            return self._resolved(
+                lambda: self.threshold_aggregate_verify_batch(
+                    batches, public_keys, datas))
+        for b in batches:
+            if not b:
+                raise ValueError("no partial signatures to aggregate")
+        try:
+            inner = _shared_pipeline().submit_async(
+                [{i: bytes(s) for i, s in b.items()} for b in batches],
+                [bytes(pk) for pk in public_keys], [bytes(d) for d in datas])
+        except _DEVICE_RUNTIME_ERRORS as exc:
+            if not self.fallback_on_device_error:
+                raise
+            _warn_device_fallback("threshold_aggregate_verify_submit", exc)
+            return self._resolved(
+                lambda: NativeImpl.threshold_aggregate_verify_batch(
+                    self, batches, public_keys, datas))
+
+        out: futures.Future = futures.Future()
+
+        def _done(f: futures.Future) -> None:
+            try:
+                raw, ok = f.result()
+            except _DEVICE_RUNTIME_ERRORS as exc:
+                if not self.fallback_on_device_error:
+                    out.set_exception(exc)
+                    return
+                _warn_device_fallback("threshold_aggregate_verify_submit",
+                                      exc)
+                try:
+                    out.set_result(NativeImpl.threshold_aggregate_verify_batch(
+                        self, batches, public_keys, datas))
+                except Exception as exc2:  # noqa: BLE001 — carried by future
+                    out.set_exception(exc2)
+            except Exception as exc:  # noqa: BLE001 — carried by future
+                out.set_exception(exc)
+            else:
+                out.set_result(([Signature(r) for r in raw], ok))
+
+        inner.add_done_callback(_done)
+        return out
 
     def pin_pubkeys(self, public_keys) -> None:
         """Pin the set's decoded planes in the device PlaneStore so cache

@@ -1,0 +1,68 @@
+"""Persistent JAX compilation cache, shared by every entry point.
+
+The fused sigagg kernels take 20 s–4 min to compile; BENCH_r05 measured
+11–14 s of setup per bench attempt re-compiling the same graphs. One
+`enable()` from app startup (app.assemble honors Config.jax_cache_dir),
+bench.py/bench_stages.py, and the kernel module import
+(ops/pallas_plane.py) points them all at the same on-disk cache.
+
+Two environment quirks this module owns:
+
+  * The JAX_COMPILATION_CACHE_DIR env var alone is NOT honored under this
+    image's jax/axon combination — `jax.config.update` is, so enable()
+    always goes through the config API.
+  * The persistent cache stores XLA:CPU AOT code specialized to the
+    compile machine's features; loading it on a different host fails with
+    a wall of machine-feature-mismatch errors (this killed the round-3
+    driver artifact, MULTICHIP_r03.json). The cache therefore lands in a
+    per-machine fingerprint subdirectory — a foreign host simply starts
+    cold instead.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import pathlib
+import platform
+
+
+def machine_fingerprint() -> str:
+    """Stable fingerprint of the host's CPU capabilities (cache subdir)."""
+    sig = platform.machine()
+    try:
+        with open("/proc/cpuinfo") as f:
+            for line in f:
+                if line.startswith("flags"):
+                    sig += line
+                    break
+    except OSError:
+        sig += platform.processor() or ""
+    return hashlib.sha256(sig.encode()).hexdigest()[:12]
+
+
+def default_base() -> str:
+    """Cache base directory: JAX_COMPILATION_CACHE_DIR if set, else
+    <repo>/.jax_cache next to the package."""
+    return os.environ.get(
+        "JAX_COMPILATION_CACHE_DIR",
+        str(pathlib.Path(__file__).resolve().parents[2] / ".jax_cache"))
+
+
+def enable(path: str | None = None) -> str | None:
+    """Point jax's persistent compilation cache at `path` (default: see
+    default_base) + the machine-fingerprint subdir. Idempotent; safe to
+    call before or after the first compile. Returns the cache directory,
+    or None if the config API rejected it (cache is an optimization only
+    — never fail startup over it)."""
+    base = path or default_base()
+    cache = os.path.join(base, machine_fingerprint())
+    try:
+        import jax
+
+        jax.config.update("jax_compilation_cache_dir", cache)
+        jax.config.update("jax_persistent_cache_enable_xla_caches", "all")
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    except Exception:  # noqa: BLE001 — cache is an optimization only
+        return None
+    return cache

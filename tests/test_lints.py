@@ -291,6 +291,78 @@ def test_planestore_rule_exempts_the_store_and_other_dirs(tmp_path):
 
 
 # ---------------------------------------------------------------------------
+# LINT-TPU-007 — no device syncs under SigAggPipeline._lock
+# ---------------------------------------------------------------------------
+
+
+def test_pipeline_lock_rule_flags_sync_under_lock(tmp_path):
+    findings = lint_source(tmp_path, "ops/x.py", """\
+        import jax
+
+        class SigAggPipeline:
+            def submit(self, batches):
+                with self._lock:
+                    state = dispatch(batches)
+                    jax.block_until_ready(state)
+                    outs = jax.device_get(state)
+                return outs
+
+            def drain(self):
+                with self._lock:
+                    return self._pending.popleft().block_until_ready()
+    """)
+    assert rules_of(findings) == ["LINT-TPU-007"] * 3
+    assert "jax.block_until_ready" in findings[0].message
+    assert "jax.device_get" in findings[1].message
+    assert ".block_until_ready" in findings[2].message
+    assert "_lock" in findings[0].message
+
+
+def test_pipeline_lock_rule_accepts_sync_outside_lock_and_closures(tmp_path):
+    findings = lint_source(tmp_path, "ops/x.py", """\
+        import jax
+
+        class SigAggPipeline:
+            def submit(self, batches):
+                with self._lock:
+                    state = dispatch(batches)
+                    # scheduling a closure is fine: it runs off the lock
+                    fut = self._pool.submit(
+                        lambda: jax.device_get(state))
+                return jax.block_until_ready(fut.result())
+
+            def aggregate_verify(self, batches):
+                with self._lock:
+                    state = dispatch(batches)
+                return jax.device_get(state)
+    """)
+    assert findings == []
+
+
+def test_pipeline_lock_rule_scopes_to_pipeline_class_and_dirs(tmp_path):
+    src = """\
+        import jax
+
+        class SigAggPipeline:
+            def submit(self, s):
+                with self._lock:
+                    return jax.device_get(s)
+    """
+    other_class = """\
+        import jax
+
+        class PlaneStore:
+            def get(self, s):
+                with self._lock:
+                    return jax.device_get(s)
+    """
+    assert rules_of(lint_source(
+        tmp_path, "tbls/x.py", src)) == ["LINT-TPU-007"]
+    assert lint_source(tmp_path, "core/x.py", src) == []
+    assert lint_source(tmp_path, "ops/y.py", other_class) == []
+
+
+# ---------------------------------------------------------------------------
 # LINT-IFACE-004 — protocol implementation claims
 # ---------------------------------------------------------------------------
 

@@ -1,0 +1,381 @@
+"""Three-stage sigagg pipeline + finish-stage vectorization contracts.
+
+Covers the seams the perf PR introduced, without compiling the fused
+device graph (that is nightly-only on CPU, see test_plane_agg_e2e):
+
+* submit()/drain() FIFO result order even when stage-3 finishes complete
+  out of order on the worker executor;
+* a slow (gated) finish never blocks the next submit's pack+dispatch —
+  the overlap the three-stage split exists to buy;
+* error behavior through the async path: invalid-signature ValueErrors
+  re-raise at the submit pop / drain / submit_async future, bad_pk slots
+  degrade to (aggregates, False), and readback passes bad_pk through;
+* the ops_sigagg_finish_backlog gauge tracks in-flight finishes and
+  returns to baseline;
+* the bounded process-wide H(m) hash-to-curve cache: byte-identity with
+  the native lib, hit/miss counters, LRU bound + cap-0 disable, and
+  cached-vs-uncached _pairing_finish agreement on good and tampered
+  inputs (real native pairings);
+* bulk-numpy byte emission (_g1_emit_bytes/_g2_emit_bytes) bit-identical
+  to the per-lane loop it replaced, including sign flags and infinity
+  lanes;
+* crypto.rlc.sample_randomizers: vectorized draw shape/oddness and
+  digit-plane equality with the per-int path.
+"""
+
+import ctypes
+import threading
+import time
+from types import SimpleNamespace
+
+import numpy as np
+import pytest
+
+from charon_tpu.crypto.rlc import RLC_BITS, sample_randomizers
+from charon_tpu.crypto.serialize import g1_from_bytes, g2_from_bytes
+from charon_tpu.ops import pallas_plane as PP
+from charon_tpu.ops import plane_agg
+from charon_tpu.tbls.native_impl import NativeImpl, NativeUnavailable
+
+try:
+    _native = NativeImpl()
+except NativeUnavailable:  # pragma: no cover — toolchain present in CI
+    _native = None
+
+needs_native = pytest.mark.skipif(
+    _native is None, reason="native library unavailable")
+
+
+# ---- stage bookkeeping (stubbed dispatch/finish) --------------------------
+
+
+def _stub_stages(monkeypatch, finish):
+    """Replace the device halves with bookkeeping stubs; the pipeline
+    contract under test is pure scheduling over the split."""
+    monkeypatch.setattr(plane_agg, "_layout_slots", lambda batches: batches)
+    monkeypatch.setattr(plane_agg, "_fused_dispatch",
+                        lambda layout, pks, msgs: ("pending", layout))
+    monkeypatch.setattr(plane_agg, "_fused_finish", finish)
+
+
+def test_submit_results_fifo_despite_out_of_order_finish(monkeypatch):
+    """slot0's finish is slow and slot1's instant, so slot1 COMPLETES
+    first on the two-wide executor — but submit() still returns slot0's
+    result first: results are FIFO in dispatch order, always."""
+    delays = {"slot0": 0.15, "slot1": 0.0, "slot2": 0.0}
+    completed = []
+
+    def finish(state, hash_fn=None):
+        time.sleep(delays[state[1]])
+        completed.append(state[1])
+        return state[1]
+
+    _stub_stages(monkeypatch, finish)
+    pipe = plane_agg.SigAggPipeline(depth=1, finish_workers=2)
+    try:
+        assert pipe.submit("slot0", [], []) == []
+        assert pipe.submit("slot1", [], []) == ["slot0"]
+        assert pipe.submit("slot2", [], []) == ["slot1"]
+        assert pipe.drain() == ["slot2"]
+        assert sorted(completed) == ["slot0", "slot1", "slot2"]
+    finally:
+        pipe.close()
+
+
+def test_slow_finish_does_not_block_next_submit(monkeypatch):
+    """While slot0's stage-3 finish is provably still running (gated on
+    an Event), the next submit() must pack+dispatch and return — the
+    lock covers stage 1 only, never a finish wait."""
+    started, release = threading.Event(), threading.Event()
+    dispatched = []
+    monkeypatch.setattr(plane_agg, "_layout_slots", lambda batches: batches)
+    monkeypatch.setattr(
+        plane_agg, "_fused_dispatch",
+        lambda layout, pks, msgs: dispatched.append(layout) or
+        ("pending", layout))
+
+    def gated(state, hash_fn=None):
+        if state[1] == "slot0":
+            started.set()
+            assert release.wait(10), "test gate never released"
+        return state[1]
+
+    monkeypatch.setattr(plane_agg, "_fused_finish", gated)
+    pipe = plane_agg.SigAggPipeline(depth=2, finish_workers=2)
+    try:
+        assert pipe.submit("slot0", [], []) == []
+        assert started.wait(5), "stage-3 finish never started"
+        assert pipe.submit("slot1", [], []) == []  # no pop at depth=2
+        assert dispatched == ["slot0", "slot1"], \
+            "slot1 must dispatch while slot0's finish is still blocked"
+        release.set()
+        assert pipe.drain() == ["slot0", "slot1"]
+    finally:
+        release.set()
+        pipe.close()
+
+
+def test_invalid_signature_reraises_at_pop_and_drain(monkeypatch):
+    """An invalid-signature ValueError raised in stage 3 surfaces exactly
+    where the two-stage pipeline raised it: at the submit() that pops the
+    slot, or at drain() — and never poisons the slots around it."""
+
+    def finish(state, hash_fn=None):
+        if state[1].startswith("bad"):
+            raise ValueError(f"invalid G2 point in {state[1]}")
+        return state[1]
+
+    _stub_stages(monkeypatch, finish)
+    pipe = plane_agg.SigAggPipeline(depth=1, finish_workers=1)
+    try:
+        assert pipe.submit("bad0", [], []) == []
+        with pytest.raises(ValueError, match="bad0"):
+            pipe.submit("ok1", [], [])  # the pop of bad0 re-raises
+        assert pipe.drain() == ["ok1"], "ok slot survives a bad neighbor"
+        assert pipe.submit("bad2", [], []) == []
+        with pytest.raises(ValueError, match="bad2"):
+            pipe.drain()
+        assert pipe.drain() == []
+    finally:
+        pipe.close()
+
+
+def test_submit_async_future_owns_result_and_exception(monkeypatch):
+    """submit_async returns THIS slot's future: errors arrive as the
+    future's exception, bad_pk degradation as a (aggregates, False)
+    value — and over-depth backpressure never consumes another slot's
+    result."""
+
+    def finish(state, hash_fn=None):
+        if state[1] == "boom":
+            raise ValueError("invalid G2 point in boom")
+        if state[1] == "badpk":
+            return (state[1], False)
+        return (state[1], True)
+
+    _stub_stages(monkeypatch, finish)
+    pipe = plane_agg.SigAggPipeline(depth=1, finish_workers=1)
+    try:
+        f0 = pipe.submit_async("boom", [], [])
+        # blocks until f0 settles (depth=1) but must NOT consume it
+        f1 = pipe.submit_async("badpk", [], [])
+        f2 = pipe.submit_async("ok", [], [])
+        assert isinstance(f0.exception(timeout=5), ValueError)
+        assert f1.result(timeout=5) == ("badpk", False)
+        assert f2.result(timeout=5) == ("ok", True)
+    finally:
+        pipe.close()
+
+
+def test_fused_readback_passes_bad_pk_through():
+    """bad_pk states have no device work: readback is the identity and
+    tags the span so the trace shows the degraded outcome."""
+    state = ("bad_pk", "layout-sentinel")
+    span = SimpleNamespace(attrs={})
+    assert plane_agg._fused_readback(state, span) is state
+    assert span.attrs["outcome"] == "bad_pk"
+
+
+def test_host_finish_invalid_lane_raises_through_executor(monkeypatch):
+    """The REAL _fused_finish/_fused_host_finish pair runs on the worker:
+    an ok-mask with a bad lane raises the same indexed ValueError as the
+    serial path, delivered through the slot's future."""
+    host = (np.array([True, False, True]), None, None, None, None, None)
+    monkeypatch.setattr(plane_agg, "_layout_slots", lambda batches: batches)
+    monkeypatch.setattr(plane_agg, "_fused_dispatch",
+                        lambda layout, pks, msgs: ("pending", layout))
+    monkeypatch.setattr(plane_agg, "_fused_readback",
+                        lambda state, span=None: ("host", 3, [], host))
+    pipe = plane_agg.SigAggPipeline(depth=1, finish_workers=1)
+    try:
+        fut = pipe.submit_async("slot", [], [])
+        exc = fut.exception(timeout=5)
+        assert isinstance(exc, ValueError)
+        assert "index 1" in str(exc)
+    finally:
+        pipe.close()
+
+
+def test_finish_backlog_gauge_tracks_in_flight(monkeypatch):
+    """ops_sigagg_finish_backlog counts scheduled-but-unfinished stage-3
+    slots (what the sigagg_finish_backlog_high health rule reads) and
+    returns to baseline once everything drains."""
+    release = threading.Event()
+
+    def finish(state, hash_fn=None):
+        assert release.wait(10), "test gate never released"
+        return state[1]
+
+    _stub_stages(monkeypatch, finish)
+    base = plane_agg._finish_backlog.value()
+    pipe = plane_agg.SigAggPipeline(depth=4, finish_workers=1)
+    try:
+        for i in range(3):
+            assert pipe.submit(f"slot{i}", [], []) == []
+        assert plane_agg._finish_backlog.value() == base + 3
+        release.set()
+        assert pipe.drain() == ["slot0", "slot1", "slot2"]
+        assert plane_agg._finish_backlog.value() == base
+    finally:
+        release.set()
+        pipe.close()
+
+
+# ---- H(m) hash-to-curve cache --------------------------------------------
+
+
+@pytest.fixture
+def h2c():
+    """Empty H(m) cache for the test; restores prior cap + contents."""
+    with plane_agg._h2c_lock:
+        saved = dict(plane_agg._h2c_cache)
+        plane_agg._h2c_cache.clear()
+    prev_cap = plane_agg._H2C_CAP
+    yield plane_agg._h2c_cache
+    plane_agg.set_h2c_cache_cap(prev_cap)
+    with plane_agg._h2c_lock:
+        plane_agg._h2c_cache.clear()
+        plane_agg._h2c_cache.update(saved)
+
+
+@needs_native
+def test_hash_to_g2_cache_hit_miss_and_byte_identity(h2c):
+    msg = b"\x11" * 32
+    miss0 = plane_agg._h2c_counter.value("miss")
+    hit0 = plane_agg._h2c_counter.value("hit")
+    first = plane_agg.hash_to_g2_cached(msg)
+    second = plane_agg.hash_to_g2_cached(msg)
+    assert first == second and len(first) == 96
+    assert plane_agg._h2c_counter.value("miss") == miss0 + 1
+    assert plane_agg._h2c_counter.value("hit") == hit0 + 1
+    # a hit is byte-identical to a fresh native recompute
+    out96 = (ctypes.c_uint8 * 96)()
+    plane_agg._native_lib().ct_hash_to_g2(msg, len(msg), out96)
+    assert first == bytes(out96)
+
+
+@needs_native
+def test_hash_to_g2_cache_lru_bound_and_disable(h2c):
+    assert plane_agg.set_h2c_cache_cap(2) >= 0  # returns the previous cap
+    m1, m2, m3, m4 = (bytes([i]) * 32 for i in (1, 2, 3, 4))
+    for m in (m1, m2, m3):
+        plane_agg.hash_to_g2_cached(m)
+    assert set(plane_agg._h2c_cache) == {m2, m3}, "oldest entry evicted"
+    plane_agg.hash_to_g2_cached(m2)  # hit promotes m2 to MRU
+    plane_agg.hash_to_g2_cached(m4)  # so this evicts m3, not m2
+    assert set(plane_agg._h2c_cache) == {m2, m4}
+    miss0 = plane_agg._h2c_counter.value("miss")
+    plane_agg.hash_to_g2_cached(m1)  # evicted → fresh miss
+    assert plane_agg._h2c_counter.value("miss") == miss0 + 1
+
+    assert plane_agg.set_h2c_cache_cap(0) == 2
+    miss1 = plane_agg._h2c_counter.value("miss")
+    plane_agg.hash_to_g2_cached(m1)
+    plane_agg.hash_to_g2_cached(m1)
+    assert plane_agg._h2c_counter.value("miss") == miss1 + 2
+    assert len(plane_agg._h2c_cache) == 0, "cap 0 disables caching"
+
+
+@needs_native
+def test_pairing_finish_cached_matches_uncached(h2c):
+    """_pairing_finish through the cache agrees with the uncached path on
+    a known-good batch AND a tampered one — real native pairings."""
+    sk = _native.generate_secret_key()
+    P = g1_from_bytes(bytes(_native.secret_to_public_key(sk)))
+    msg, wrong = b"\x5a" * 32, b"\x5b" * 32
+    S = g2_from_bytes(bytes(_native.sign(sk, msg)))
+
+    plane_agg.set_h2c_cache_cap(0)  # uncached reference
+    assert plane_agg._pairing_finish(S, [(msg, P)]) is True
+    assert plane_agg._pairing_finish(S, [(wrong, P)]) is False
+
+    plane_agg.set_h2c_cache_cap(64)
+    assert plane_agg._pairing_finish(S, [(msg, P)]) is True  # miss
+    hit0 = plane_agg._h2c_counter.value("hit")
+    assert plane_agg._pairing_finish(S, [(msg, P)]) is True  # hit
+    assert plane_agg._h2c_counter.value("hit") == hit0 + 1
+    assert plane_agg._pairing_finish(S, [(wrong, P)]) is False
+
+
+# ---- vectorized byte emission --------------------------------------------
+
+
+def _limbs_to_int(limbs) -> int:
+    return sum(int(limbs[j]) << (12 * j) for j in range(PP.LIMBS))
+
+
+def _ref_compressed(raw: bytes, sign: bool, inf: bool) -> bytes:
+    """The per-lane reference loop _stamp_flags replaced."""
+    if inf:
+        return b"\xc0" + bytes(len(raw) - 1)
+    out = bytearray(raw)
+    out[0] |= 0x80 | (0x20 if sign else 0)
+    return bytes(out)
+
+
+def test_g2_emit_bytes_matches_per_lane_reference():
+    V = 11
+    rng = np.random.default_rng(7)
+    limbs = rng.integers(0, 1 << 12, size=(V, 2, PP.LIMBS), dtype=np.int32)
+    Bp = PP.pad_batch(V)
+    sign = np.zeros(Bp, bool)
+    inf = np.zeros(Bp, bool)
+    sign[[0, 3, 7]] = True
+    inf[[2, 7]] = True  # lane 7: infinity wins over sign
+    plane = PP.to_plane(limbs, 2)
+
+    got = plane_agg._g2_emit_bytes(plane, sign, inf, V)
+    want = [
+        _ref_compressed(
+            _limbs_to_int(limbs[i, 1]).to_bytes(48, "big") +
+            _limbs_to_int(limbs[i, 0]).to_bytes(48, "big"),
+            bool(sign[i]), bool(inf[i]))
+        for i in range(V)]
+    assert got == want
+
+
+def test_g1_emit_bytes_matches_per_lane_reference():
+    V = 9
+    rng = np.random.default_rng(13)
+    limbs = rng.integers(0, 1 << 12, size=(V, PP.LIMBS), dtype=np.int32)
+    Bp = PP.pad_batch(V)
+    sign = np.zeros(Bp, bool)
+    inf = np.zeros(Bp, bool)
+    sign[[1, 4]] = True
+    inf[[5]] = True
+    plane = PP.to_plane(limbs, 1)
+
+    got = plane_agg._g1_emit_bytes(plane, sign, inf, V)
+    want = [
+        _ref_compressed(_limbs_to_int(limbs[i]).to_bytes(48, "big"),
+                        bool(sign[i]), bool(inf[i]))
+        for i in range(V)]
+    assert got == want
+
+
+# ---- vectorized randomizer draw ------------------------------------------
+
+
+def test_sample_randomizers_shape_and_oddness():
+    rs = sample_randomizers(33)
+    assert rs.shape == (33,)
+    if RLC_BITS == 64:
+        assert rs.dtype == np.uint64
+    assert all(int(r) & 1 for r in rs), "randomizers must be odd"
+    assert all(int(r) < (1 << RLC_BITS) for r in rs)
+    assert sample_randomizers(0).shape == (0,)
+
+
+def test_sample_randomizers_digitplanes_match_int_path():
+    """The ndarray fast path through scalars_to_bitplanes must produce
+    bit-identical planes to the per-int bytes path the device consumed
+    before — the dispatch feeds these straight into the fused graph."""
+    rs = sample_randomizers(17)
+    as_ints = [int(r) for r in rs]
+    B = 17
+    np.testing.assert_array_equal(
+        PP.scalars_to_bitplanes(rs, B, nbits=RLC_BITS),
+        PP.scalars_to_bitplanes(as_ints, B, nbits=RLC_BITS))
+    np.testing.assert_array_equal(
+        PP.scalars_to_digitplanes(rs, B, nbits=RLC_BITS),
+        PP.scalars_to_digitplanes(as_ints, B, nbits=RLC_BITS))
