@@ -96,17 +96,20 @@ def _enable_compile_cache() -> None:
         print(f"# compile cache: {cache}", file=sys.stderr)
 
 
-def _phase_quantiles() -> dict[str, dict[str, float]]:
-    """Per-phase (pack/execute/finish/drain) p50/p99/count of the fused
-    dispatch histogram, read from the SAME production registry /metrics
-    serves. Keys are the phase labels; values round to ms resolution."""
+def _phase_quantiles(
+        hist: str = "ops_device_dispatch_seconds",
+) -> dict[str, dict[str, float]]:
+    """Per-phase (pack/execute/finish/drain) p50/p99/count of a
+    phase-labelled latency histogram, read from the SAME production
+    registry /metrics serves. Keys are the phase labels; values round to
+    ms resolution. Pass `ops_sigagg_shard_seconds` for the per-shard
+    pack/transfer breakdown of a multi-device slot."""
     import re
 
     from charon_tpu.utils import metrics
 
     out: dict[str, dict[str, float]] = {}
-    for name, stats in metrics.snapshot_quantiles(
-            "ops_device_dispatch_seconds").items():
+    for name, stats in metrics.snapshot_quantiles(hist).items():
         m = re.search(r'phase="([^"]+)"', name)
         if m is None or not stats["count"]:
             continue
@@ -290,6 +293,8 @@ def _measure(cpu_only: bool) -> None:
     _flight_recorder_dump()
 
     device_throughput = N_VALIDATORS / min(t_pipe, t_slot)
+    from charon_tpu.ops import mesh as mesh_mod
+
     print(json.dumps({
         "metric": "partial-sig verify+aggregate throughput "
                   "(1k validators, 4-of-6)",
@@ -301,6 +306,10 @@ def _measure(cpu_only: bool) -> None:
         "slot_s": round(t_slot, 4),
         "pipelined_slot_s": round(t_pipe, 4),
         "phases": phases,
+        # mesh shape this run sharded over (1 = single-device path) and the
+        # per-shard pack/transfer quantiles — empty on a 1-device run
+        "n_devices": mesh_mod.device_count(),
+        "shard_phases": _phase_quantiles("ops_sigagg_shard_seconds"),
     }))
 
 
@@ -321,12 +330,16 @@ def _micro() -> None:
     _log_micro(t_slot, times, None, tag="micro")
     phases = _phase_quantiles()
     _print_phases(phases)
+    from charon_tpu.ops import mesh as mesh_mod
+
     print(json.dumps({
         "metric": "micro: fused 1k-validator aggregate+verify dispatch",
         "value": round(t_slot, 4),
         "unit": "seconds",
         "vs_baseline": round(N_VALIDATORS / t_slot, 1),
         "phases": phases,
+        "n_devices": mesh_mod.device_count(),
+        "shard_phases": _phase_quantiles("ops_sigagg_shard_seconds"),
     }))
 
 

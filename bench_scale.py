@@ -22,8 +22,33 @@ import time
 
 
 def _emit(name, value, unit, **extra):
+    # every record carries the resolved sigagg mesh width so BASELINE.md
+    # rows are attributable to a device topology (1 = single-device path)
+    from charon_tpu.ops import mesh as mesh_mod
+
     print(json.dumps({"config": name, "value": round(value, 2), "unit": unit,
+                      "n_devices": mesh_mod.device_count(),
                       **extra}), flush=True)
+
+
+def _shard_phases() -> dict[str, dict[str, float]]:
+    """Per-shard pack/transfer p50/p99 of `ops_sigagg_shard_seconds` —
+    empty on a single-device run (the histogram only fills on the sharded
+    dispatch path). Same registry/idiom as bench.py's _phase_quantiles."""
+    import re
+
+    from charon_tpu.utils import metrics
+
+    out: dict[str, dict[str, float]] = {}
+    for name, stats in metrics.snapshot_quantiles(
+            "ops_sigagg_shard_seconds").items():
+        m = re.search(r'phase="([^"]+)"', name)
+        if m is None or not stats["count"]:
+            continue
+        out[m.group(1)] = {"p50_s": round(stats["p50"], 4),
+                           "p99_s": round(stats["p99"], 4),
+                           "count": stats["count"]}
+    return out
 
 
 def _warm(fn, attempts: int = 4):
@@ -88,7 +113,7 @@ def bench_sigagg100() -> None:
         lambda: tpu.threshold_aggregate_verify_batch(batches, pks, datas))
     _emit("sigagg 100DV 4-of-6 agg+verify", 100 / t_dev, "validators/sec",
           cpu_s=round(t_cpu, 3), device_s=round(t_dev, 3),
-          vs_cpu=round(t_cpu / t_dev, 2))
+          vs_cpu=round(t_cpu / t_dev, 2), shard_phases=_shard_phases())
 
     # The realistic 100-DV slot: attestation + sync-committee duties land
     # together and share ONE fused device dispatch through the batching
@@ -119,7 +144,7 @@ def bench_sigagg100() -> None:
     t_cpu2 = t_cpu * 2  # two duties' worth of the serial CPU baseline
     _emit("sigagg 100DV coalesced 2-duty slot", 200 / t_slot,
           "validators/sec", device_s=round(t_slot, 3),
-          vs_cpu=round(t_cpu2 / t_slot, 2))
+          vs_cpu=round(t_cpu2 / t_slot, 2), shard_phases=_shard_phases())
 
 
 def bench_parsigex500() -> None:

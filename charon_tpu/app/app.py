@@ -80,6 +80,11 @@ class Config:
     # persistent JAX compilation cache location (utils/jaxcache.enable);
     # None/"" -> JAX_COMPILATION_CACHE_DIR or <repo>/.jax_cache
     jax_cache_dir: str | None = None
+    # shard-width clamp for the multi-device sigagg plane (ops/mesh.py):
+    # None leaves CHARON_TPU_SIGAGG_DEVICES / auto-discovery in charge,
+    # 1 forces the single-device path, N>1 caps the mesh at N devices
+    # (multi-tenant hosts pin it below the chip count)
+    sigagg_devices: int | None = None
     test: TestConfig = field(default_factory=TestConfig)
 
 
@@ -209,6 +214,16 @@ async def assemble(config: Config) -> App:
     from ..utils import jaxcache
 
     jaxcache.enable(config.jax_cache_dir or None)
+    if config.sigagg_devices is not None:
+        # Clamp the sigagg mesh BEFORE the tbls backend is selected: the
+        # mesh seam caches its first resolve, and coalesce/flush sizing
+        # reads device_count() at coalescer construction.
+        from ..ops import mesh as mesh_mod
+
+        mesh_mod.set_override(config.sigagg_devices)
+        _log.info("sigagg mesh width clamped",
+                  sigagg_devices=config.sigagg_devices,
+                  resolved=mesh_mod.device_count())
     _select_tbls_backend(config)
     test = config.test
     privkey_lock = None

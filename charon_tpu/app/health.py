@@ -127,6 +127,14 @@ def default_checks(quorum_peers: int,
               "pipeline depth (finish stage is the pipeline bound — widen "
               "CHARON_TPU_FINISH_WORKERS or profile the finish phase)",
               lambda w: w.gauge_sum("ops_sigagg_finish_backlog") > 4),
+        Check("sigagg_shard_width_degraded",
+              "sigagg slots dispatching narrower than the resolved mesh "
+              "(ops_sigagg_shard_width below ops_mesh_devices — slots fell "
+              "back to fewer devices than the mesh seam resolved; check for "
+              "sharded-dispatch errors or a stale CHARON_TPU_SIGAGG_DEVICES "
+              "override)",
+              lambda w: (0 < w.gauge_sum("ops_sigagg_shard_width")
+                         < w.gauge_sum("ops_mesh_devices"))),
         Check("high_error_log_rate", "more than 5 error logs in the window",
               lambda w: w.counter_delta("log_messages_total", "error") > 5),
         Check("high_warning_log_rate", "more than 10 warning logs in the window",

@@ -181,20 +181,24 @@ class TblsCoalescer:
 
     def __init__(self, window: float = 0.025, flush_at: int | None = None):
         # An EXPLICIT flush_at always wins, for both windows. The default
-        # is one plane tile: coalescing amortizes the device dispatch
-        # floor until the batch stops fitting a tile, so flushing EARLIER
-        # by count splits batches that would have shared one dispatch (a
-        # per-peer 170-sig set must not flush alone just because it
-        # crossed the device-eligibility minimum — that cost the 3-peer
-        # burst its coalescing when ver_at was min_device_verify). A
-        # tile-sized count flush can also never land below
-        # min_device_batch/min_device_verify, so a count-triggered flush
-        # always takes the device path; the window timer still bounds
-        # latency for batches that never fill.
+        # is one plane tile PER MESH DEVICE: coalescing amortizes the
+        # device dispatch floor until the batch stops fitting the mesh's
+        # combined plane, so flushing EARLIER by count splits batches that
+        # would have shared one dispatch (a per-peer 170-sig set must not
+        # flush alone just because it crossed the device-eligibility
+        # minimum — that cost the 3-peer burst its coalescing when ver_at
+        # was min_device_verify). On a sharded mesh each device holds a
+        # contiguous validator chunk, so a D-device slot only saturates at
+        # D tiles — a single-tile flush would leave D−1 devices running
+        # mostly padding. A tile-sized count flush can also never land
+        # below min_device_batch/min_device_verify, so a count-triggered
+        # flush always takes the device path; the window timer still
+        # bounds latency for batches that never fill.
         if flush_at is None:
+            from ..ops import mesh as mesh_mod
             from ..ops.pallas_plane import TILE
 
-            flush_at = TILE
+            flush_at = TILE * max(1, mesh_mod.device_count())
         self._agg = _Window("agg", window, flush_at, self._dispatch_agg)
         self._ver = _Window("verify", window, flush_at, self._dispatch_ver)
         self.flushes = 0

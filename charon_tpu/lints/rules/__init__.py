@@ -6,7 +6,8 @@ from .aio import UntrackedTaskRule
 from .exc import BroadExceptRule
 from .iface import ProtocolImplRule
 from .obs import DutySpanRule
-from .tpu import DeviceDtypeRule, PipelineLockSyncRule, PlaneStoreRoutingRule
+from .tpu import (DeviceDtypeRule, MeshTopologyRule, PipelineLockSyncRule,
+                  PlaneStoreRoutingRule)
 
 __all__ = [
     "UntrackedTaskRule",
@@ -14,6 +15,7 @@ __all__ = [
     "DeviceDtypeRule",
     "PlaneStoreRoutingRule",
     "PipelineLockSyncRule",
+    "MeshTopologyRule",
     "ProtocolImplRule",
     "DutySpanRule",
     "default_rules",
@@ -27,6 +29,7 @@ def default_rules() -> list:
         DeviceDtypeRule(),
         PlaneStoreRoutingRule(),
         PipelineLockSyncRule(),
+        MeshTopologyRule(),
         ProtocolImplRule(),
         DutySpanRule(),
     ]

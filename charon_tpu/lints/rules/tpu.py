@@ -26,7 +26,8 @@ sweep that `ops.plane_store.STORE` exists to amortize. The rule flags
 plane-builder calls whose first argument mentions a pubkey-hinted name,
 except inside the store itself, inside the decode layer the store calls
 (`g1_plane_from_compressed` and its device half), or inside a callback
-handed to `STORE.host_entry` (that IS the sanctioned routing).
+handed to `STORE.host_entry`/`STORE.sharded_entry` (those ARE the
+sanctioned routing).
 
 LINT-TPU-007 (PipelineLockSyncRule) — no device sync while holding
 `SigAggPipeline._lock`. The pipeline lock covers ONLY the host
@@ -37,6 +38,16 @@ concurrent submitter's pack behind one slot's device wait — exactly the
 stall the three-stage pipeline exists to remove. Code inside nested
 function definitions/lambdas is exempt (it runs later, off the lock —
 the stage-3 executor scheduling shape).
+
+LINT-TPU-008 (MeshTopologyRule) — device topology comes from the
+`ops/mesh.py` seam. A bare `jax.devices()` / `jax.local_devices()` /
+`jax.device_count()` / `jax.local_device_count()` anywhere else in
+charon_tpu bypasses the `CHARON_TPU_SIGAGG_DEVICES` clamp and the cached
+Mesh object (the sharded executable cache keys on mesh identity), so the
+probing module and the sigagg plane can disagree about the machine. Scope
+is the WHOLE package — not just ops/tbls — because batching knobs
+(core/coalesce) and app assembly scale off the width too; `ops/mesh.py`
+itself is the sanctioned probe and is exempt.
 """
 
 from __future__ import annotations
@@ -247,7 +258,7 @@ class PlaneStoreRoutingRule:
     id = "LINT-TPU-005"
     description = ("compressed pubkey bytes must reach plane construction "
                    "through ops.plane_store.STORE (full_plane/chunk_planes/"
-                   "host_entry), not ad-hoc decompress calls")
+                   "host_entry/sharded_entry), not ad-hoc decompress calls")
 
     def check(self, src: SourceFile) -> Iterable[Finding]:
         if not src.in_dir(*_SCOPE):
@@ -276,12 +287,14 @@ class PlaneStoreRoutingRule:
 
     @staticmethod
     def _host_entry_callbacks(tree: ast.Module) -> set[str]:
-        """Names of functions passed as arguments to `...host_entry(...)` —
-        those run exactly once per (digest, key) under the store's lock."""
+        """Names of functions passed as arguments to `...host_entry(...)`
+        or `...sharded_entry(...)` — those run exactly once per
+        (digest, key) under the store's lock."""
         names: set[str] = set()
         for node in ast.walk(tree):
             if isinstance(node, ast.Call) \
-                    and _callee_name(node.func) == "host_entry":
+                    and _callee_name(node.func) in ("host_entry",
+                                                    "sharded_entry"):
                 names.update(a.id for a in node.args
                              if isinstance(a, ast.Name))
         return names
@@ -381,3 +394,36 @@ class PipelineLockSyncRule:
                     "serializes every concurrent submit's pack behind this "
                     "slot's device wait; fence/readback must run after the "
                     "lock is released (the stage-2→3 seam)")
+
+
+_TOPOLOGY_PROBES = ("devices", "local_devices", "device_count",
+                    "local_device_count")
+
+
+class MeshTopologyRule:
+    id = "LINT-TPU-008"
+    description = ("device topology must come from ops.mesh "
+                   "(sigagg_mesh/device_count) — bare jax.devices()/"
+                   "jax.local_device_count() bypasses the "
+                   "CHARON_TPU_SIGAGG_DEVICES clamp and the cached mesh")
+
+    def check(self, src: SourceFile) -> Iterable[Finding]:
+        # whole-package scope; ops/mesh.py IS the sanctioned probe
+        if src.rel.split("/")[-1] == "mesh.py" and src.in_dir("ops"):
+            return
+        _np_al, _jnp_al, jax_al = _aliases(src.tree)
+        if not jax_al:
+            return
+        for node in ast.walk(src.tree):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in _TOPOLOGY_PROBES
+                    and isinstance(node.func.value, ast.Name)
+                    and node.func.value.id in jax_al):
+                continue
+            yield Finding(
+                src.rel, node.lineno, self.id,
+                f"`jax.{node.func.attr}()` probes device topology directly;"
+                " route through ops.mesh (sigagg_mesh/device_count) so the "
+                "CHARON_TPU_SIGAGG_DEVICES clamp applies and every slot "
+                "shares the one cached Mesh")

@@ -1,0 +1,122 @@
+"""Device-topology seam for the multi-device sigagg plane.
+
+Every production decision about HOW MANY devices the fused sigagg slot
+shards over flows through this module — nothing else in charon_tpu may
+probe `jax.devices()` / `jax.local_device_count()` directly (machine-
+checked by LINT-TPU-008). Centralizing the probe buys three things:
+
+  * one override knob: `CHARON_TPU_SIGAGG_DEVICES` clamps the shard
+    width (ops deployments pin it below the host's device count to leave
+    chips for other tenants, or to 1 to force the single-device path);
+  * one cached Mesh object: `sharded_plane._build_steps` is lru_cached
+    on the mesh, so every slot must see the SAME Mesh instance or the
+    compiled sharded executables are rebuilt per call;
+  * a robust single-device passthrough: hosts with one device (or no
+    usable jax backend at all) get `sigagg_mesh() is None`, and callers
+    keep the exact single-device `_fused_dispatch` path, bit-identical
+    to a build without this module.
+
+The `ops_mesh_devices` gauge exports the resolved width (0 = no backend)
+so the health checker can cross-check it against the width slots actually
+dispatch with (`ops_sigagg_shard_width`).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+
+from ..utils import metrics
+
+# Shard-width override: >0 clamps the mesh to min(value, local devices);
+# 1 forces the single-device passthrough. Read at first resolve — set it
+# before any sigagg dispatch (app config wires Config.sigagg_devices
+# through here before the tbls backend is selected).
+DEVICES_ENV = "CHARON_TPU_SIGAGG_DEVICES"
+
+_mesh_devices_g = metrics.gauge(
+    "ops_mesh_devices",
+    "Resolved sigagg mesh width: local devices clamped by "
+    "CHARON_TPU_SIGAGG_DEVICES (0 = no usable jax backend)")
+
+_lock = threading.Lock()
+_resolved: list = []  # [(width, mesh_or_none)] — cached after first probe
+
+
+def _discover() -> list:
+    """THE sanctioned topology probe (everything else routes through this
+    module, LINT-TPU-008). Returns [] when jax or its backend is missing/
+    broken — callers degrade to the single-device (native-fallback) path
+    instead of raising at import or assembly time."""
+    try:
+        import jax
+
+        return list(jax.devices())
+    except Exception:  # noqa: BLE001 — no backend == single-device host
+        return []
+
+
+def _resolve() -> tuple[int, object]:
+    devices = _discover()
+    n = len(devices)
+    try:
+        override = int(os.environ.get(DEVICES_ENV, "0"))
+    except ValueError:
+        override = 0
+    if override > 0:
+        n = min(n, override)
+    elif devices and devices[0].platform == "cpu":
+        # Host-platform "devices" are virtual XLA threads (the
+        # --xla_force_host_platform_device_count test meshes), not chips —
+        # never auto-shard production slots over them. CPU meshes are
+        # opt-in via CHARON_TPU_SIGAGG_DEVICES (the dryrun and the tier-1
+        # sharded tests set it); real accelerators auto-promote.
+        n = 1
+    mesh = None
+    if n > 1:
+        import numpy as np
+        from jax.sharding import Mesh
+
+        mesh = Mesh(np.asarray(devices[:n]), axis_names=("data",))
+    _mesh_devices_g.set(float(n))
+    return (max(1, n) if devices else 1, mesh)
+
+
+def device_count() -> int:
+    """Devices the sigagg plane shards over (cached; never < 1). This is
+    the scaling factor for batching knobs (core/coalesce sizes its flush
+    threshold off it) — NOT the raw host inventory."""
+    with _lock:
+        if not _resolved:
+            _resolved.append(_resolve())
+        return _resolved[0][0]
+
+
+def sigagg_mesh():
+    """The cached 1-D "data" `jax.sharding.Mesh` over the first
+    device_count() local devices, or None when only one device is usable
+    (the single-device passthrough: callers must keep the exact
+    single-device dispatch path)."""
+    with _lock:
+        if not _resolved:
+            _resolved.append(_resolve())
+        return _resolved[0][1]
+
+
+def set_override(n: int | None) -> None:
+    """Apply a configured shard-width clamp (app Config.sigagg_devices)
+    and drop the cached resolve so the next dispatch sees it. None clears
+    the override."""
+    if n is None:
+        os.environ.pop(DEVICES_ENV, None)
+    else:
+        os.environ[DEVICES_ENV] = str(int(n))
+    reset_for_testing()
+
+
+def reset_for_testing() -> None:
+    """Drop the cached mesh (tests flip DEVICES_ENV between cases). The
+    sharded _build_steps lru_cache keys on the Mesh object, so a reset
+    also makes subsequent slots recompile — production never resets."""
+    with _lock:
+        _resolved.clear()

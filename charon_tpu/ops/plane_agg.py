@@ -50,7 +50,7 @@ from ..crypto import fields as PF
 from ..utils import metrics, tracer
 from ..crypto.curve import (g1_generator, jac_add, jac_is_infinity, FqOps,
                             Fq2Ops)
-from ..crypto.rlc import RLC_BITS, sample_randomizer, sample_randomizers
+from ..crypto.rlc import RLC_BITS, sample_randomizers
 from ..crypto.serialize import g1_to_bytes, g2_to_bytes
 from . import field as F
 from . import pallas_plane as PP
@@ -77,6 +77,14 @@ _dispatch_hist = metrics.histogram(
 _finish_backlog = metrics.gauge(
     "ops_sigagg_finish_backlog",
     "SigAggPipeline slots whose stage-3 host finish has not completed")
+
+# Shard width of the most recent sigagg dispatch: 1 on the single-device
+# path, the mesh width on the sharded path. Health cross-checks this
+# against ops_mesh_devices — a mesh wider than the dispatched width means
+# slots are not being promoted onto the sharded plane.
+_shard_width = metrics.gauge(
+    "ops_sigagg_shard_width",
+    "Devices the current sigagg slot's validator axis is sharded over")
 
 
 @functools.lru_cache(maxsize=4096)
@@ -766,8 +774,36 @@ def threshold_aggregate_and_verify(batches: list[dict[int, bytes]],
         out = _serialize_aggregates(RX, RY, RZ, V)
         return out, _rlc_finish(state, hash_fn)
 
-    state = _fused_dispatch(layout, pks, msgs)
+    m = _sigagg_mesh()
+    if m is not None:
+        from . import sharded_plane
+
+        state = sharded_plane.sharded_dispatch(batches, pks, msgs, m)
+    else:
+        state = _fused_dispatch(layout, pks, msgs)
     return _fused_finish(state, hash_fn)
+
+
+def _sigagg_mesh():
+    """The production mesh seam (ops/mesh.py): a >1-device Mesh routes
+    device-path slots onto the sharded plane, None keeps the exact
+    single-device path."""
+    from . import mesh as mesh_mod
+
+    return mesh_mod.sigagg_mesh()
+
+
+def _dispatch_slot(batches, pks, msgs):
+    """Stage-1 router for SigAggPipeline: sharded pack+dispatch across the
+    mesh when ops.mesh reports >1 device, the single-device fused dispatch
+    otherwise. Both sides are pure host-work + enqueue (no device sync),
+    so the pipeline lock may cover this call (LINT-TPU-007)."""
+    m = _sigagg_mesh()
+    if m is not None:
+        from . import sharded_plane
+
+        return sharded_plane.sharded_dispatch(batches, pks, msgs, m)
+    return _fused_dispatch(_layout_slots(batches), pks, msgs)
 
 
 def _fused_dispatch(layout, pks, msgs):
@@ -782,6 +818,7 @@ def _fused_dispatch(layout, pks, msgs):
             _dispatch_hist.observe_time("pack"):
         state = _fused_dispatch_impl(layout, pks, msgs)
         span.attrs["outcome"] = state[0]
+        _shard_width.set(1.0)
         return state
 
 
@@ -823,7 +860,13 @@ def _fused_readback(state, span=None):
     "execute" phase (pure device wait — on a pipelined caller this is where
     overlap shows up as ~0); the jax.device_get transfer alone is "drain".
     Returns the host-side state for _fused_host_finish ("bad_pk" states
-    pass through untouched — there is no device work to wait for)."""
+    pass through untouched — there is no device work to wait for).
+    Sharded-plane states (tag "sharded*") delegate to
+    sharded_plane.sharded_readback — same phases, per-shard drain."""
+    if state[0].startswith("sharded"):
+        from . import sharded_plane
+
+        return sharded_plane.sharded_readback(state, span)
     if state[0] == "bad_pk":
         if span is not None:
             span.attrs["outcome"] = "bad_pk"
@@ -846,6 +889,10 @@ def _fused_host_finish(hstate, hash_fn=None):
     this on a worker thread overlapping the next slot's pack and the
     in-flight device execute. The whole body is the "finish" phase of
     ops_device_dispatch_seconds."""
+    if hstate[0].startswith("sharded"):
+        from . import sharded_plane
+
+        return sharded_plane.sharded_host_finish(hstate, hash_fn)
     if hstate[0] == "bad_pk":
         _tag, layout = hstate
         sigs_all, scalars_all, V, Vp, T, Wv = layout
@@ -886,6 +933,12 @@ def _run_finish(ctx, state, hash_fn):
 class SigAggPipeline:
     """Three-stage fused-sigagg pipeline over the _fused_dispatch /
     _fused_readback / _fused_host_finish split.
+
+    Every entry point dispatches through _dispatch_slot: on a host whose
+    ops.mesh seam reports >1 device, stage 1 is the SHARDED pack+dispatch
+    (validator axis P("data") across the mesh) and stages 2/3 delegate to
+    the sharded readback/finish — double-buffering, FIFO, error-at-pop
+    and bad_pk semantics are identical either way.
 
     Stage 1 (host pack + async dispatch) runs on the submitting thread
     under the pipeline lock; stage 2 (device execute) runs on the device's
@@ -945,7 +998,7 @@ class SigAggPipeline:
         with tracer.start_span("ops/sigagg_pipeline/submit",
                                slots=len(batches)) as span:
             with self._lock:
-                state = _fused_dispatch(_layout_slots(batches), pks, msgs)
+                state = _dispatch_slot(batches, pks, msgs)
                 self._pending.append(self._schedule_finish(state, hash_fn))
                 over = (self._pending.popleft()
                         if len(self._pending) > self._depth else None)
@@ -964,7 +1017,7 @@ class SigAggPipeline:
         with tracer.start_span("ops/sigagg_pipeline/submit",
                                slots=len(batches)) as span:
             with self._lock:
-                state = _fused_dispatch(_layout_slots(batches), pks, msgs)
+                state = _dispatch_slot(batches, pks, msgs)
                 fut = self._schedule_finish(state, hash_fn)
                 self._pending.append(fut)
                 over = (self._pending.popleft()
@@ -998,7 +1051,7 @@ class SigAggPipeline:
         with tracer.start_span("ops/sigagg_pipeline/aggregate_verify",
                                slots=len(batches)):
             with self._lock:
-                state = _fused_dispatch(_layout_slots(batches), pks, msgs)
+                state = _dispatch_slot(batches, pks, msgs)
             return _fused_finish(state, hash_fn)
 
     def close(self) -> None:

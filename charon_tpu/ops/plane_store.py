@@ -187,12 +187,13 @@ class PlaneStore:
                 planes.append(plane)
         return planes
 
-    # ---- host-side entries (sharded plane) -------------------------------
+    # ---- host-side entries -----------------------------------------------
 
     def host_entry(self, pks: list, extra_key: tuple, build):
-        """Memoize a HOST-side derivation of a pubkey set (e.g. the sharded
-        plane's per-device parse stacks), same digest keying and LRU as the
-        device planes. `build()` runs under the store lock on miss."""
+        """Memoize a HOST-side derivation of a pubkey set, same digest
+        keying and LRU as the device planes. `build()` runs under the
+        store lock on miss. (The sharded pk stacks moved to sharded_entry
+        below — device-resident, not host.)"""
         key = (self.digest(pks), "host") + tuple(extra_key)
         with self._lock:
             entry = self._entries.get(key)
@@ -202,6 +203,33 @@ class PlaneStore:
                 _hits.inc("host")
                 return entry
             _misses.inc("host")
+            entry = build()
+            self._insert(key, entry)
+            return entry
+
+    # ---- sharded device entries (multi-device sigagg) --------------------
+
+    def sharded_entry(self, pks: list, geometry: tuple, build):
+        """Memoize a DEVICE-RESIDENT sharded derivation of a pubkey set —
+        the sharded plane's per-device pk parse stacks, placed with a
+        NamedSharding across the mesh by `build()`. Keyed on the full-set
+        digest plus the shard geometry (D, Vd, Vp), so a mesh-width or
+        bucket change builds a fresh placement while the steady state
+        (static cluster set, fixed mesh) is pure hits: zero host parse
+        AND zero host→device pk transfer per slot. Same LRU/pinning as
+        the device planes; counted under kind="device". Tests that
+        rebuild the mesh between cases must also swap in a fresh STORE —
+        a cached entry holds arrays committed to the old mesh's devices.
+        """
+        key = (self.digest(pks), "sharded") + tuple(geometry)
+        with self._lock:
+            entry = self._entries.get(key)
+            if entry is not None:
+                self._entries.pop(key)
+                self._entries[key] = entry
+                _hits.inc("device")
+                return entry
+            _misses.inc("device")
             entry = build()
             self._insert(key, entry)
             return entry

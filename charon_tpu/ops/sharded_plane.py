@@ -19,7 +19,16 @@ scales over ICI: per-chip work is embarrassingly parallel, the single
 all_gather moves E·LIMBS·TW ints per chip, and every kernel is the
 identical pallas plane kernel the single-chip path uses.
 
-Used by __graft_entry__.dryrun_multichip (driver contract) and
+Production entry: the module is split along the SAME three-stage seam as
+plane_agg — `sharded_dispatch` (host pack + async dispatch, the "pack"
+phase), `sharded_readback` (device fence + per-shard transfer, "execute"/
+"drain") and the pure-host `sharded_host_finish` ("finish") — so
+SigAggPipeline double-buffers and overlaps sharded slots exactly as it
+does single-device ones. plane_agg routes every pipeline/batch entry here
+whenever ops.mesh.sigagg_mesh() reports >1 device; the classic
+`threshold_aggregate_and_verify_sharded` wrapper (dryrun/tests) is now a
+thin dispatch+finish composition over the same stages. Also used by
+__graft_entry__.dryrun_multichip (driver contract) and
 tests/test_multichip.py; numerically cross-checked against the single-chip
 path (bit-identical aggregate bytes, identical RLC decision).
 """
@@ -33,8 +42,21 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from ..utils import metrics, tracer
 from . import pallas_plane as PP
 from . import plane_agg as PA
+
+# Per-shard latency inside one sharded slot: "pack" is one device chunk's
+# host parse, "transfer" is one shard's drain-side readback. The spread
+# across shards (p99 vs p50) is the load-imbalance signal the benches
+# print — contiguous chunking gives the LAST device the short remainder
+# chunk, so a wide spread means V is too small for the mesh.
+_shard_hist = metrics.histogram(
+    "ops_sigagg_shard_seconds",
+    "Per-shard phases of a sharded sigagg slot: host chunk pack, "
+    "per-shard readback transfer", ("phase",),
+    buckets=(0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25,
+             0.5, 1, 2.5, 5))
 
 
 def _chunk_plane_inputs(batches, Vp: int, T: int):
@@ -169,35 +191,49 @@ def _build_steps(mesh, G: int, T: int, Wv: int):
     return step1, step2, step3
 
 
-def threshold_aggregate_and_verify_sharded(
-        batches, pks, msgs, mesh, rs=None, hash_fn=None):
-    """Fused aggregate+verify, data-parallel over mesh axis "data".
+def sharded_dispatch(batches, pks, msgs, mesh, rs=None):
+    """Stage 1 of a sharded slot: host pack + async dispatch over mesh
+    axis "data"; returns the pending state plane_agg._fused_readback /
+    _fused_host_finish (and with them SigAggPipeline) complete. Same
+    contract and trust preconditions as plane_agg._fused_dispatch —
+    everything here is host work + enqueue (the "pack" phase of
+    ops_device_dispatch_seconds); NOTHING syncs on the device, so the
+    pipeline lock may cover this whole body (LINT-TPU-007).
 
-    Same contract as plane_agg.threshold_aggregate_and_verify (and the same
-    trust preconditions: partials individually verified upstream). Pubkey
-    validation — infinity rejection + subgroup membership, which RLC
-    soundness requires — runs through plane_agg.validate_pk_set below:
-    once per distinct pubkey set per process (a cluster's validator set is
-    static between reconfigurations), not per slot, and via the NATIVE
-    backend so no single-device graph compiles inside the multichip dryrun
-    (the _pk_plane_cached route cold-compiled _g1_subgroup_jit for ~6 min
-    on the driver host — MULTICHIP_r04.json rc=124). The per-step sharded
-    graph re-validates curve membership of every decompressed point but
-    relies on that amortized subgroup check. Validators are sharded over
-    the mesh. Returns (compressed aggregates, all_valid); raises ValueError
-    on an invalid or out-of-subgroup pubkey, like the single-chip path.
-    """
-    from jax.sharding import NamedSharding, PartitionSpec as P
-
+    Pubkey validation — infinity rejection + subgroup membership, which
+    RLC soundness requires — runs through plane_agg.validate_pk_set:
+    once per distinct pubkey set per process (a cluster's validator set
+    is static between reconfigurations), not per slot, and via the
+    NATIVE backend so no single-device graph compiles inside the
+    multichip dryrun (the _pk_plane_cached route cold-compiled
+    _g1_subgroup_jit for ~6 min on the driver host — MULTICHIP_r04.json
+    rc=124). An invalid/∞/out-of-subgroup pubkey degrades to the
+    "sharded_bad_pk" state — aggregates still computed, all_valid=False
+    at finish — bit-identical to the single-device bad_pk contract."""
     V = len(batches)
     if not (V == len(pks) == len(msgs)):
         raise ValueError("length mismatch")
     if V == 0:
-        return [], True
-    # reject-infinity + subgroup-check the pk set (content-digest cached —
-    # one validation per process per pubkey set, advisor round-3 medium)
-    PA.validate_pk_set([bytes(p) for p in pks])
+        return ("sharded_empty",)
     D = mesh.devices.size
+    with tracer.start_span("ops/sharded_dispatch", validators=V,
+                           shards=D) as span, \
+            PA._dispatch_hist.observe_time("pack"):
+        try:
+            PA.validate_pk_set([bytes(p) for p in pks])
+        except ValueError:
+            span.attrs["outcome"] = "sharded_bad_pk"
+            return ("sharded_bad_pk", [dict(b) for b in batches])
+        state = _sharded_dispatch_impl(batches, pks, msgs, mesh, rs, span)
+        span.attrs["outcome"] = state[0]
+        PA._shard_width.set(float(D))
+        return state
+
+
+def _sharded_dispatch_impl(batches, pks, msgs, mesh, rs, span):
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    V, D = len(batches), mesh.devices.size
     T = max(len(b) for b in batches)
     if T == 0:
         raise ValueError("empty partial signature set")
@@ -206,26 +242,36 @@ def threshold_aggregate_and_verify_sharded(
     #                                    combined width must be a bucket)
     Wv = Vp // PP.SUB
 
-    # ---- host-side parse, one chunk per device ---------------------------
-    X0r, X1r, sgn, lmask, digits = (np.stack(a) for a in zip(*[
-        _chunk_plane_inputs(batches[d * Vd:(d + 1) * Vd], Vp, T)
-        for d in range(D)]))
+    # ---- host-side parse, one chunk per device (timed per shard) ---------
+    stacks = []
+    for d in range(D):
+        with _shard_hist.observe_time("pack"):
+            stacks.append(_chunk_plane_inputs(
+                batches[d * Vd:(d + 1) * Vd], Vp, T))
+        span.add_event("shard_pack", shard=d)
+    X0r, X1r, sgn, lmask, digits = (np.stack(a) for a in zip(*stacks))
+
     # the per-device pk parse stacks are a pure function of the (static)
-    # pubkey set and the shard geometry — memoized in the PlaneStore
-    # (host_entry) so steady-state slots skip the whole-set byte parse
+    # pubkey set and the shard geometry — built once per (digest, D, Vd,
+    # Vp) and held DEVICE-RESIDENT with NamedSharding placement in the
+    # PlaneStore, so steady-state slots skip both the whole-set byte parse
+    # and the host→device transfer of the pk planes
+    shard = NamedSharding(mesh, P("data"))
+
     def _parse_pk_chunks():
         pk_chunks = [PA._parse_compressed(
             [bytes(p) for p in pks[d * Vd:(d + 1) * Vd]]
             or [b"\xc0" + bytes(47)],
             48, "G1", False, Vp) for d in range(D)]
-        return (np.stack([PA._raw_to_plane(c[0], Vp) for c in pk_chunks]),
+        host = (np.stack([PA._raw_to_plane(c[0], Vp) for c in pk_chunks]),
                 np.stack([c[2] for c in pk_chunks]),
                 np.stack([c[3] for c in pk_chunks]))
+        return tuple(jax.device_put(jnp.asarray(a), shard) for a in host)
 
     from . import plane_store
 
-    pkXr, pk_sgn, pk_lmask = plane_store.STORE.host_entry(
-        [bytes(p) for p in pks], ("sharded", D, Vd, Vp), _parse_pk_chunks)
+    pkXr, pk_sgn, pk_lmask = plane_store.STORE.sharded_entry(
+        [bytes(p) for p in pks], (D, Vd, Vp), _parse_pk_chunks)
 
     # RLC randomizers: global per validator, chunked per device; padding
     # lanes carry zero (infinity contributions)
@@ -254,29 +300,108 @@ def threshold_aggregate_and_verify_sharded(
             gmask[d, g, loc // (Vp // PP.SUB), loc % (Vp // PP.SUB)] = True
 
     step1, step2, step3 = _build_steps(mesh, G, T, Wv)
-    shard = NamedSharding(mesh, P("data"))
     a1 = [jax.device_put(jnp.asarray(a), shard)
-          for a in (X0r, X1r, sgn, lmask, digits, pkXr, pk_sgn, pk_lmask)]
+          for a in (X0r, X1r, sgn, lmask, digits)]
     (ok, pok, xs, sign, inf,
-     RXs, RYs, RZs, pXs, pYs, pZs) = step1(*a1)
+     RXs, RYs, RZs, pXs, pYs, pZs) = step1(*a1, pkXr, pk_sgn, pk_lmask)
     a2 = [jax.device_put(jnp.asarray(a), shard) for a in (rdig, gmask)]
     SX, SY, SZ, PX, PY, PZ = step3(*step2(RXs, RYs, RZs, pXs, pYs, pZs, *a2))
+    return ("sharded_pending", V, D, Vd, group_keys,
+            (ok, pok, xs, sign, inf), (SX, SY, SZ, PX, PY, PZ))
 
-    if not (np.asarray(ok).all() and np.asarray(pok).all()):
-        raise ValueError("invalid point in sharded load")
 
-    # ---- host: emit aggregate bytes per device chunk ---------------------
-    out: list[bytes] = []
-    xs_np, sign_np, inf_np = (np.asarray(a) for a in (xs, sign, inf))
-    for d in range(D):
-        n_local = min(Vd, max(0, V - d * Vd))
-        if n_local:
-            out.extend(PA._g2_emit_bytes(
-                xs_np[d], sign_np[d].reshape(-1), inf_np[d].reshape(-1),
-                n_local))
+def _shards_by_index(arr, D):
+    """One addressable shard per mesh position along axis 0, ordered by
+    global index, or None when the layout is not the expected 1-D "data"
+    sharding (callers fall back to a wholesale device_get)."""
+    try:
+        shards = list(arr.addressable_shards)
+        if len(shards) != D:
+            return None
+        parts = [None] * D
+        for s in shards:
+            idx = s.index[0].start if s.index else None
+            if idx is None or not 0 <= idx < D or parts[idx] is not None:
+                return None
+            parts[idx] = s
+        return parts
+    except Exception:  # noqa: BLE001 — unexpected layout: fall back
+        return None
 
-    # ---- host: fold the replicated RLC sums + multi-pairing --------------
-    S = PP._host_fold(SX, SY, SZ, 2)
-    pts = [(m, PA._unembed_g1(PP._host_fold(PX[g], PY[g], PZ[g], 2)))
-           for g, m in enumerate(group_keys)]
-    return out, PA._pairing_finish(S, pts, hash_fn)
+
+def sharded_readback(state, span=None):
+    """Stage 2→3 boundary of a sharded slot: block on the mesh-wide work
+    ("execute" phase) then transfer results shard by shard ("drain") so
+    each device's readback is individually timed (ops_sigagg_shard_seconds
+    {phase="transfer"} + shard_transfer span events). "sharded_bad_pk"/
+    "sharded_empty" states pass through untouched."""
+    if state[0] in ("sharded_bad_pk", "sharded_empty"):
+        if span is not None:
+            span.attrs["outcome"] = state[0]
+        return state
+    _tag, V, D, Vd, group_keys, shard_outs, red_outs = state
+    with PA._dispatch_hist.observe_time("execute"):
+        jax.block_until_ready(shard_outs)
+        jax.block_until_ready(red_outs)
+    if span is not None:
+        span.add_event("device_fence")
+    with PA._dispatch_hist.observe_time("drain"):
+        per = [_shards_by_index(a, D) for a in shard_outs]
+        if all(p is not None for p in per):
+            cols = [[None] * D for _ in shard_outs]
+            for d in range(D):
+                with _shard_hist.observe_time("transfer"):
+                    for i in range(len(shard_outs)):
+                        cols[i][d] = np.asarray(per[i][d].data)
+                if span is not None:
+                    span.add_event("shard_transfer", shard=d)
+            host_shards = tuple(np.concatenate(c, axis=0) for c in cols)
+        else:
+            host_shards = tuple(np.asarray(a)
+                                for a in jax.device_get(shard_outs))
+        host_reds = tuple(np.asarray(a) for a in jax.device_get(red_outs))
+    return ("sharded_host", V, D, Vd, group_keys, host_shards, host_reds)
+
+
+def sharded_host_finish(hstate, hash_fn=None):
+    """Stage 3, pure host — validity check, per-chunk byte emission, RLC
+    host folds and the native multi-pairing (the "finish" phase; the
+    heavy parts release the GIL so the pipeline's stage-3 workers overlap
+    it with the next slot's pack and the in-flight execute). bad_pk
+    degrades exactly like the single-device path: aggregates computed,
+    all_valid=False."""
+    if hstate[0] == "sharded_empty":
+        return [], True
+    if hstate[0] == "sharded_bad_pk":
+        layout = PA._layout_slots(hstate[1])
+        RX, RY, RZ, V, Vp = PA._aggregate_plane(None, layout)
+        return PA._serialize_aggregates(RX, RY, RZ, V), False
+    _tag, V, D, Vd, group_keys, host_shards, host_reds = hstate
+    with PA._dispatch_hist.observe_time("finish"):
+        ok, pok, xs, sign, inf = host_shards
+        if not (ok.all() and pok.all()):
+            raise ValueError("invalid point in sharded load")
+        out: list[bytes] = []
+        for d in range(D):
+            n_local = min(Vd, max(0, V - d * Vd))
+            if n_local:
+                out.extend(PA._g2_emit_bytes(
+                    xs[d], sign[d].reshape(-1), inf[d].reshape(-1),
+                    n_local))
+        SX, SY, SZ, PX, PY, PZ = host_reds
+        S = PP._host_fold(SX, SY, SZ, 2)
+        pts = [(m, PA._unembed_g1(PP._host_fold(PX[g], PY[g], PZ[g], 2)))
+               for g, m in enumerate(group_keys)]
+        return out, PA._pairing_finish(S, pts, hash_fn)
+
+
+def threshold_aggregate_and_verify_sharded(
+        batches, pks, msgs, mesh, rs=None, hash_fn=None):
+    """Fused aggregate+verify, data-parallel over mesh axis "data" — the
+    blocking composition of the three stages above (the shape the
+    MULTICHIP dryrun and tests drive directly). Same contract as
+    plane_agg.threshold_aggregate_and_verify: returns (compressed
+    aggregates, all_valid), degrading to all_valid=False on an invalid or
+    out-of-subgroup pubkey like the single-chip path."""
+    state = sharded_dispatch(batches, pks, msgs, mesh, rs=rs)
+    return PA._fused_finish(state, hash_fn)

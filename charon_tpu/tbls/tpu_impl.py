@@ -26,6 +26,14 @@ one dispatch + one transfer, round-3 single-dispatch design) regardless
 of batch size ≤1024, so it only wins past `min_device_batch` /
 `min_device_verify` items; the cross-duty batching window
 (core/coalesce.py) gathers sub-threshold duties up to these sizes.
+
+Multi-device hosts: every fused sigagg entry point here
+(threshold_aggregate_verify_batch / _overlapped / _submit) dispatches
+through plane_agg._dispatch_slot, which consults the ops.mesh seam — on a
+>1-device mesh the slot's validator axis is sharded P("data") across all
+local devices (ops/sharded_plane.py) with identical outputs and
+bad_pk/FIFO semantics; with one device (or CHARON_TPU_SIGAGG_DEVICES=1)
+the exact single-device path runs, bit-identical to prior builds.
 Feature-gated in app wiring via
 charon_tpu.utils.featureset.TPU_BLS, mirroring how the reference gates
 backends behind tbls.SetImplementation + app/featureset
@@ -285,7 +293,9 @@ class TPUImpl(NativeImpl):
     def pin_pubkeys(self, public_keys) -> None:
         """Pin the set's decoded planes in the device PlaneStore so cache
         pressure from transient sets can never evict the cluster's own
-        share/root pubkeys (core/sigagg pins at construction)."""
+        share/root pubkeys (core/sigagg pins at construction). Pinning is
+        by full-set digest, so the sharded per-device pk placements
+        (PlaneStore.sharded_entry) are protected by the same pin."""
         from ..ops import plane_store
 
         plane_store.STORE.pin([bytes(pk) for pk in public_keys])

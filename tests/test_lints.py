@@ -363,6 +363,76 @@ def test_pipeline_lock_rule_scopes_to_pipeline_class_and_dirs(tmp_path):
 
 
 # ---------------------------------------------------------------------------
+# LINT-TPU-008 — topology comes from ops.mesh
+# ---------------------------------------------------------------------------
+
+
+def test_mesh_rule_flags_bare_topology_probes(tmp_path):
+    findings = lint_source(tmp_path, "core/x.py", """\
+        import jax
+
+        def width():
+            return len(jax.devices())
+
+        def shards():
+            return jax.local_device_count()
+    """)
+    assert rules_of(findings) == ["LINT-TPU-008", "LINT-TPU-008"]
+    assert "jax.devices()" in findings[0].message
+    assert "ops.mesh" in findings[0].message
+    assert "jax.local_device_count()" in findings[1].message
+
+
+def test_mesh_rule_accepts_seam_and_nonjax_calls(tmp_path):
+    findings = lint_source(tmp_path, "ops/x.py", """\
+        import jax
+
+        def width():
+            from . import mesh
+            return mesh.device_count()
+
+        def backend():
+            # not a topology probe
+            return jax.default_backend()
+
+        def other(registry):
+            # same attribute on a non-jax object is fine
+            return registry.devices()
+    """)
+    assert findings == []
+
+
+def test_mesh_rule_exempts_the_seam_itself(tmp_path):
+    src = """\
+        import jax
+
+        def _discover():
+            return list(jax.devices())
+    """
+    assert lint_source(tmp_path, "ops/mesh.py", src) == []
+    # only ops/mesh.py is the sanctioned probe — a mesh.py elsewhere isn't
+    assert rules_of(lint_source(
+        tmp_path, "core/mesh.py", src)) == ["LINT-TPU-008"]
+
+
+def test_planestore_rule_sanctions_sharded_entry_callback(tmp_path):
+    # the sharded PK-plane memoization path: a decode inside a callback
+    # handed to plane_store.STORE.sharded_entry is sanctioned exactly like
+    # host_entry's
+    findings = lint_source(tmp_path, "ops/x.py", """\
+        def _parse_pk_chunks(pks):
+            return _parse_compressed([bytes(p) for p in pks], 48, "G1",
+                                     False, 64)
+
+        def outer(pks, geometry):
+            from . import plane_store
+            return plane_store.STORE.sharded_entry(
+                pks, geometry, _parse_pk_chunks)
+    """)
+    assert findings == []
+
+
+# ---------------------------------------------------------------------------
 # LINT-IFACE-004 — protocol implementation claims
 # ---------------------------------------------------------------------------
 

@@ -1,0 +1,159 @@
+"""Multi-device sigagg promotion contract (tier-1).
+
+Two layers:
+
+  * in-process unit tests of the ops/mesh topology seam — override clamp,
+    CPU opt-in rule, 1-device passthrough, resolve caching — which run on
+    the conftest's 8 virtual CPU devices without compiling anything;
+  * subprocess integration tests driving the PRODUCTION SigAggPipeline
+    over a real (virtual CPU) mesh via charon_tpu/testutil/sharded_check:
+    4-device with uneven V and a single-device bit-identity compare, and
+    3-device to cover sharded_plane._build_steps' non-power-of-two
+    all_gather fallback (the ppermute butterfly needs D a power of two).
+
+The subprocesses share the repo's machine-keyed persistent .jax_cache
+(same recipe as the multichip dryrun), so only the first-ever run on a
+box pays the XLA:CPU compile; the timeout is a regression guard for the
+warm path plus one cold-compile's slack.
+"""
+
+import os
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+REPO = Path(__file__).resolve().parents[1]
+CHECK_TIMEOUT_S = 420
+
+
+def _mesh_env(n_devices: int) -> dict:
+    """Subprocess env: JAX on n virtual CPU devices with the sharded width
+    pinned (CPU meshes are opt-in at the mesh seam). The conftest already
+    put an 8-device XLA flag in this process's environ — REPLACE it, the
+    child must see exactly n devices."""
+    env = dict(os.environ)
+    flags = [t for t in env.get("XLA_FLAGS", "").split()
+             if "xla_force_host_platform_device_count" not in t]
+    flags.append(f"--xla_force_host_platform_device_count={n_devices}")
+    env["XLA_FLAGS"] = " ".join(flags)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["CHARON_TPU_COMPILE_LEAN"] = "1"
+    env["CHARON_TPU_SIGAGG_DEVICES"] = str(n_devices)
+    env["JAX_COMPILATION_CACHE_DIR"] = str(REPO / ".jax_cache")
+    return env
+
+
+def _run_check(n_devices: int, *extra: str) -> str:
+    res = subprocess.run(
+        [sys.executable, "-m", "charon_tpu.testutil.sharded_check",
+         str(n_devices), *extra],
+        env=_mesh_env(n_devices), cwd=str(REPO), capture_output=True,
+        text=True, timeout=CHECK_TIMEOUT_S)
+    assert res.returncode == 0, (
+        f"sharded_check rc={res.returncode}\n"
+        f"--- stdout ---\n{res.stdout}\n--- stderr ---\n{res.stderr[-4000:]}")
+    assert "sharded_check OK" in res.stdout, res.stdout
+    return res.stdout
+
+
+def test_sharded_4dev_bit_identical_and_tamper():
+    """4-device mesh, V=6 (V % D != 0, trailing shard all padding): valid
+    slot verifies bit-identical to the native oracle, tampered slot flips
+    the RLC verdict, and the 1-device passthrough rerun (override=1)
+    produces byte-identical aggregates."""
+    _run_check(4, "--single-device-compare")
+
+
+def test_sharded_3dev_gather_fallback():
+    """3 devices: D & (D-1) != 0, so the combine all-reduce takes the
+    all_gather + host-side fold fallback instead of the XOR-pairing
+    ppermute butterfly — the branch a power-of-two mesh never executes."""
+    _run_check(3)
+
+
+# ---------------------------------------------------------------------------
+# ops/mesh seam unit tests (in-process; no device dispatch)
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture
+def mesh_seam():
+    from charon_tpu.ops import mesh as mesh_mod
+
+    old = os.environ.get(mesh_mod.DEVICES_ENV)
+    yield mesh_mod
+    if old is None:
+        os.environ.pop(mesh_mod.DEVICES_ENV, None)
+    else:
+        os.environ[mesh_mod.DEVICES_ENV] = old
+    mesh_mod.reset_for_testing()
+
+
+def test_mesh_cpu_devices_are_opt_in(mesh_seam):
+    """The conftest gives this process 8 virtual CPU devices, but
+    host-platform devices are test artifacts: without the explicit
+    override the seam must resolve to the single-device passthrough —
+    production slots never auto-shard over them, and the tier's
+    single-device tests (and the coalescer's default flush_at) stay on
+    the exact single-device path."""
+    os.environ.pop(mesh_seam.DEVICES_ENV, None)
+    mesh_seam.reset_for_testing()
+    assert mesh_seam.device_count() == 1
+    assert mesh_seam.sigagg_mesh() is None
+
+
+def test_mesh_override_promotes_and_clamps(mesh_seam):
+    import jax
+
+    n_avail = len(jax.devices())
+    assert n_avail >= 8, "conftest should provision 8 virtual devices"
+    os.environ[mesh_seam.DEVICES_ENV] = "4"
+    mesh_seam.reset_for_testing()
+    assert mesh_seam.device_count() == 4
+    mesh = mesh_seam.sigagg_mesh()
+    assert mesh is not None and mesh.devices.size == 4
+    assert mesh.axis_names == ("data",)
+    # override above the host inventory clamps to what exists
+    os.environ[mesh_seam.DEVICES_ENV] = str(n_avail + 64)
+    mesh_seam.reset_for_testing()
+    assert mesh_seam.device_count() == n_avail
+
+
+def test_mesh_override_one_forces_passthrough(mesh_seam):
+    mesh_seam.set_override(1)
+    assert mesh_seam.device_count() == 1
+    assert mesh_seam.sigagg_mesh() is None
+
+
+def test_mesh_resolve_is_cached(mesh_seam):
+    """Every slot must see the SAME Mesh instance — sharded_plane's
+    compiled steps are lru_cached on the mesh object, so a fresh Mesh per
+    call would recompile the sharded executables every slot."""
+    mesh_seam.set_override(4)
+    m1 = mesh_seam.sigagg_mesh()
+    m2 = mesh_seam.sigagg_mesh()
+    assert m1 is m2
+    # env changes without a reset are deliberately ignored (cached) ...
+    os.environ[mesh_seam.DEVICES_ENV] = "2"
+    assert mesh_seam.sigagg_mesh() is m1
+    # ... and picked up after reset_for_testing
+    mesh_seam.reset_for_testing()
+    assert mesh_seam.sigagg_mesh().devices.size == 2
+
+
+def test_mesh_bad_override_ignored(mesh_seam):
+    os.environ[mesh_seam.DEVICES_ENV] = "not-a-number"
+    mesh_seam.reset_for_testing()
+    # malformed override falls back to the no-override rule (CPU opt-in)
+    assert mesh_seam.device_count() == 1
+
+
+def test_mesh_gauge_exports_width(mesh_seam):
+    from charon_tpu.utils import metrics
+
+    mesh_seam.set_override(4)
+    mesh_seam.device_count()
+    assert metrics.default_registry.snapshot(
+        "ops_mesh_devices")["ops_mesh_devices"] == 4.0
