@@ -85,6 +85,20 @@ class Config:
     # 1 forces the single-device path, N>1 caps the mesh at N devices
     # (multi-tenant hosts pin it below the chip count)
     sigagg_devices: int | None = None
+    # self-healing device plane (ops/guard.py, docs/robustness.md); None
+    # leaves the CHARON_TPU_BREAKER_* / _SLOT_DEADLINE_S env defaults:
+    # consecutive slot failures before the breaker trips the plane native,
+    breaker_threshold: int | None = None
+    # seconds the breaker stays open before a half-open probe,
+    breaker_cooldown_s: float | None = None
+    # and the pipeline slot watchdog deadline (0 disables the watchdog)
+    slot_deadline_s: float | None = None
+    # chaos: a utils/faults.py JSON plan armed at assemble (reproducible
+    # fault injection); None falls back to CHARON_TPU_FAULT_PLAN
+    fault_plan: str | None = None
+    # per-request retry window (seconds) for beacon HTTP routes; 0 turns
+    # the Retryer wiring off (single attempt, legacy behavior)
+    beacon_retry_s: float = 10.0
     test: TestConfig = field(default_factory=TestConfig)
 
 
@@ -224,6 +238,20 @@ async def assemble(config: Config) -> App:
         _log.info("sigagg mesh width clamped",
                   sigagg_devices=config.sigagg_devices,
                   resolved=mesh_mod.device_count())
+    # robustness seams BEFORE the tbls backend / first dispatch: the fault
+    # plan must be armed when the first slot runs, and the guard knobs are
+    # read at breaker/pipeline construction (docs/robustness.md)
+    from ..ops import guard as guard_mod
+    from ..utils import faults as faults_mod
+
+    if config.fault_plan:
+        plan = faults_mod.arm(config.fault_plan)
+        _log.warn("chaos fault plan ARMED", sites=",".join(plan.sites))
+    else:
+        faults_mod.arm_from_env()
+    guard_mod.configure(threshold=config.breaker_threshold,
+                        cooldown=config.breaker_cooldown_s,
+                        slot_deadline=config.slot_deadline_s)
     _select_tbls_backend(config)
     test = config.test
     privkey_lock = None
@@ -286,9 +314,14 @@ async def assemble(config: Config) -> App:
             raise errors.new("no beacon source: configure beacon_urls or "
                              "TestConfig.beacon")
         from ..eth2.beacon import MultiBeaconNode
-        from ..eth2.http_beacon import HTTPBeaconNode
+        from ..eth2.http_beacon import HTTPBeaconNode, request_retryer
 
-        nodes = [HTTPBeaconNode(u) for u in config.beacon_urls]
+        # every fetch/submit route retries temporary failures inside a
+        # per-request window (reference app/retry around eth2 calls)
+        bn_retryer = (request_retryer(config.beacon_retry_s)
+                      if config.beacon_retry_s > 0 else None)
+        nodes = [HTTPBeaconNode(u, retryer=bn_retryer)
+                 for u in config.beacon_urls]
         beacon = MultiBeaconNode(nodes) if len(nodes) > 1 else nodes[0]
     if config.synthetic_proposals:
         from ..eth2.beacon import SyntheticProposals
@@ -324,10 +357,17 @@ async def assemble(config: Config) -> App:
     # core/coalesce.py). Benefits the native RLC batch verifier too, so it
     # is on regardless of the tpu_bls feature.
     coalescer = coalesce_mod.TblsCoalescer()
+    # duty-deadline retryer (reference app/retry): shared by the core-wire
+    # async steps AND parsigex broadcast, so a peer blip re-sends partials
+    # under backoff until the duty expires
+    retryer = retry_util.Retryer(
+        lambda duty: deadline_fn(duty) if duty is not None else None,
+        expbackoff.Config(base=0.05, jitter=0.1, max_delay=0.5))
     psigex = parsigex_mod.ParSigEx(
         ParSigExTCPTransport(node), my_idx, new_duty_gater(chain),
         parsigex_mod.new_batch_eth2_verifier(chain, keys,
-                                             coalescer=coalescer))
+                                             coalescer=coalescer),
+        retryer=retryer)
     agg = sigagg_mod.SigAgg(keys, chain, coalescer=coalescer)
     caster = bcast_mod.Broadcaster(beacon, chain)
     fetch.register_agg_sig_db(aggsig_db.await_)
@@ -344,9 +384,6 @@ async def assemble(config: Config) -> App:
 
     track = tracker_mod.Tracker(Deadliner(tracker_deadline), keys.num_shares)
     inclusion = tracker_mod.InclusionChecker(beacon, chain)
-    retryer = retry_util.Retryer(
-        lambda duty: deadline_fn(duty) if duty is not None else None,
-        expbackoff.Config(base=0.05, jitter=0.1, max_delay=0.5))
     wire(sched, fetch, consensus, duty_db, vapi, parsig_db, psigex, agg,
          aggsig_db, caster,
          options=[WithAsyncRetry(retryer), WithTracing(), WithTracking(track)])

@@ -135,6 +135,19 @@ def default_checks(quorum_peers: int,
               "override)",
               lambda w: (0 < w.gauge_sum("ops_sigagg_shard_width")
                          < w.gauge_sum("ops_mesh_devices"))),
+        Check("sigagg_plane_degraded",
+              "sigagg slots fell back down the recovery ladder or the "
+              "plane circuit breaker is open/half-open "
+              "(ops_sigagg_fallback_total moved or ops_plane_breaker_state "
+              "is non-zero — device dispatches are failing; see "
+              "docs/robustness.md)",
+              lambda w: (w.counter_delta("ops_sigagg_fallback_total") > 0
+                         or w.gauge_sum("ops_plane_breaker_state") > 0)),
+        Check("sigagg_slot_stuck",
+              "a sigagg slot blew its watchdog deadline (a device fence "
+              "hung past CHARON_TPU_SLOT_DEADLINE_S and the slot was "
+              "recovered down the ladder; see docs/robustness.md)",
+              lambda w: w.counter_delta("ops_sigagg_watchdog_total") > 0),
         Check("high_error_log_rate", "more than 5 error logs in the window",
               lambda w: w.counter_delta("log_messages_total", "error") > 5),
         Check("high_warning_log_rate", "more than 10 warning logs in the window",

@@ -144,6 +144,20 @@ class _Window:
             # isolated in O(log N) dispatches instead of N serial ones —
             # each dispatch has a ~1s device floor, so a serial retry of a
             # full window would blow the slot budget.
+            from ..ops import guard
+
+            if guard.is_device_error(exc):
+                # Device-class failure (lost chip, hung fence, exhausted
+                # guard ladder): systemic by definition — no input item
+                # caused it, so bisecting re-dispatches up to 2N-1 times
+                # against broken hardware. Fail the whole flush with the
+                # classified error; callers see one attributable cause.
+                _log.warn("coalesced dispatch hit device-class failure; "
+                          "failing flush without bisect",
+                          requests=len(reqs), err=exc)
+                for f in futs:
+                    _resolve(f, exc=exc)
+                return
             if len(reqs) == 1:
                 _resolve(futs[0], exc=exc)
                 return

@@ -17,7 +17,7 @@ from typing import Awaitable, Callable
 
 from .. import tbls
 from ..eth2.spec import ChainSpec
-from ..utils import aio, errors, log, metrics
+from ..utils import aio, errors, faults, log, metrics
 from .gater import DutyGaterFunc
 from .keyshares import KeyShares
 from .signeddata import _Eth2Signed
@@ -100,11 +100,12 @@ class ParSigEx:
     (reference parsigex.go:105 Broadcast, :61 handle)."""
 
     def __init__(self, transport, peer_idx: int, gater: DutyGaterFunc,
-                 verify_set=None):
+                 verify_set=None, retryer=None):
         self._transport = transport
         self._peer_idx = peer_idx
         self._gater = gater
         self._verify_set = verify_set
+        self._retryer = retryer  # utils.retry.Retryer or None (no retry)
         self._subs = []
         transport.register(peer_idx, self._handle)
 
@@ -112,12 +113,28 @@ class ParSigEx:
         self._subs.append(fn)
 
     async def broadcast(self, duty: Duty, parsigs: ParSignedDataSet) -> None:
-        """Send our partials to every peer directly (parsigex.go:105-130)."""
-        await self._transport.broadcast(self._peer_idx, duty, parsigs)
+        """Send our partials to every peer directly (parsigex.go:105-130).
+        With a Retryer wired (app.assemble passes the duty-deadline one),
+        temporary transport failures re-send under backoff until the
+        duty's deadline — a peer blip must not lose our partials; without
+        one the legacy single-attempt shape is unchanged."""
+        if self._retryer is None:
+            await self._transport.broadcast(self._peer_idx, duty, parsigs)
+            return
+        await self._retryer.do_async(
+            duty, "parsigex broadcast",
+            lambda: self._transport.broadcast(self._peer_idx, duty, parsigs))
 
     async def _handle(self, duty: Duty, parsigs: ParSignedDataSet) -> None:
         """Inbound from a peer: gate, verify every partial, then hand to
         subscribers (ParSigDB.StoreExternal) (parsigex.go:61-102)."""
+        try:
+            faults.check("parsigex.recv")
+        except Exception as exc:  # noqa: BLE001 — injected chaos only
+            _recv_counter.inc("fault", amount=len(parsigs))
+            _log.warn("dropping peer partials: injected recv fault",
+                      err=exc, duty=str(duty))
+            return
         if not self._gater(duty):
             _recv_counter.inc("gated", amount=len(parsigs))
             _log.warn("dropping gated duty from peer", duty=str(duty))

@@ -197,7 +197,15 @@ class VapiRouter:
     async def _duty_body_share_pubkeys(self, body) -> list[bytes]:
         """Resolve a duties request body to share pubkeys. The beacon API
         standard body is decimal validator-index strings; 0x-hex pubkeys are
-        also accepted (the DVT extension validatormock uses)."""
+        also accepted (the DVT extension validatormock uses). The body MUST
+        be a JSON array: a dict would iterate its keys, a string its
+        CHARACTERS, and `null`/`0`/`false` would 500 — reject every
+        non-list shape up front so the middleware returns 400 (`[]` stays
+        valid and means "no filter")."""
+        if not isinstance(body, list):
+            raise ValueError(
+                "duties request body must be a JSON array of validator "
+                f"indices or 0x pubkeys, got {type(body).__name__}")
         pubkeys: list[bytes] = []
         indices: list[int] = []
         for x in body:

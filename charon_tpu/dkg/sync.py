@@ -92,11 +92,14 @@ class SyncProtocol:
                 try:
                     if await self._query_peer(idx) >= step:
                         pending.discard(idx)
-                except Exception:  # noqa: BLE001 — retry until deadline
+                except Exception as exc:  # noqa: BLE001 — retry until deadline
                     # a peer that already reported this step may have finished
                     # and torn down its node — count it as done
                     if self.peer_steps.get(idx, 0) >= step:
                         pending.discard(idx)
+                    else:
+                        _log.debug("dkg step query failed; will retry",
+                                   peer=idx, step=step, err=exc)
             if pending:
                 if asyncio.get_running_loop().time() > deadline:
                     raise errors.new("dkg step timeout", step=step,

@@ -9,7 +9,14 @@ the per-endpoint behaviors:
   * lazy connect/reconnect (reference app/eth2wrap/lazy.go:16): the aiohttp
     session is created on first use and torn down + rebuilt after any
     transport error, so a BN restart never wedges the client;
-  * per-endpoint latency/error metrics (eth2wrap.go:317-329).
+  * per-endpoint latency/error metrics (eth2wrap.go:317-329);
+  * optional deadline-bounded retry (reference app/retry): construct with
+    a `utils.retry.Retryer` (app.assemble wires one) and every fetch/
+    submit route transparently retries TEMPORARY failures — transport
+    errors, timeouts — inside a per-request window, while HTTP-status
+    errors and other deterministic failures surface immediately. The
+    `beacon.http` chaos site (utils/faults.py) fires per attempt, so
+    injected connection faults exercise exactly this loop.
 """
 
 from __future__ import annotations
@@ -18,11 +25,22 @@ import json
 import time
 from typing import Any
 
-from ..utils import errors, log, metrics
+from ..utils import errors, expbackoff, faults, log, metrics
+from ..utils import retry as retry_util
 from . import json_codec as jc
 from . import spec
 
 _log = log.with_topic("eth2wrap")
+
+
+def request_retryer(window: float = 10.0,
+                    backoff: expbackoff.Config = expbackoff.FAST
+                    ) -> retry_util.Retryer:
+    """A Retryer shaped for beacon routes: each request gets an absolute
+    `window`-second deadline from its FIRST attempt (routes pass no duty,
+    so the duty-deadline Retryer shape would never expire — retry.go's
+    beacon calls are likewise bounded by a fixed request budget)."""
+    return retry_util.Retryer(lambda _duty: time.time() + window, backoff)
 
 _latency = metrics.histogram(
     "app_eth2_request_duration_seconds", "BN request latency",
@@ -34,10 +52,12 @@ _errors_c = metrics.counter(
 class HTTPBeaconNode:
     """One beacon node over HTTP (aiohttp), lazily connected."""
 
-    def __init__(self, base_url: str, timeout: float = 10.0):
+    def __init__(self, base_url: str, timeout: float = 10.0,
+                 retryer: "retry_util.Retryer | None" = None):
         self.base_url = base_url.rstrip("/")
         self.name = self.base_url
         self._timeout = timeout
+        self._retryer = retryer  # None == single attempt (legacy shape)
         self._session = None  # lazy (reference lazy.go)
 
     async def _sess(self):
@@ -54,9 +74,23 @@ class HTTPBeaconNode:
 
     async def _req(self, method: str, path: str, *, params: dict | None = None,
                    body: Any = None) -> Any:
+        """One logical request: a single attempt without a retryer, else
+        retried under the retryer's deadline while the failure is
+        temporary (transport/timeout — is_temporary walks the CharonError
+        cause chain down to the raw aiohttp/OS error)."""
+        if self._retryer is None:
+            return await self._req_once(method, path, params=params,
+                                        body=body)
+        return await self._retryer.do_async(
+            None, f"beacon {method} {path}",
+            lambda: self._req_once(method, path, params=params, body=body))
+
+    async def _req_once(self, method: str, path: str, *,
+                        params: dict | None = None, body: Any = None) -> Any:
         url = self.base_url + path
         t0 = time.monotonic()
         try:
+            faults.check("beacon.http")
             sess = await self._sess()
             async with sess.request(method, url, params=params,
                                     json=body) as resp:
@@ -77,8 +111,10 @@ class HTTPBeaconNode:
                     await self._session.close()
             finally:
                 self._session = None
+            # chain the raw transport error so retry.is_temporary can
+            # classify the CharonError via its __cause__ walk
             raise errors.new("beacon transport error", path=path,
-                             err=str(exc))
+                             err=str(exc)) from exc
         finally:
             _latency.observe(time.monotonic() - t0, self.base_url)
         obj = json.loads(payload) if payload else {}

@@ -3,7 +3,7 @@
 from __future__ import annotations
 
 from .aio import UntrackedTaskRule
-from .exc import BroadExceptRule
+from .exc import BroadExceptRule, GuardSeamRule
 from .iface import ProtocolImplRule
 from .obs import DutySpanRule
 from .tpu import (DeviceDtypeRule, MeshTopologyRule, PipelineLockSyncRule,
@@ -12,6 +12,7 @@ from .tpu import (DeviceDtypeRule, MeshTopologyRule, PipelineLockSyncRule,
 __all__ = [
     "UntrackedTaskRule",
     "BroadExceptRule",
+    "GuardSeamRule",
     "DeviceDtypeRule",
     "PlaneStoreRoutingRule",
     "PipelineLockSyncRule",
@@ -26,6 +27,7 @@ def default_rules() -> list:
     return [
         UntrackedTaskRule(),
         BroadExceptRule(),
+        GuardSeamRule(),
         DeviceDtypeRule(),
         PlaneStoreRoutingRule(),
         PipelineLockSyncRule(),

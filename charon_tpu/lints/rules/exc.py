@@ -83,3 +83,44 @@ class BroadExceptRule:
                     "broad `except Exception` with no log and no re-raise "
                     "can silently swallow a duty failure; log it, re-raise, "
                     "or narrow the exception type")
+
+
+# ---------------------------------------------------------------------------
+# LINT-EXC-009 — device dispatch/readback must route through the guard seam
+# ---------------------------------------------------------------------------
+
+# The stage-2/3 completion seams whose DIRECT invocation bypasses failure
+# classification, the fallback ladder and the circuit breaker (ops/guard.py).
+_GUARDED_SEAMS = ("_fused_finish", "_fused_readback", "_fused_host_finish",
+                  "sharded_readback", "sharded_host_finish")
+# The plane internals and the guard itself legitimately call the seams.
+_SANCTIONED_FILES = ("plane_agg.py", "sharded_plane.py", "guard.py")
+
+
+class GuardSeamRule:
+    id = "LINT-EXC-009"
+    description = ("device dispatch/readback completion in ops//tbls/ must "
+                   "route through ops.guard.finish_slot — calling the "
+                   "_fused_*/sharded_* completion seams directly skips "
+                   "failure classification, the fallback ladder and the "
+                   "circuit breaker")
+
+    def check(self, src: SourceFile) -> Iterable[Finding]:
+        if not src.in_dir("ops", "tbls"):
+            return
+        if src.rel.split("/")[-1] in _SANCTIONED_FILES:
+            return
+        for node in ast.walk(src.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            fn = node.func
+            name = fn.attr if isinstance(fn, ast.Attribute) else (
+                fn.id if isinstance(fn, ast.Name) else None)
+            if name not in _GUARDED_SEAMS:
+                continue
+            yield Finding(
+                src.rel, node.lineno, self.id,
+                f"`{name}(...)` completes a device slot without the guard "
+                "seam: a device-class failure here propagates raw instead "
+                "of riding the fallback ladder/breaker — call "
+                "ops.guard.finish_slot(state, inputs) (docs/robustness.md)")

@@ -1,0 +1,380 @@
+"""Self-healing guard around the device sigagg plane.
+
+The device plane is the fastest path but also the only one that can
+fail for reasons unrelated to the inputs: a lost chip, a failed XLA
+execution or transfer, a hung fence. The reference charon retries every
+flaky step under deadline-bounded backoff and degrades gracefully; this
+module is that armor for the TPU plane. Three pieces:
+
+**Classification** (`classify`): a failure is either a deterministic
+*input* error — ValueError from a bad encoding / invalid point /
+length mismatch, which retrying cannot change and MUST propagate so
+callers attribute the offending item — or a *device*-class failure
+(`jax.errors.JaxRuntimeError`, `faults.DeviceLostFault`, timeouts,
+anything else unexpected), which is worth re-dispatching.
+
+**The fallback ladder** (`finish_slot`): a device-class failure
+invalidates the cached topology and re-packs the SAME slot on
+progressively narrower meshes — D → D/2 → … → 1 (the single-device
+fused path) — under `utils.expbackoff`, landing on the bit-identical
+`tbls.native_impl.native_slot_fallback` CPU rung when no width works.
+Every landing increments `ops_sigagg_fallback_total{reason,target}`.
+The ladder runs OFF the pipeline lock (stage-3 workers / the consuming
+thread), so concurrent packs never serialize behind a retry
+(LINT-TPU-007 still holds).
+
+**The circuit breaker** (`CircuitBreaker`): consecutive device-plane
+failures trip the whole plane to native for a cooldown —
+`plane_agg._dispatch_slot` asks `allow_device_dispatch()` before
+touching the device — then a half-open probe slot tests the way back.
+State is exported as `ops_plane_breaker_state` (0 closed / 1 half-open
+/ 2 open) and, with the fallback counter, feeds the
+`sigagg_plane_degraded` health rule.
+
+The slot watchdog (`watchdog_recover`) is the ladder's entry point for
+a *hung* fence: `SigAggPipeline` waits on slot futures with a deadline
+and hands the timed-out slot here — the stuck future is abandoned
+(nothing can safely interrupt an XLA wait) and the slot re-runs down
+the ladder, surfacing as a classified timeout instead of blocking
+`drain()` forever. See docs/robustness.md for the full taxonomy.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+
+from ..utils import expbackoff, faults, log, metrics
+
+_log = log.with_topic("guard")
+
+BREAKER_THRESHOLD_ENV = "CHARON_TPU_BREAKER_THRESHOLD"
+BREAKER_COOLDOWN_ENV = "CHARON_TPU_BREAKER_COOLDOWN_S"
+SLOT_DEADLINE_ENV = "CHARON_TPU_SLOT_DEADLINE_S"
+
+# Ladder backoff: short and tightly capped — a duty slot has a ~12 s
+# budget and the ladder may try several rungs inside it.
+LADDER_BACKOFF = expbackoff.Config(
+    base=0.05, multiplier=2.0, jitter=0.1, max_delay=1.0)
+
+_fallback_c = metrics.counter(
+    "ops_sigagg_fallback_total",
+    "Sigagg slots the guard re-dispatched off their primary plane, by "
+    "failure reason and landing target (mesh:<width> or native)",
+    ("reason", "target"))
+_breaker_g = metrics.gauge(
+    "ops_plane_breaker_state",
+    "Device-plane circuit breaker: 0 closed (device path), 1 half-open "
+    "(probing back), 2 open (every slot goes native)")
+_watchdog_c = metrics.counter(
+    "ops_sigagg_watchdog_total",
+    "Slot futures abandoned by the pipeline watchdog after their "
+    "deadline expired (hung device fence) and recovered down the ladder")
+
+CLOSED, HALF_OPEN, OPEN = 0.0, 1.0, 2.0
+
+_device_types_cache: tuple | None = None
+
+
+def _device_types() -> tuple:
+    """Exception classes that mean THE DEVICE failed, not the inputs."""
+    global _device_types_cache
+    if _device_types_cache is None:
+        types: list = [faults.DeviceLostFault, TimeoutError]
+        try:
+            import jax
+
+            types.append(jax.errors.JaxRuntimeError)
+        except Exception:  # noqa: BLE001 — no jax == nothing to classify
+            pass
+        _device_types_cache = tuple(types)
+    return _device_types_cache
+
+
+def classify(exc: BaseException) -> str:
+    """Failure taxonomy: "input" for deterministic input errors that must
+    propagate (retrying cannot change them), otherwise the retryable
+    device-class reason — "device_lost" (lost chip / failed XLA
+    execution), "timeout" (hung fence / expired deadline), or "error"
+    (unexpected; retried anyway — a transient runtime bug should not
+    cost a duty)."""
+    if isinstance(exc, ValueError):
+        return "input"
+    if isinstance(exc, TimeoutError):
+        return "timeout"
+    if isinstance(exc, _device_types()):
+        return "device_lost"
+    return "error"
+
+
+def is_device_error(exc: BaseException) -> bool:
+    """True when exc (or anything on its __cause__/cause chain) is a
+    device-class failure — i.e. systemic, not attributable to any input
+    item. core/coalesce uses this to skip its bisect attribution: halving
+    a batch cannot locate a fault that lives in the hardware."""
+    seen: set[int] = set()
+    cur: BaseException | None = exc
+    while cur is not None and id(cur) not in seen:
+        seen.add(id(cur))
+        if classify(cur) in ("device_lost", "timeout"):
+            return True
+        nxt = getattr(cur, "cause", None)
+        cur = nxt if isinstance(nxt, BaseException) else cur.__cause__
+    return False
+
+
+def _env_int(name: str, default: int) -> int:
+    try:
+        return int(os.environ.get(name, str(default)))
+    except ValueError:
+        return default
+
+
+def _env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, str(default)))
+    except ValueError:
+        return default
+
+
+def slot_deadline_default() -> float:
+    """Watchdog deadline (seconds) for pipeline slot futures; 0 disables.
+    Generous by default — a cold compile of the fused graph on CPU takes
+    minutes, and the watchdog exists for *hung* fences, not slow ones."""
+    return _env_float(SLOT_DEADLINE_ENV, 600.0)
+
+
+class CircuitBreaker:
+    """Consecutive-failure breaker over the whole device plane.
+
+    closed --(threshold consecutive slot failures)--> open
+    open --(cooldown elapsed)--> half-open (ONE probe slot allowed)
+    half-open --probe succeeds--> closed / --probe fails--> open
+    """
+
+    def __init__(self, threshold: int | None = None,
+                 cooldown: float | None = None) -> None:
+        self._threshold = max(1, threshold if threshold is not None
+                              else _env_int(BREAKER_THRESHOLD_ENV, 3))
+        self._cooldown = max(0.0, cooldown if cooldown is not None
+                             else _env_float(BREAKER_COOLDOWN_ENV, 30.0))
+        self._lock = threading.Lock()
+        self._state = CLOSED
+        self._consecutive = 0
+        self._opened_at = 0.0
+        self._probing = False
+        _breaker_g.set(CLOSED)
+
+    @property
+    def state(self) -> float:
+        with self._lock:
+            return self._state
+
+    def allow_device(self) -> bool:
+        """May the next slot touch the device? Open trips to half-open
+        once the cooldown elapses; half-open admits exactly one in-flight
+        probe slot."""
+        with self._lock:
+            if self._state == CLOSED:
+                return True
+            if (self._state == OPEN
+                    and time.monotonic() - self._opened_at >= self._cooldown):
+                self._state = HALF_OPEN
+                self._probing = False
+                _breaker_g.set(HALF_OPEN)
+                _log.info("plane breaker half-open; probing device path")
+            if self._state == HALF_OPEN and not self._probing:
+                self._probing = True
+                return True
+            return False
+
+    def record_success(self) -> None:
+        with self._lock:
+            self._consecutive = 0
+            self._probing = False
+            if self._state != CLOSED:
+                self._state = CLOSED
+                _breaker_g.set(CLOSED)
+                _log.info("plane breaker closed; device path restored")
+
+    def record_failure(self) -> None:
+        with self._lock:
+            self._consecutive += 1
+            trip = (self._state == HALF_OPEN
+                    or self._consecutive >= self._threshold)
+            if trip:
+                if self._state != OPEN:
+                    _log.warn("plane breaker OPEN; slots go native",
+                              consecutive=self._consecutive,
+                              cooldown_s=self._cooldown)
+                self._state = OPEN
+                self._opened_at = time.monotonic()
+                self._probing = False
+                _breaker_g.set(OPEN)
+
+
+BREAKER = CircuitBreaker()
+
+
+def configure(threshold: int | None = None, cooldown: float | None = None,
+              slot_deadline: float | None = None) -> None:
+    """Apply app Config knobs (breaker shape, watchdog deadline). None
+    keeps the env-var/default value for that knob."""
+    global BREAKER
+    if threshold is not None or cooldown is not None:
+        BREAKER = CircuitBreaker(threshold=threshold, cooldown=cooldown)
+    if slot_deadline is not None:
+        os.environ[SLOT_DEADLINE_ENV] = str(float(slot_deadline))
+
+
+def reset_for_testing() -> None:
+    global BREAKER, _device_types_cache
+    BREAKER = CircuitBreaker()
+    _device_types_cache = None
+
+
+def allow_device_dispatch() -> bool:
+    """plane_agg._dispatch_slot's breaker gate: False routes the slot
+    straight to the native rung with zero device work."""
+    return BREAKER.allow_device()
+
+
+def finish_slot(state, inputs, hash_fn=None):
+    """The guarded stage-2/3 seam: complete one dispatched slot, riding
+    the fallback ladder on device-class failure.
+
+    `state` is whatever plane_agg._dispatch_slot returned (including the
+    guard-specific "native_slot" breaker bypass and "dispatch_failed"
+    captured-error tags); `inputs` is the (batches, pks, msgs) snapshot
+    retained for re-packing. Deterministic input errors propagate
+    unchanged; everything else descends D → D/2 → … → 1 → native and
+    only raises if every rung fails.
+    """
+    from . import plane_agg as PA
+
+    tag = state[0]
+    if tag == "native_slot":
+        _fallback_c.inc("breaker_open", "native")
+        _log.warn("slot routed native: breaker open")
+        return _native_rung(inputs, hash_fn)
+    if tag == "dispatch_failed":
+        exc = state[1]
+        reason = classify(exc)
+        BREAKER.record_failure()
+        _log.warn("slot dispatch failed on primary plane; descending "
+                  "ladder", err=exc, reason=reason)
+        return _run_ladder(inputs, hash_fn, _primary_width() // 2,
+                           reason, exc)
+    try:
+        out = PA._fused_finish(state, hash_fn)
+    except Exception as exc:
+        reason = classify(exc)
+        if reason == "input":
+            raise
+        BREAKER.record_failure()
+        _log.warn("slot failed on primary plane; descending ladder",
+                  err=exc, reason=reason, width=_state_width(state))
+        return _run_ladder(inputs, hash_fn, _state_width(state) // 2,
+                           reason, exc)
+    BREAKER.record_success()
+    return out
+
+
+def watchdog_recover(inputs, hash_fn=None):
+    """A slot future blew its deadline: the fence is hung. Abandon the
+    stuck future (its worker thread resolves late or leaks with the hung
+    runtime) and re-run the slot down the ladder from the next-narrower
+    width, surfacing the failure as a classified timeout."""
+    _watchdog_c.inc()
+    BREAKER.record_failure()
+    _log.error("slot watchdog deadline expired; recovering down ladder")
+    return _run_ladder(
+        inputs, hash_fn, _primary_width() // 2, "watchdog_timeout",
+        TimeoutError("sigagg slot watchdog deadline expired"))
+
+
+def note_backpressure_timeout() -> None:
+    """A submit_async over-depth backpressure wait timed out. The hung
+    slot's own (wrapped) future recovers itself; this just surfaces the
+    stall so sigagg_slot_stuck trips even if the owner never consumes."""
+    _watchdog_c.inc()
+    _log.warn("pipeline backpressure wait expired; releasing submitter")
+
+
+def _primary_width() -> int:
+    from . import mesh as mesh_mod
+
+    return mesh_mod.device_count()
+
+
+def _state_width(state) -> int:
+    """Shard width the failed state was dispatched at: sharded states
+    carry D at index 2; single-device states are width 1."""
+    if state[0].startswith("sharded") and len(state) > 2 \
+            and isinstance(state[2], int):
+        return state[2]
+    return 1
+
+
+def _run_ladder(inputs, hash_fn, start_width, reason, first_exc):
+    """Re-pack and re-dispatch one slot at start_width, start_width/2, …,
+    1, then the native rung. Input errors raise immediately at any rung;
+    the topology cache is invalidated first so retries see fresh devices."""
+    from . import mesh as mesh_mod
+    from . import plane_agg as PA
+
+    batches, pks, msgs = inputs
+    mesh_mod.invalidate()
+    widths = []
+    w = start_width
+    while w > 1:
+        widths.append(w)
+        w //= 2
+    if start_width >= 1:
+        widths.append(1)
+    backoff = expbackoff.Backoff(LADDER_BACKOFF)
+    last = first_exc
+    for width in widths:
+        backoff.wait_sync()
+        try:
+            if width > 1:
+                m = mesh_mod.narrowed(width)
+                if m is None:  # not enough devices left for this rung
+                    continue
+                from . import sharded_plane
+
+                state = sharded_plane.sharded_dispatch(batches, pks, msgs, m)
+            else:
+                state = PA._fused_dispatch(
+                    PA._layout_slots(batches), pks, msgs)
+            out = PA._fused_finish(state, hash_fn)
+        except Exception as exc:
+            if classify(exc) == "input":
+                raise
+            last = exc
+            continue
+        _fallback_c.inc(reason, f"mesh:{width}")
+        _log.warn("slot recovered on narrower plane", width=width,
+                  reason=reason)
+        return out
+    _fallback_c.inc(reason, "native")
+    _log.warn("slot degraded to native plane", reason=reason)
+    try:
+        return _native_rung(inputs, hash_fn)
+    except Exception as exc:
+        if classify(exc) == "input":
+            raise
+        raise exc from last
+
+
+def _native_rung(inputs, hash_fn):
+    if hash_fn is not None:
+        # custom hash-to-curve only exists on test paths; the native rung
+        # computes the standard ETH hash and must not silently diverge
+        raise RuntimeError(
+            "native fallback cannot honor a custom hash_fn")
+    from ..tbls.native_impl import native_slot_fallback
+
+    batches, pks, msgs = inputs
+    return native_slot_fallback(batches, pks, msgs)

@@ -26,7 +26,7 @@ from __future__ import annotations
 import os
 import threading
 
-from ..utils import metrics
+from ..utils import faults, metrics
 
 # Shard-width override: >0 clamps the mesh to min(value, local devices);
 # 1 forces the single-device passthrough. Read at first resolve — set it
@@ -41,6 +41,7 @@ _mesh_devices_g = metrics.gauge(
 
 _lock = threading.Lock()
 _resolved: list = []  # [(width, mesh_or_none)] — cached after first probe
+_narrowed: dict = {}  # width -> Mesh, the guard ladder's D/2... rungs
 
 
 def _discover() -> list:
@@ -57,6 +58,7 @@ def _discover() -> list:
 
 
 def _resolve() -> tuple[int, object]:
+    faults.check("mesh.resolve")
     devices = _discover()
     n = len(devices)
     try:
@@ -103,6 +105,42 @@ def sigagg_mesh():
         return _resolved[0][1]
 
 
+def narrowed(width: int):
+    """A cached 1-D "data" Mesh over the first `width` resolved devices —
+    the D/2 … 2 rungs of ops.guard's fallback ladder. Returns None when
+    `width` <= 1 (callers take the single-device `_fused_dispatch` path)
+    or when fewer than `width` devices are usable. Cached per width so
+    `sharded_plane._build_steps`'s lru_cache keys stay stable across
+    retries — every retry at width W reuses ONE Mesh object and its
+    compiled sharded executables."""
+    width = int(width)
+    if width <= 1:
+        return None
+    with _lock:
+        if width in _narrowed:
+            return _narrowed[width]
+    devices = _discover()
+    if len(devices) < width:
+        return None
+    import numpy as np
+    from jax.sharding import Mesh
+
+    m = Mesh(np.asarray(devices[:width]), axis_names=("data",))
+    with _lock:
+        # keep the first instance if a concurrent rung built one too
+        return _narrowed.setdefault(width, m)
+
+
+def invalidate() -> None:
+    """Drop every cached mesh (primary and narrowed) so the next dispatch
+    re-probes the topology. ops.guard calls this after classifying a
+    device-lost failure: the device set may genuinely have changed, and a
+    stale Mesh over a dead chip would fail every retry."""
+    with _lock:
+        _resolved.clear()
+        _narrowed.clear()
+
+
 def set_override(n: int | None) -> None:
     """Apply a configured shard-width clamp (app Config.sigagg_devices)
     and drop the cached resolve so the next dispatch sees it. None clears
@@ -120,3 +158,4 @@ def reset_for_testing() -> None:
     also makes subsequent slots recompile — production never resets."""
     with _lock:
         _resolved.clear()
+        _narrowed.clear()

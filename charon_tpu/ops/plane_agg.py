@@ -39,7 +39,8 @@ import functools
 import os
 import threading
 from collections import OrderedDict, deque
-from concurrent.futures import Future, ThreadPoolExecutor
+from concurrent.futures import Future, InvalidStateError, ThreadPoolExecutor
+from concurrent.futures import TimeoutError as _FuturesTimeout
 from concurrent.futures import wait as _futures_wait
 
 import jax
@@ -47,7 +48,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..crypto import fields as PF
-from ..utils import metrics, tracer
+from ..utils import faults, metrics, tracer
 from ..crypto.curve import (g1_generator, jac_add, jac_is_infinity, FqOps,
                             Fq2Ops)
 from ..crypto.rlc import RLC_BITS, sample_randomizers
@@ -774,14 +775,10 @@ def threshold_aggregate_and_verify(batches: list[dict[int, bytes]],
         out = _serialize_aggregates(RX, RY, RZ, V)
         return out, _rlc_finish(state, hash_fn)
 
-    m = _sigagg_mesh()
-    if m is not None:
-        from . import sharded_plane
+    from . import guard
 
-        state = sharded_plane.sharded_dispatch(batches, pks, msgs, m)
-    else:
-        state = _fused_dispatch(layout, pks, msgs)
-    return _fused_finish(state, hash_fn)
+    state = _dispatch_slot(batches, pks, msgs)
+    return guard.finish_slot(state, (batches, pks, msgs), hash_fn)
 
 
 def _sigagg_mesh():
@@ -797,13 +794,29 @@ def _dispatch_slot(batches, pks, msgs):
     """Stage-1 router for SigAggPipeline: sharded pack+dispatch across the
     mesh when ops.mesh reports >1 device, the single-device fused dispatch
     otherwise. Both sides are pure host-work + enqueue (no device sync),
-    so the pipeline lock may cover this call (LINT-TPU-007)."""
-    m = _sigagg_mesh()
-    if m is not None:
-        from . import sharded_plane
+    so the pipeline lock may cover this call (LINT-TPU-007).
 
-        return sharded_plane.sharded_dispatch(batches, pks, msgs, m)
-    return _fused_dispatch(_layout_slots(batches), pks, msgs)
+    Guard integration (docs/robustness.md): when the plane circuit
+    breaker is open the slot never touches the device — the "native_slot"
+    tag sends guard.finish_slot straight to the bit-identical CPU rung.
+    Device-class dispatch failures are *captured* as "dispatch_failed"
+    (not raised) so the fallback ladder runs at finish time, OFF this
+    lock; deterministic input errors still raise to the submitter."""
+    from . import guard
+
+    if not guard.allow_device_dispatch():
+        return ("native_slot",)
+    try:
+        m = _sigagg_mesh()
+        if m is not None:
+            from . import sharded_plane
+
+            return sharded_plane.sharded_dispatch(batches, pks, msgs, m)
+        return _fused_dispatch(_layout_slots(batches), pks, msgs)
+    except Exception as exc:
+        if guard.classify(exc) == "input":
+            raise
+        return ("dispatch_failed", exc)
 
 
 def _fused_dispatch(layout, pks, msgs):
@@ -823,6 +836,7 @@ def _fused_dispatch(layout, pks, msgs):
 
 
 def _fused_dispatch_impl(layout, pks, msgs):
+    faults.check("sigagg.pack")
     sigs_all, scalars_all, V, Vp, T, Wv = layout
     body, _fin, sgn, loaded = _parse_compressed(
         sigs_all, 96, "G2", False, Vp * T)
@@ -863,6 +877,7 @@ def _fused_readback(state, span=None):
     pass through untouched — there is no device work to wait for).
     Sharded-plane states (tag "sharded*") delegate to
     sharded_plane.sharded_readback — same phases, per-shard drain."""
+    faults.check("sigagg.execute")
     if state[0].startswith("sharded"):
         from . import sharded_plane
 
@@ -876,6 +891,7 @@ def _fused_readback(state, span=None):
         jax.block_until_ready(outs)
     if span is not None:
         span.add_event("device_fence")
+    faults.check("sigagg.readback")
     with _dispatch_hist.observe_time("drain"):
         host = jax.device_get(outs)
     return ("host", V, group_msgs, host)
@@ -889,6 +905,7 @@ def _fused_host_finish(hstate, hash_fn=None):
     this on a worker thread overlapping the next slot's pack and the
     in-flight device execute. The whole body is the "finish" phase of
     ops_device_dispatch_seconds."""
+    faults.check("sigagg.finish")
     if hstate[0].startswith("sharded"):
         from . import sharded_plane
 
@@ -921,13 +938,30 @@ PIPELINE_DEPTH = int(os.environ.get("CHARON_TPU_PIPELINE_DEPTH", "2"))
 FINISH_WORKERS = int(os.environ.get("CHARON_TPU_FINISH_WORKERS", "2"))
 
 
-def _run_finish(ctx, state, hash_fn):
+def _run_finish(ctx, state, inputs, hash_fn):
     """Stage-3 worker body: complete one slot inside the submitter's copied
-    contextvars (tracer spans land in the submitting duty's trace)."""
+    contextvars (tracer spans land in the submitting duty's trace). Routes
+    through guard.finish_slot so a device-class failure rides the fallback
+    ladder on this worker thread — off the pipeline lock — instead of
+    surfacing as an error at the pop."""
+    from . import guard
+
     try:
-        return ctx.run(_fused_finish, state, hash_fn)
+        return ctx.run(guard.finish_slot, state, inputs, hash_fn)
     finally:
         _finish_backlog.inc(amount=-1.0)
+
+
+def _settle(fut: Future, value=None, exc: BaseException | None = None):
+    """Resolve a watchdog-wrapped future, tolerating a lost race with the
+    other resolver (late worker vs fired watchdog)."""
+    try:
+        if exc is not None:
+            fut.set_exception(exc)
+        else:
+            fut.set_result(value)
+    except InvalidStateError:
+        pass  # the other side already settled it — their result stands
 
 
 class SigAggPipeline:
@@ -972,15 +1006,25 @@ class SigAggPipeline:
     """
 
     def __init__(self, depth: int | None = None,
-                 finish_workers: int | None = None):
+                 finish_workers: int | None = None,
+                 slot_deadline: float | None = None):
+        from . import guard
+
         self._depth = max(1, PIPELINE_DEPTH if depth is None else depth)
         self._workers = max(1, FINISH_WORKERS if finish_workers is None
                             else finish_workers)
+        # Watchdog: slot futures gain a deadline so a hung device fence
+        # surfaces as a classified timeout riding the guard's fallback
+        # ladder instead of blocking drain() forever. 0 disables.
+        self._deadline = (guard.slot_deadline_default()
+                          if slot_deadline is None else slot_deadline)
         self._lock = threading.Lock()
-        self._pending: deque = deque()  # Futures, FIFO dispatch order
+        # FIFO of (future, (batches, pks, msgs), hash_fn) in dispatch
+        # order — the inputs snapshot is what the watchdog re-packs
+        self._pending: deque = deque()
         self._pool: ThreadPoolExecutor | None = None
 
-    def _schedule_finish(self, state, hash_fn) -> Future:
+    def _schedule_finish(self, state, inputs, hash_fn) -> Future:
         # caller holds self._lock; scheduling only — no device sync here
         if self._pool is None:
             self._pool = ThreadPoolExecutor(
@@ -988,7 +1032,24 @@ class SigAggPipeline:
                 thread_name_prefix="sigagg-finish")
         _finish_backlog.inc()
         ctx = contextvars.copy_context()
-        return self._pool.submit(_run_finish, ctx, state, hash_fn)
+        return self._pool.submit(_run_finish, ctx, state, inputs, hash_fn)
+
+    def _pop_result(self, entry):
+        """Consume one pending slot's result, watchdog-bounded: a future
+        that misses the deadline is abandoned (its worker is stuck on a
+        hung fence) and the slot re-runs down the guard ladder on THIS
+        thread — outside the lock, so concurrent packs continue."""
+        fut, inputs, hash_fn = entry
+        if not self._deadline:
+            return fut.result()
+        try:
+            return fut.result(timeout=self._deadline)
+        except (_FuturesTimeout, TimeoutError):
+            if fut.done():
+                raise  # the SLOT raised a timeout (ladder exhausted)
+            from . import guard
+
+            return guard.watchdog_recover(inputs, hash_fn)
 
     def submit(self, batches, pks, msgs, hash_fn=None) -> list:
         """Pack + async-dispatch one slot; its stage-3 finish is scheduled
@@ -997,15 +1058,18 @@ class SigAggPipeline:
         with every previous submit); pair with drain() for the tail."""
         with tracer.start_span("ops/sigagg_pipeline/submit",
                                slots=len(batches)) as span:
+            inputs = (batches, pks, msgs)
             with self._lock:
                 state = _dispatch_slot(batches, pks, msgs)
-                self._pending.append(self._schedule_finish(state, hash_fn))
+                self._pending.append(
+                    (self._schedule_finish(state, inputs, hash_fn),
+                     inputs, hash_fn))
                 over = (self._pending.popleft()
                         if len(self._pending) > self._depth else None)
                 span.attrs["in_flight"] = len(self._pending)
             # block OUTSIDE the lock: the popped slot's finish may still be
             # running on a worker; a concurrent submit packs meanwhile
-            return [over.result()] if over is not None else []
+            return [self._pop_result(over)] if over is not None else []
 
     def submit_async(self, batches, pks, msgs, hash_fn=None) -> Future:
         """Pack + async-dispatch one slot and return a Future resolving to
@@ -1016,18 +1080,60 @@ class SigAggPipeline:
         concurrent callers each get exactly their own."""
         with tracer.start_span("ops/sigagg_pipeline/submit",
                                slots=len(batches)) as span:
+            inputs = (batches, pks, msgs)
             with self._lock:
                 state = _dispatch_slot(batches, pks, msgs)
-                fut = self._schedule_finish(state, hash_fn)
-                self._pending.append(fut)
+                fut = self._schedule_finish(state, inputs, hash_fn)
+                self._pending.append((fut, inputs, hash_fn))
                 over = (self._pending.popleft()
                         if len(self._pending) > self._depth else None)
                 span.attrs["in_flight"] = len(self._pending)
             if over is not None:
                 # backpressure only: wait, don't .result() — the popped
-                # future's owner consumes its value/exception
-                _futures_wait([over])
-            return fut
+                # future's owner consumes its value/exception. Deadline-
+                # bounded: a hung slot must not wedge every submitter
+                # (its own wrapped future watchdog-recovers the result).
+                _done, not_done = _futures_wait(
+                    [over[0]], timeout=self._deadline or None)
+                if not_done:
+                    from . import guard
+
+                    guard.note_backpressure_timeout()
+            if not self._deadline:
+                return fut
+            return self._watchdog_wrap(fut, inputs, hash_fn)
+
+    def _watchdog_wrap(self, fut: Future, inputs, hash_fn) -> Future:
+        """Clone `fut` onto a deadline: the returned future resolves from
+        the worker when it finishes in time, or from the guard ladder on
+        a timer thread when the deadline expires first (the stuck inner
+        future is abandoned; whichever side settles first wins)."""
+        out: Future = Future()
+        out.set_running_or_notify_cancel()
+
+        def _copy(src: Future) -> None:
+            timer.cancel()
+            exc = src.exception()
+            _settle(out, value=None if exc is not None else src.result(),
+                    exc=exc)
+
+        def _expire() -> None:
+            if fut.done():
+                return
+            from . import guard
+
+            try:
+                res = guard.watchdog_recover(inputs, hash_fn)
+            except BaseException as exc:  # noqa: BLE001 — future boundary
+                _settle(out, exc=exc)
+            else:
+                _settle(out, value=res)
+
+        timer = threading.Timer(self._deadline, _expire)
+        timer.daemon = True
+        timer.start()
+        fut.add_done_callback(_copy)
+        return out
 
     def drain(self) -> list:
         """Finish every in-flight slot, oldest first (blocking)."""
@@ -1038,8 +1144,8 @@ class SigAggPipeline:
                     if not self._pending:
                         span.attrs["drained"] = len(out)
                         return out
-                    fut = self._pending.popleft()
-                out.append(fut.result())
+                    entry = self._pending.popleft()
+                out.append(self._pop_result(entry))
 
     def aggregate_verify(self, batches, pks, msgs, hash_fn=None):
         """Dispatch this slot and block for ITS result (the tbls
@@ -1050,9 +1156,11 @@ class SigAggPipeline:
         behind the executor."""
         with tracer.start_span("ops/sigagg_pipeline/aggregate_verify",
                                slots=len(batches)):
+            from . import guard
+
             with self._lock:
                 state = _dispatch_slot(batches, pks, msgs)
-            return _fused_finish(state, hash_fn)
+            return guard.finish_slot(state, (batches, pks, msgs), hash_fn)
 
     def close(self) -> None:
         """Shut the stage-3 executor down (waits for in-flight finishes).

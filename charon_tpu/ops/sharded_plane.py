@@ -42,7 +42,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-from ..utils import metrics, tracer
+from ..utils import faults, metrics, tracer
 from . import pallas_plane as PP
 from . import plane_agg as PA
 
@@ -219,6 +219,7 @@ def sharded_dispatch(batches, pks, msgs, mesh, rs=None):
     with tracer.start_span("ops/sharded_dispatch", validators=V,
                            shards=D) as span, \
             PA._dispatch_hist.observe_time("pack"):
+        faults.check("sigagg.pack")
         try:
             PA.validate_pk_set([bytes(p) for p in pks])
         except ValueError:
@@ -345,6 +346,7 @@ def sharded_readback(state, span=None):
         jax.block_until_ready(red_outs)
     if span is not None:
         span.add_event("device_fence")
+    faults.check("sigagg.readback")
     with PA._dispatch_hist.observe_time("drain"):
         per = [_shards_by_index(a, D) for a in shard_outs]
         if all(p is not None for p in per):
@@ -402,6 +404,10 @@ def threshold_aggregate_and_verify_sharded(
     MULTICHIP dryrun and tests drive directly). Same contract as
     plane_agg.threshold_aggregate_and_verify: returns (compressed
     aggregates, all_valid), degrading to all_valid=False on an invalid or
-    out-of-subgroup pubkey like the single-chip path."""
+    out-of-subgroup pubkey like the single-chip path. Completion routes
+    through guard.finish_slot, so a device-class failure rides the
+    fallback ladder here too."""
+    from . import guard
+
     state = sharded_dispatch(batches, pks, msgs, mesh, rs=rs)
-    return PA._fused_finish(state, hash_fn)
+    return guard.finish_slot(state, (batches, pks, msgs), hash_fn)

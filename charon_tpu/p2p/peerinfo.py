@@ -79,6 +79,6 @@ class PeerInfo:
                     await self.exchange_once(idx)
                 except asyncio.CancelledError:
                     return
-                except Exception:  # noqa: BLE001 — ping covers liveness logging
-                    pass
+                except Exception as exc:  # noqa: BLE001 — ping covers liveness
+                    _log.debug("peerinfo exchange failed", peer=idx, err=exc)
             await asyncio.sleep(self._interval)

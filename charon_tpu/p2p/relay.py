@@ -92,7 +92,9 @@ class RelayServer:
                 # notifications and nothing else inbound.
                 while True:
                     await ch.read()
-            except Exception:  # noqa: BLE001 — registration dropped
+            except Exception as exc:  # noqa: BLE001 — registration dropped
+                _log.debug("relay registration connection closed",
+                           peer=peer.hex()[:12], err=exc)
                 if self._registered.get(peer) is ch:
                     del self._registered[peer]
         elif kind == "dial":
@@ -107,7 +109,10 @@ class RelayServer:
             try:
                 await reg.write(json.dumps({"cmd": "incoming", "from": peer.hex()}).encode())
                 accept_ch = await asyncio.wait_for(fut, timeout=10.0)
-            except (asyncio.TimeoutError, Exception):  # noqa: BLE001
+            except Exception as exc:  # noqa: BLE001 — dial leg fails closed
+                _log.debug("relay dial failed: target did not accept",
+                           dialer=peer.hex()[:12], target=target.hex()[:12],
+                           err=exc)
                 self._awaiting_accept.pop(peer + target, None)
                 await ch.write(json.dumps({"ok": False, "error": "target did not accept"}).encode())
                 await ch.close()
@@ -133,8 +138,8 @@ class RelayServer:
             try:
                 while True:
                     await dst.write(await src.read())
-            except Exception:  # noqa: BLE001 — either side closing ends the splice
-                pass
+            except Exception as exc:  # noqa: BLE001 — closing ends the splice
+                _log.debug("relay splice ended", err=exc)
 
         t1 = aio.spawn(pump(a, b), name="relay-splice-ab")
         t2 = aio.spawn(pump(b, a), name="relay-splice-ba")
@@ -219,6 +224,8 @@ class RelayClient:
                     raise errors.new("relay dial refused", reason=resp.get("error"))
                 return await SecureChannel.initiate(outer, self._node.privkey, spec.pubkey)
             except Exception as exc:  # noqa: BLE001 — try next relay
+                _log.debug("relay dial attempt failed; trying next",
+                           relay=f"{host}:{port}", err=exc)
                 last = exc
                 if outer is not None:
                     await outer.close()

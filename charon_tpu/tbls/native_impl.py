@@ -242,3 +242,24 @@ def best_cpu_impl():
         from .python_impl import PythonImpl
 
         return PythonImpl()
+
+
+def native_slot_fallback(batches, public_keys, datas):
+    """Final rung of the ops.guard fallback ladder: run one sigagg slot
+    entirely on the CPU, with the device plane's output contract —
+    compressed aggregate BYTES (not Signature objects) plus the batch
+    validity bit. Both planes compute Σ λⱼ·sigⱼ exactly and emit the same
+    ETH serialization, so a slot that degrades here is bit-identical to
+    the device result it replaces (the tbls oracle suite is the proof).
+
+    Accepts the plane path's raw-bytes inputs (dict values / pubkeys /
+    messages are plain bytes); deterministic encoding errors raise
+    ValueError just like the device load does, so guard's input/device
+    classification is stable across rungs.
+    """
+    if not batches:
+        return [], True
+    impl = best_cpu_impl()
+    sigs, ok = impl.threshold_aggregate_verify_batch(
+        batches, public_keys, datas)
+    return [bytes(s) for s in sigs], ok

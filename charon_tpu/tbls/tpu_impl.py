@@ -56,13 +56,21 @@ def _device_runtime_errors() -> tuple:
     runtime — distinct from input-validation ValueErrors, which must
     propagate. A transient device fault must not fail a duty: the batch
     falls back to the native CPU path (same results, slower), like the
-    reference's tolerance of individual BN failures."""
+    reference's tolerance of individual BN failures. ops.guard ladders
+    most of these away before they reach this layer; this tuple is the
+    last-resort belt over the guard's braces (and TimeoutError covers an
+    exhausted watchdog ladder). faults.DeviceLostFault is the chaos
+    seam's injected stand-in, so chaos runs degrade identically to real
+    losses even where jax raises a different concrete type."""
+    from ..utils import faults
+
+    base: tuple = (faults.DeviceLostFault, TimeoutError)
     try:
         import jax
 
-        return (jax.errors.JaxRuntimeError,)
+        return base + (jax.errors.JaxRuntimeError,)
     except Exception:  # noqa: BLE001 — no jax, no device errors
-        return ()
+        return base
 
 
 _DEVICE_RUNTIME_ERRORS = _device_runtime_errors()

@@ -1,0 +1,99 @@
+"""Chaos-test helpers: fault-plan builders and recovery-metric probes.
+
+Thin sugar over `utils/faults` + the guard metrics so chaos harnesses
+(tests/, the `chaosdryrun` entry mode) read declaratively::
+
+    with chaos.armed(chaos.device_lost("sigagg.execute", index=2)):
+        run_duties()
+    assert chaos.fallback_total() > 0        # the ladder fired
+    assert chaos.breaker_state() == 0.0      # and the plane re-closed
+
+Everything here reads the in-process metrics registry directly — no
+/metrics scrape needed — so assertions stay exact (no window aliasing).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+
+from ..utils import faults, metrics
+
+
+# -- plan builders ------------------------------------------------------------
+
+def entry(site: str, index: int = 0, *, count: int = 1,
+          kind: str = "device_lost", msg: str = "") -> dict:
+    """One validated fault-plan entry (validation happens at arm time)."""
+    return {"site": site, "index": index, "count": count,
+            "kind": kind, "msg": msg}
+
+
+def device_lost(site: str, index: int = 0, count: int = 1) -> list[dict]:
+    return [entry(site, index, count=count, kind="device_lost")]
+
+
+def timeout(site: str, index: int = 0, count: int = 1) -> list[dict]:
+    return [entry(site, index, count=count, kind="timeout")]
+
+
+def connection(site: str, index: int = 0, count: int = 1) -> list[dict]:
+    return [entry(site, index, count=count, kind="connection")]
+
+
+def plan_json(*entry_lists: list[dict]) -> str:
+    """Merge entry lists into the JSON form CHARON_TPU_FAULT_PLAN takes —
+    the shape subprocess chaos dryruns inherit through the environment."""
+    merged: list[dict] = []
+    for entries in entry_lists:
+        merged.extend(entries)
+    return json.dumps(merged)
+
+
+@contextlib.contextmanager
+def armed(*entry_lists: list[dict]):
+    """Arm a plan for the duration of a with-block, disarming on exit even
+    when the block raises (a leaked plan would poison later tests)."""
+    plan = faults.arm([e for entries in entry_lists for e in entries])
+    try:
+        yield plan
+    finally:
+        faults.disarm()
+
+
+# -- recovery-metric probes ---------------------------------------------------
+
+def injected_total(site: str | None = None) -> float:
+    """faults_injected_total, for one site or summed across all."""
+    c = metrics.default_registry.counter("faults_injected_total")
+    if site is not None:
+        return c.value(site)
+    with c._lock:
+        return sum(c._children.values())
+
+
+def _guard_metrics():
+    # importing the guard registers its metrics with the right label shape
+    # BEFORE we look them up (Registry._register is first-writer-wins)
+    from ..ops import guard  # noqa: F401 — side-effect import
+
+    return metrics.default_registry
+
+
+def fallback_total(reason: str | None = None,
+                   target: str | None = None) -> float:
+    """ops_sigagg_fallback_total{reason,target}; None wildcards a label."""
+    c = _guard_metrics().counter("ops_sigagg_fallback_total")
+    with c._lock:
+        return sum(v for (r, t), v in c._children.items()
+                   if (reason is None or r == reason)
+                   and (target is None or t == target))
+
+
+def breaker_state() -> float:
+    """ops_plane_breaker_state: 0.0 closed / 1.0 half-open / 2.0 open."""
+    return _guard_metrics().gauge("ops_plane_breaker_state").value()
+
+
+def watchdog_total() -> float:
+    return _guard_metrics().counter("ops_sigagg_watchdog_total").value()

@@ -1,0 +1,189 @@
+"""Deterministic fault-injection seam for chaos runs.
+
+The reference charon hardens every flaky step (device loss, beacon
+blips, peer drops) behind retries and fallbacks; to *test* that armor
+this module gives the pipeline named injection sites that raise a
+planned error on an exact invocation, so a chaos run is reproducible
+bit-for-bit: the same plan always kills the same slot of the same run.
+
+A site is a cheap `faults.check("sigagg.execute")` call on the real
+code path. Disarmed (the default, production) the check is one module
+global read and a compare — no locks, no counters, no allocation.
+Armed, each call counts the site's invocations under a lock and raises
+the planned exception when an armed (site, index) window matches,
+incrementing `faults_injected_total{site}`.
+
+Plans are keyed on (site, invocation index) and armed either
+programmatically (`faults.arm([...])`, tests/chaos harnesses) or via
+the `CHARON_TPU_FAULT_PLAN` environment variable holding the same JSON
+(subprocess dryruns). Entry shape::
+
+    {"site": "sigagg.execute",   # one of SITES
+     "index": 2,                 # 0-based invocation that fires
+     "count": 1,                 # optional: consecutive firings (default 1)
+     "kind": "device_lost",      # one of KINDS (default "device_lost")
+     "msg": "..."}               # optional exception text
+
+Failure taxonomy (docs/robustness.md): the *kind* picks the exception
+class, which is what `ops.guard.classify` keys its retry decision on —
+`device_lost` and `timeout` ride the fallback ladder, `input` is a
+deterministic error that must propagate, `connection` exercises the
+Retryer-wired network paths.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+
+from . import metrics
+
+PLAN_ENV = "CHARON_TPU_FAULT_PLAN"
+
+# Every named injection site on the pipeline. Plans naming anything else
+# are rejected at arm time — a typo'd site would otherwise silently
+# never fire and the chaos run would assert against a healthy system.
+SITES = (
+    "sigagg.pack",      # host parse + async device dispatch (stage 1)
+    "sigagg.execute",   # device fence (stage 2)
+    "sigagg.readback",  # device->host transfer (stage 2/3 boundary)
+    "sigagg.finish",    # pure-host back half (stage 3)
+    "mesh.resolve",     # topology probe (ops/mesh._resolve)
+    "beacon.http",      # HTTPBeaconNode request attempts
+    "parsigex.recv",    # inbound partial-signature handling
+)
+
+
+class DeviceLostFault(RuntimeError):
+    """Injected stand-in for a lost device / failed XLA execution.
+
+    `ops.guard.classify` treats it exactly like `jax.errors.
+    JaxRuntimeError`; `tbls.tpu_impl` lists it in its device-error
+    tuple, so an injected loss degrades identically to a real one even
+    on hosts whose jax build raises a different concrete type.
+    """
+
+
+KINDS = {
+    "device_lost": DeviceLostFault,
+    "timeout": TimeoutError,
+    "input": ValueError,
+    "connection": ConnectionError,
+    "error": RuntimeError,
+}
+
+_injected_c = metrics.counter(
+    "faults_injected_total",
+    "Planned faults raised by the chaos injection seam, by site",
+    ("site",))
+
+_lock = threading.Lock()
+_plan: "FaultPlan | None" = None  # None == disarmed: check() is a no-op
+_counts: dict[str, int] = {}      # site -> invocations since arm()
+
+
+class FaultPlan:
+    """A validated, immutable set of (site, index window) -> exception."""
+
+    def __init__(self, entries) -> None:
+        self._by_site: dict[str, list[tuple[int, int, str, str]]] = {}
+        for e in entries:
+            site = e.get("site")
+            if site not in SITES:
+                raise ValueError(f"unknown fault site: {site!r}")
+            kind = e.get("kind", "device_lost")
+            if kind not in KINDS:
+                raise ValueError(f"unknown fault kind: {kind!r}")
+            index = int(e.get("index", 0))
+            count = int(e.get("count", 1))
+            if index < 0 or count < 1:
+                raise ValueError("fault index must be >= 0, count >= 1")
+            msg = e.get("msg", "")
+            self._by_site.setdefault(site, []).append(
+                (index, index + count, kind, msg))
+
+    def spec_for(self, site: str, idx: int):
+        """(kind, msg) when invocation `idx` of `site` is armed, else None."""
+        for start, end, kind, msg in self._by_site.get(site, ()):
+            if start <= idx < end:
+                return kind, msg
+        return None
+
+    @property
+    def sites(self) -> tuple[str, ...]:
+        return tuple(sorted(self._by_site))
+
+
+def parse_plan(spec) -> FaultPlan:
+    """Build a FaultPlan from a JSON string, a list of entry dicts, or an
+    existing FaultPlan (pass-through)."""
+    if isinstance(spec, FaultPlan):
+        return spec
+    if isinstance(spec, str):
+        spec = json.loads(spec)
+    if isinstance(spec, dict):  # {"entries": [...]} wrapper form
+        spec = spec.get("entries", [])
+    return FaultPlan(spec)
+
+
+def arm(spec) -> FaultPlan:
+    """Arm a plan (JSON string / entry list / FaultPlan) and reset the
+    per-site invocation counters so runs are reproducible."""
+    global _plan
+    plan = parse_plan(spec)
+    with _lock:
+        _counts.clear()
+        _plan = plan
+    return plan
+
+
+def arm_from_env() -> "FaultPlan | None":
+    """Arm from CHARON_TPU_FAULT_PLAN when set (subprocess chaos dryruns);
+    returns the plan or None when the variable is absent/empty."""
+    raw = os.environ.get(PLAN_ENV, "").strip()
+    if not raw:
+        return None
+    return arm(raw)
+
+
+def disarm() -> None:
+    """Return to the zero-overhead production state."""
+    global _plan
+    with _lock:
+        _plan = None
+        _counts.clear()
+
+
+def active() -> bool:
+    return _plan is not None
+
+
+def invocations(site: str) -> int:
+    """How many times `site` was reached since arm() (0 when disarmed) —
+    chaos harnesses use this to assert the faulted path actually ran."""
+    with _lock:
+        return _counts.get(site, 0)
+
+
+def check(site: str) -> None:
+    """The injection site. Disarmed: a single global read. Armed: count
+    this invocation and raise the planned exception if one matches."""
+    if _plan is None:
+        return
+    _raise_if_armed(site)
+
+
+def _raise_if_armed(site: str) -> None:
+    with _lock:
+        plan = _plan
+        if plan is None:  # disarmed between the fast check and the lock
+            return
+        idx = _counts.get(site, 0)
+        _counts[site] = idx + 1
+        spec = plan.spec_for(site, idx)
+    if spec is None:
+        return
+    kind, msg = spec
+    _injected_c.inc(site)
+    raise KINDS[kind](msg or f"injected {kind} fault at {site}[{idx}]")

@@ -35,8 +35,10 @@ REPO = Path(__file__).resolve().parents[1]
 # (a concurrent tier-1 run, cold page cache) produced spurious rc=124s.
 # Hold ~1.4-1.5x instead: still inside the driver's kill window, and a
 # genuine graph addition (the +352 s class of regression this test
-# exists to catch) still blows through it unambiguously.
-BUDGET_S = 780
+# exists to catch) still blows through it unambiguously. (800 s: the
+# guarded dispatch seam adds a little host-side work per slot but no new
+# compiled graph — the inventory print still pins the module set.)
+BUDGET_S = 800
 
 
 @pytest.mark.scale

@@ -1,0 +1,437 @@
+"""Fault-injection seam (utils/faults) + self-healing device plane
+(ops/guard): plan arming and exact-invocation firing, the failure
+taxonomy, the fallback ladder (narrower mesh → single device → native),
+the plane circuit breaker, and the pipeline slot watchdog — all on
+stubbed device stages, so the whole chaos story runs in tier-1 time.
+The real-graph bit-identity chaos run is `__graft_entry__.py
+chaosdryrun` (slow tier)."""
+
+import threading
+import time
+
+import pytest
+
+from charon_tpu.ops import guard, mesh, plane_agg, sharded_plane
+from charon_tpu.testutil import chaos
+from charon_tpu.utils import expbackoff, faults
+
+INPUTS = (["batches"], ["pks"], ["msgs"])
+
+
+@pytest.fixture(autouse=True)
+def _clean_guard_and_plan():
+    faults.disarm()
+    guard.reset_for_testing()
+    yield
+    faults.disarm()
+    guard.reset_for_testing()
+
+
+@pytest.fixture
+def no_backoff(monkeypatch):
+    monkeypatch.setattr(guard, "LADDER_BACKOFF",
+                        expbackoff.Config(base=0.0, jitter=0.0))
+
+
+# ---------------------------------------------------------------------------
+# utils/faults — the injection seam
+# ---------------------------------------------------------------------------
+
+
+class TestFaultPlan:
+    def test_rejects_unknown_site_kind_and_bad_windows(self):
+        with pytest.raises(ValueError, match="unknown fault site"):
+            faults.parse_plan([{"site": "sigagg.exploded"}])
+        with pytest.raises(ValueError, match="unknown fault kind"):
+            faults.parse_plan([{"site": "sigagg.pack", "kind": "gremlin"}])
+        with pytest.raises(ValueError, match="index"):
+            faults.parse_plan([{"site": "sigagg.pack", "index": -1}])
+        with pytest.raises(ValueError, match="count"):
+            faults.parse_plan([{"site": "sigagg.pack", "count": 0}])
+
+    def test_parse_forms_json_dict_wrapper_and_passthrough(self):
+        p1 = faults.parse_plan('[{"site": "mesh.resolve"}]')
+        p2 = faults.parse_plan({"entries": [{"site": "mesh.resolve"}]})
+        assert p1.sites == p2.sites == ("mesh.resolve",)
+        assert faults.parse_plan(p1) is p1
+
+    def test_fires_on_exact_invocation_window(self):
+        faults.arm([{"site": "sigagg.execute", "index": 2, "count": 2,
+                     "kind": "device_lost"}])
+        outcomes = []
+        for _ in range(6):
+            try:
+                faults.check("sigagg.execute")
+                outcomes.append("ok")
+            except faults.DeviceLostFault:
+                outcomes.append("boom")
+        assert outcomes == ["ok", "ok", "boom", "boom", "ok", "ok"]
+        assert faults.invocations("sigagg.execute") == 6
+
+    def test_kind_selects_exception_class(self):
+        faults.arm([{"site": "beacon.http", "kind": "connection",
+                     "msg": "cable pulled"}])
+        with pytest.raises(ConnectionError, match="cable pulled"):
+            faults.check("beacon.http")
+
+    def test_disarmed_is_a_noop_and_counts_nothing(self):
+        for _ in range(3):
+            faults.check("sigagg.pack")
+        assert faults.invocations("sigagg.pack") == 0
+        assert not faults.active()
+
+    def test_arm_resets_counters_for_reproducibility(self):
+        faults.arm([{"site": "sigagg.pack", "index": 0}])
+        with pytest.raises(faults.DeviceLostFault):
+            faults.check("sigagg.pack")
+        faults.arm([{"site": "sigagg.pack", "index": 0}])
+        with pytest.raises(faults.DeviceLostFault):
+            faults.check("sigagg.pack")  # same plan, same firing invocation
+
+    def test_arm_from_env(self, monkeypatch):
+        monkeypatch.setenv(faults.PLAN_ENV,
+                           '[{"site": "parsigex.recv", "kind": "error"}]')
+        plan = faults.arm_from_env()
+        assert plan is not None and plan.sites == ("parsigex.recv",)
+        with pytest.raises(RuntimeError):
+            faults.check("parsigex.recv")
+        monkeypatch.setenv(faults.PLAN_ENV, "")
+        assert faults.arm_from_env() is None
+
+    def test_injected_counter_increments_per_firing(self):
+        before = chaos.injected_total("mesh.resolve")
+        with chaos.armed(chaos.device_lost("mesh.resolve", index=0,
+                                           count=2)):
+            for _ in range(3):
+                try:
+                    faults.check("mesh.resolve")
+                except faults.DeviceLostFault:
+                    pass
+        assert chaos.injected_total("mesh.resolve") == before + 2
+
+
+# ---------------------------------------------------------------------------
+# classification
+# ---------------------------------------------------------------------------
+
+
+class TestClassify:
+    def test_taxonomy(self):
+        assert guard.classify(ValueError("bad point")) == "input"
+        assert guard.classify(TimeoutError("fence hung")) == "timeout"
+        assert guard.classify(faults.DeviceLostFault("gone")) == "device_lost"
+        assert guard.classify(RuntimeError("???")) == "error"
+
+    def test_jax_runtime_error_is_device_class(self):
+        import jax
+
+        assert guard.classify(
+            jax.errors.JaxRuntimeError("DEVICE_LOST")) == "device_lost"
+
+    def test_is_device_error_walks_cause_chain(self):
+        try:
+            try:
+                raise faults.DeviceLostFault("chip gone")
+            except faults.DeviceLostFault as inner:
+                raise RuntimeError("slot failed") from inner
+        except RuntimeError as outer:
+            assert guard.is_device_error(outer)
+        assert not guard.is_device_error(ValueError("bad input"))
+        assert not guard.is_device_error(RuntimeError("plain bug"))
+
+
+# ---------------------------------------------------------------------------
+# the fallback ladder
+# ---------------------------------------------------------------------------
+
+
+class TestLadder:
+    def test_success_path_is_untouched(self, monkeypatch):
+        monkeypatch.setattr(plane_agg, "_fused_finish",
+                            lambda state, hash_fn=None: ("agg", True))
+        before = chaos.fallback_total()
+        assert guard.finish_slot(("pending", "x"), INPUTS) == ("agg", True)
+        assert chaos.fallback_total() == before
+
+    def test_input_error_propagates_without_fallback(self, monkeypatch):
+        def finish(state, hash_fn=None):
+            raise ValueError("invalid G2 point at index 3")
+
+        monkeypatch.setattr(plane_agg, "_fused_finish", finish)
+        before = chaos.fallback_total()
+        with pytest.raises(ValueError, match="index 3"):
+            guard.finish_slot(("pending", "x"), INPUTS)
+        assert chaos.fallback_total() == before
+        assert guard.BREAKER.state == guard.CLOSED
+
+    def test_recovers_on_narrower_mesh(self, monkeypatch, no_backoff):
+        def finish(state, hash_fn=None):
+            if state[0] == "sharded_pending":
+                raise faults.DeviceLostFault("chip fell over")
+            return ("recovered", state)
+
+        monkeypatch.setattr(plane_agg, "_fused_finish", finish)
+        monkeypatch.setattr(mesh, "invalidate", lambda: None)
+        monkeypatch.setattr(mesh, "narrowed",
+                            lambda w: f"mesh{w}" if w == 2 else None)
+        monkeypatch.setattr(
+            sharded_plane, "sharded_dispatch",
+            lambda b, p, m, mesh_: ("retry", b, mesh_))
+        before = chaos.fallback_total(reason="device_lost", target="mesh:2")
+        out = guard.finish_slot(("sharded_pending", None, 4), INPUTS)
+        assert out == ("recovered", ("retry", ["batches"], "mesh2"))
+        assert chaos.fallback_total(reason="device_lost",
+                                    target="mesh:2") == before + 1
+
+    def test_exhausts_to_native_rung(self, monkeypatch, no_backoff):
+        def finish(state, hash_fn=None):
+            raise faults.DeviceLostFault("still broken")
+
+        monkeypatch.setattr(plane_agg, "_fused_finish", finish)
+        monkeypatch.setattr(plane_agg, "_layout_slots", lambda b: b)
+        monkeypatch.setattr(plane_agg, "_fused_dispatch",
+                            lambda layout, p, m: ("pending", layout))
+        monkeypatch.setattr(mesh, "invalidate", lambda: None)
+        monkeypatch.setattr(mesh, "narrowed", lambda w: None)
+        import charon_tpu.tbls.native_impl as native_impl
+
+        monkeypatch.setattr(native_impl, "native_slot_fallback",
+                            lambda b, p, m: (["native-agg"], True))
+        before = chaos.fallback_total(reason="device_lost", target="native")
+        out = guard.finish_slot(("sharded_pending", None, 4), INPUTS)
+        assert out == (["native-agg"], True)
+        assert chaos.fallback_total(reason="device_lost",
+                                    target="native") == before + 1
+
+    def test_native_rung_rejects_custom_hash_fn(self, monkeypatch,
+                                                no_backoff):
+        def finish(state, hash_fn=None):
+            raise faults.DeviceLostFault("gone")
+
+        monkeypatch.setattr(plane_agg, "_fused_finish", finish)
+        monkeypatch.setattr(plane_agg, "_layout_slots", lambda b: b)
+        monkeypatch.setattr(plane_agg, "_fused_dispatch",
+                            lambda layout, p, m: ("pending", layout))
+        monkeypatch.setattr(mesh, "invalidate", lambda: None)
+        monkeypatch.setattr(mesh, "narrowed", lambda w: None)
+        with pytest.raises(RuntimeError, match="custom hash_fn"):
+            guard.finish_slot(("sharded_pending", None, 2), INPUTS,
+                              hash_fn=lambda m: m)
+
+    def test_dispatch_failed_state_rides_the_ladder(self, monkeypatch,
+                                                    no_backoff):
+        monkeypatch.setattr(mesh, "invalidate", lambda: None)
+        monkeypatch.setattr(mesh, "device_count", lambda: 1)
+        import charon_tpu.tbls.native_impl as native_impl
+
+        monkeypatch.setattr(native_impl, "native_slot_fallback",
+                            lambda b, p, m: (["native-agg"], False))
+        monkeypatch.setattr(plane_agg, "_layout_slots", lambda b: b)
+
+        def dispatch(layout, p, m):
+            raise faults.DeviceLostFault("still down")
+
+        monkeypatch.setattr(plane_agg, "_fused_dispatch", dispatch)
+        state = ("dispatch_failed", faults.DeviceLostFault("pack blew up"))
+        assert guard.finish_slot(state, INPUTS) == (["native-agg"], False)
+
+
+# ---------------------------------------------------------------------------
+# the circuit breaker
+# ---------------------------------------------------------------------------
+
+
+class TestBreaker:
+    def test_trips_after_threshold_and_half_open_probe_cycle(self):
+        b = guard.CircuitBreaker(threshold=2, cooldown=0.05)
+        assert b.allow_device()
+        b.record_failure()
+        assert b.state == guard.CLOSED
+        b.record_failure()
+        assert b.state == guard.OPEN
+        assert not b.allow_device()  # cooldown not elapsed
+        time.sleep(0.06)
+        assert b.allow_device()      # half-open: the one probe
+        assert b.state == guard.HALF_OPEN
+        assert not b.allow_device()  # second probe refused
+        b.record_success()
+        assert b.state == guard.CLOSED
+        assert b.allow_device()
+
+    def test_half_open_probe_failure_reopens(self):
+        b = guard.CircuitBreaker(threshold=1, cooldown=0.01)
+        b.record_failure()
+        assert b.state == guard.OPEN
+        time.sleep(0.02)
+        assert b.allow_device()
+        b.record_failure()  # the probe failed
+        assert b.state == guard.OPEN
+
+    def test_success_resets_consecutive_count(self):
+        b = guard.CircuitBreaker(threshold=2, cooldown=1.0)
+        b.record_failure()
+        b.record_success()
+        b.record_failure()
+        assert b.state == guard.CLOSED, "non-consecutive failures don't trip"
+
+    def test_gauge_tracks_state(self):
+        b = guard.CircuitBreaker(threshold=1, cooldown=60.0)
+        assert chaos.breaker_state() == guard.CLOSED
+        b.record_failure()
+        assert chaos.breaker_state() == guard.OPEN
+
+    def test_configure_applies_knobs(self):
+        guard.configure(threshold=1, cooldown=123.0)
+        assert guard.BREAKER._threshold == 1
+        assert guard.BREAKER._cooldown == 123.0
+
+    def test_open_breaker_routes_dispatch_native(self, monkeypatch):
+        guard.configure(threshold=1, cooldown=60.0)
+        guard.BREAKER.record_failure()
+        assert plane_agg._dispatch_slot(*INPUTS) == ("native_slot",)
+        import charon_tpu.tbls.native_impl as native_impl
+
+        monkeypatch.setattr(native_impl, "native_slot_fallback",
+                            lambda b, p, m: (["native-agg"], True))
+        before = chaos.fallback_total(reason="breaker_open", target="native")
+        out = guard.finish_slot(("native_slot",), INPUTS)
+        assert out == (["native-agg"], True)
+        assert chaos.fallback_total(reason="breaker_open",
+                                    target="native") == before + 1
+
+    def test_dispatch_captures_device_error_as_state(self, monkeypatch):
+        monkeypatch.setattr(plane_agg, "_sigagg_mesh", lambda: None)
+        monkeypatch.setattr(plane_agg, "_layout_slots", lambda b: b)
+
+        def dispatch(layout, p, m):
+            raise faults.DeviceLostFault("pack blew up")
+
+        monkeypatch.setattr(plane_agg, "_fused_dispatch", dispatch)
+        state = plane_agg._dispatch_slot(*INPUTS)
+        assert state[0] == "dispatch_failed"
+        assert isinstance(state[1], faults.DeviceLostFault)
+
+    def test_dispatch_input_error_still_raises(self, monkeypatch):
+        monkeypatch.setattr(plane_agg, "_sigagg_mesh", lambda: None)
+        monkeypatch.setattr(plane_agg, "_layout_slots", lambda b: b)
+
+        def dispatch(layout, p, m):
+            raise ValueError("not a signature")
+
+        monkeypatch.setattr(plane_agg, "_fused_dispatch", dispatch)
+        with pytest.raises(ValueError, match="not a signature"):
+            plane_agg._dispatch_slot(*INPUTS)
+
+
+# ---------------------------------------------------------------------------
+# the slot watchdog
+# ---------------------------------------------------------------------------
+
+
+def _stub_stages(monkeypatch, finish):
+    monkeypatch.setattr(plane_agg, "_layout_slots", lambda b: b)
+    monkeypatch.setattr(plane_agg, "_fused_dispatch",
+                        lambda layout, p, m: ("pending", layout))
+    monkeypatch.setattr(plane_agg, "_fused_finish", finish)
+
+
+class TestWatchdog:
+    def test_hung_finish_recovers_through_async_future(self, monkeypatch,
+                                                       no_backoff):
+        release = threading.Event()
+
+        def hung(state, hash_fn=None):
+            assert release.wait(10), "test gate never released"
+            return ("late", True)
+
+        _stub_stages(monkeypatch, hung)
+        monkeypatch.setattr(mesh, "invalidate", lambda: None)
+        monkeypatch.setattr(mesh, "device_count", lambda: 1)
+        import charon_tpu.tbls.native_impl as native_impl
+
+        monkeypatch.setattr(native_impl, "native_slot_fallback",
+                            lambda b, p, m: (["wd-agg"], True))
+        before = chaos.watchdog_total()
+        pipe = plane_agg.SigAggPipeline(depth=1, finish_workers=1,
+                                        slot_deadline=0.15)
+        try:
+            fut = pipe.submit_async(*INPUTS)
+            # resolves from the watchdog's ladder run, not the hung worker
+            assert fut.result(timeout=5) == (["wd-agg"], True)
+            assert chaos.watchdog_total() == before + 1
+        finally:
+            release.set()
+            pipe.close()
+
+    def test_hung_finish_recovers_at_drain(self, monkeypatch, no_backoff):
+        release = threading.Event()
+
+        def hung(state, hash_fn=None):
+            assert release.wait(10), "test gate never released"
+            return ("late", True)
+
+        _stub_stages(monkeypatch, hung)
+        monkeypatch.setattr(mesh, "invalidate", lambda: None)
+        monkeypatch.setattr(mesh, "device_count", lambda: 1)
+        import charon_tpu.tbls.native_impl as native_impl
+
+        monkeypatch.setattr(native_impl, "native_slot_fallback",
+                            lambda b, p, m: (["wd-agg"], True))
+        pipe = plane_agg.SigAggPipeline(depth=2, finish_workers=1,
+                                        slot_deadline=0.15)
+        try:
+            assert pipe.submit(*INPUTS) == []
+            assert pipe.drain() == [(["wd-agg"], True)]
+        finally:
+            release.set()
+            pipe.close()
+
+    def test_zero_deadline_disables_watchdog(self, monkeypatch):
+        _stub_stages(monkeypatch,
+                     lambda state, hash_fn=None: ("fast", True))
+        pipe = plane_agg.SigAggPipeline(depth=1, finish_workers=1,
+                                        slot_deadline=0.0)
+        try:
+            fut = pipe.submit_async(*INPUTS)
+            assert fut.result(timeout=5) == ("fast", True)
+        finally:
+            pipe.close()
+
+
+# ---------------------------------------------------------------------------
+# plan + pipeline integration (stubbed device, real guard wiring)
+# ---------------------------------------------------------------------------
+
+
+class TestChaosIntegration:
+    def test_planned_finish_fault_rides_ladder_to_native(self, monkeypatch,
+                                                         no_backoff):
+        """An armed plan kills the slot's first finish; the guard ladder
+        lands it on the native rung and the pipeline still delivers the
+        result in order — the tier-1 shape of the chaosdryrun story."""
+
+        def finish(state, hash_fn=None):
+            faults.check("sigagg.finish")
+            return ("device", True)
+
+        _stub_stages(monkeypatch, finish)
+        monkeypatch.setattr(mesh, "invalidate", lambda: None)
+        monkeypatch.setattr(mesh, "device_count", lambda: 1)
+        import charon_tpu.tbls.native_impl as native_impl
+
+        monkeypatch.setattr(native_impl, "native_slot_fallback",
+                            lambda b, p, m: (["native-agg"], True))
+        before = chaos.fallback_total(reason="device_lost", target="native")
+        pipe = plane_agg.SigAggPipeline(depth=1, finish_workers=1)
+        try:
+            with chaos.armed(chaos.device_lost("sigagg.finish", index=0)):
+                f0 = pipe.submit_async(*INPUTS)
+                f1 = pipe.submit_async(*INPUTS)
+                assert f0.result(timeout=5) == (["native-agg"], True)
+                assert f1.result(timeout=5) == ("device", True)
+        finally:
+            pipe.close()
+        assert chaos.fallback_total(reason="device_lost",
+                                    target="native") == before + 1
+        assert guard.BREAKER.state == guard.CLOSED, \
+            "one failure then a success must not trip the default breaker"

@@ -143,6 +143,56 @@ def test_exc_rule_ignores_files_outside_scope(tmp_path):
 
 
 # ---------------------------------------------------------------------------
+# LINT-EXC-009 — device completion must route through the guard seam
+# ---------------------------------------------------------------------------
+
+
+def test_guard_seam_rule_flags_direct_completion_calls(tmp_path):
+    findings = lint_source(tmp_path, "ops/x.py", """\
+        from . import plane_agg as PA
+
+        def run(state, batches):
+            out = PA._fused_finish(state, None)
+            raw = _fused_readback(state)
+            return out, raw
+    """)
+    assert rules_of(findings) == ["LINT-EXC-009", "LINT-EXC-009"]
+    assert all("guard" in f.message for f in findings)
+
+
+def test_guard_seam_rule_scopes_to_ops_and_tbls(tmp_path):
+    flagged = lint_source(tmp_path, "tbls/x.py", """\
+        def run(state):
+            return sharded_readback(state)
+    """)
+    assert rules_of(flagged) == ["LINT-EXC-009"]
+    outside = lint_source(tmp_path, "core/x.py", """\
+        def run(state):
+            return sharded_readback(state)
+    """)
+    assert outside == []
+
+
+def test_guard_seam_rule_exempts_plane_internals_and_guard(tmp_path):
+    for rel in ("ops/plane_agg.py", "ops/sharded_plane.py", "ops/guard.py"):
+        findings = lint_source(tmp_path, rel, """\
+            def run(state):
+                return _fused_host_finish(state, None)
+        """)
+        assert findings == [], rel
+
+
+def test_guard_seam_rule_accepts_guarded_path(tmp_path):
+    findings = lint_source(tmp_path, "ops/x.py", """\
+        from . import guard
+
+        def run(state, inputs):
+            return guard.finish_slot(state, inputs)
+    """)
+    assert findings == []
+
+
+# ---------------------------------------------------------------------------
 # LINT-TPU-003 — device dtype and host-sync invariants
 # ---------------------------------------------------------------------------
 

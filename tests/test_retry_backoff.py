@@ -1,0 +1,282 @@
+"""utils/retry + utils/expbackoff under test: deadline expiry, the
+temporary-error cause-chain walk, jitter bounds — and the Retryer wiring
+on HTTPBeaconNode routes, exercised end to end against the HTTP beacon
+mock with `beacon.http` faults injected per attempt (utils/faults.py)."""
+
+import asyncio
+import time
+
+import pytest
+
+from charon_tpu.eth2.http_beacon import HTTPBeaconNode, request_retryer
+from charon_tpu.testutil import chaos
+from charon_tpu.testutil.beaconmock import BeaconMock
+from charon_tpu.testutil.beaconmock_http import HTTPBeaconMock
+from charon_tpu.utils import expbackoff, faults
+from charon_tpu.utils.errors import CharonError
+from charon_tpu.utils.retry import Retryer, TemporaryError, is_temporary
+
+
+def _run(coro, timeout=60):
+    async def wrapped():
+        return await asyncio.wait_for(coro, timeout)
+
+    return asyncio.run(wrapped())
+
+
+_FAST = expbackoff.Config(base=0.005, multiplier=2.0, jitter=0.0,
+                          max_delay=0.02)
+
+
+# ---------------------------------------------------------------------------
+# is_temporary — the cause-chain walk
+# ---------------------------------------------------------------------------
+
+
+class TestIsTemporary:
+    def test_direct_temporary_types(self):
+        assert is_temporary(TemporaryError("x"))
+        assert is_temporary(asyncio.TimeoutError())
+        assert is_temporary(TimeoutError())
+        assert is_temporary(ConnectionError())
+        assert is_temporary(ConnectionRefusedError())
+
+    def test_permanent_types(self):
+        assert not is_temporary(ValueError("bad input"))
+        assert not is_temporary(FileNotFoundError("gone"))
+        assert not is_temporary(PermissionError("no"))
+        assert not is_temporary(RuntimeError("bug"))
+
+    def test_walks_dunder_cause_chain(self):
+        # the CharonError wrap idiom: `raise errors.new(...) from exc`
+        try:
+            try:
+                raise ConnectionResetError("peer reset")
+            except ConnectionResetError as inner:
+                raise CharonError("beacon transport error") from inner
+        except CharonError as outer:
+            assert is_temporary(outer)
+
+    def test_walks_structured_cause_attribute(self):
+        # errors.new(..., err=exc) records a `cause` attribute
+        e = CharonError("wrapped")
+        e.cause = TemporaryError("blip")
+        assert is_temporary(e)
+
+    def test_permanent_cause_stays_permanent(self):
+        try:
+            try:
+                raise ValueError("bad encoding")
+            except ValueError as inner:
+                raise CharonError("decode failed") from inner
+        except CharonError as outer:
+            assert not is_temporary(outer)
+
+
+# ---------------------------------------------------------------------------
+# Retryer — deadline-bounded retry
+# ---------------------------------------------------------------------------
+
+
+class TestRetryer:
+    def test_retries_temporary_until_success(self):
+        async def run():
+            r = Retryer(lambda _d: time.time() + 5.0, _FAST)
+            calls = {"n": 0}
+
+            async def flaky():
+                calls["n"] += 1
+                if calls["n"] < 3:
+                    raise TemporaryError("blip")
+                return "ok"
+
+            assert await r.do_async(None, "flaky", flaky) == "ok"
+            assert calls["n"] == 3
+
+        _run(run())
+
+    def test_permanent_error_fails_fast(self):
+        async def run():
+            r = Retryer(lambda _d: time.time() + 5.0, _FAST)
+            calls = {"n": 0}
+
+            async def broken():
+                calls["n"] += 1
+                raise ValueError("deterministic")
+
+            with pytest.raises(ValueError):
+                await r.do_async(None, "broken", broken)
+            assert calls["n"] == 1
+
+        _run(run())
+
+    def test_deadline_expiry_raises_last_error(self):
+        async def run():
+            r = Retryer(lambda _d: time.time() + 0.05, _FAST)
+
+            async def always_temp():
+                raise TemporaryError("never recovers")
+
+            t0 = time.monotonic()
+            with pytest.raises((TemporaryError, asyncio.TimeoutError)):
+                await r.do_async(None, "doomed", always_temp)
+            # bounded: the retry loop must stop at the deadline, not spin
+            assert time.monotonic() - t0 < 2.0
+
+        _run(run())
+
+    def test_expired_deadline_refuses_to_start(self):
+        async def run():
+            r = Retryer(lambda _d: time.time() - 1.0, _FAST)
+            calls = {"n": 0}
+
+            async def fn():
+                calls["n"] += 1
+
+            with pytest.raises(asyncio.TimeoutError):
+                await r.do_async(None, "late", fn)
+            assert calls["n"] == 0
+
+        _run(run())
+
+    def test_none_deadline_single_shot_on_permanent(self):
+        async def run():
+            r = Retryer(lambda _d: None, _FAST)
+
+            async def fn():
+                return 42
+
+            assert await r.do_async(None, "free", fn) == 42
+
+        _run(run())
+
+
+# ---------------------------------------------------------------------------
+# expbackoff — growth, cap, jitter bounds
+# ---------------------------------------------------------------------------
+
+
+class TestBackoff:
+    def test_growth_and_cap_without_jitter(self):
+        b = expbackoff.Backoff(expbackoff.Config(
+            base=1.0, multiplier=2.0, jitter=0.0, max_delay=5.0))
+        assert [b.next_delay() for _ in range(5)] == [1.0, 2.0, 4.0, 5.0, 5.0]
+        b.reset()
+        assert b.next_delay() == 1.0
+
+    def test_jitter_stays_inside_band(self):
+        cfg = expbackoff.Config(base=1.0, multiplier=1.0, jitter=0.25,
+                                max_delay=60.0)
+        b = expbackoff.Backoff(cfg)
+        for _ in range(200):
+            d = b.next_delay()
+            assert 0.75 <= d <= 1.25, d
+
+    def test_jittered_delay_never_negative_at_full_jitter(self):
+        b = expbackoff.Backoff(expbackoff.Config(
+            base=0.1, multiplier=1.0, jitter=1.0, max_delay=1.0))
+        assert all(b.next_delay() >= 0.0 for _ in range(200))
+
+
+# ---------------------------------------------------------------------------
+# Retryer-wired beacon routes under injected beacon.http faults
+# ---------------------------------------------------------------------------
+
+
+def _mock(n_validators=2):
+    pubkeys = [bytes([i + 1]) * 48 for i in range(n_validators)]
+    return BeaconMock(pubkeys, genesis_time=time.time() + 1.0,
+                      seconds_per_slot=0.4, slots_per_epoch=8)
+
+
+class TestBeaconRetryWiring:
+    def test_injected_connection_faults_are_retried_transparently(self):
+        """A plan killing the first two beacon.http attempts with connection
+        errors: the Retryer-wired client absorbs them and the route still
+        returns the right payload; the disarmed-identical third attempt is
+        the one that lands."""
+
+        async def run():
+            server = HTTPBeaconMock(_mock())
+            await server.start()
+            client = HTTPBeaconNode(
+                server.base_url,
+                retryer=Retryer(lambda _d: time.time() + 10.0, _FAST))
+            try:
+                injected_before = chaos.injected_total("beacon.http")
+                with chaos.armed(chaos.connection("beacon.http", index=0,
+                                                  count=2)):
+                    assert not await client.node_syncing()
+                    assert faults.invocations("beacon.http") == 3
+                assert chaos.injected_total("beacon.http") \
+                    == injected_before + 2
+            finally:
+                await client.close()
+                await server.stop()
+
+        _run(run())
+
+    def test_unretryered_client_surfaces_the_fault(self):
+        """Without a Retryer the legacy single-attempt shape is unchanged:
+        the injected transport fault surfaces as the wrapped CharonError."""
+
+        async def run():
+            server = HTTPBeaconMock(_mock())
+            await server.start()
+            client = HTTPBeaconNode(server.base_url)
+            try:
+                with chaos.armed(chaos.connection("beacon.http")):
+                    with pytest.raises(CharonError):
+                        await client.node_syncing()
+                    assert faults.invocations("beacon.http") == 1
+            finally:
+                await client.close()
+                await server.stop()
+
+        _run(run())
+
+    def test_retry_window_bounds_a_dead_route(self):
+        """Every attempt faulted: the request_retryer window must cut the
+        loop off instead of retrying forever (the duty-deadline Retryer
+        shape would never expire on duty=None routes)."""
+
+        async def run():
+            server = HTTPBeaconMock(_mock())
+            await server.start()
+            client = HTTPBeaconNode(
+                server.base_url,
+                retryer=request_retryer(window=0.2, backoff=_FAST))
+            try:
+                with chaos.armed(chaos.connection("beacon.http", index=0,
+                                                  count=10_000)):
+                    t0 = time.monotonic()
+                    with pytest.raises(
+                            (CharonError, asyncio.TimeoutError)):
+                        await client.node_syncing()
+                    assert time.monotonic() - t0 < 5.0
+            finally:
+                await client.close()
+                await server.stop()
+
+        _run(run())
+
+    def test_http_status_errors_are_not_retried(self):
+        """Deterministic HTTP-status failures (404 route) must fail fast
+        even with a Retryer wired — only TEMPORARY errors retry."""
+
+        async def run():
+            server = HTTPBeaconMock(_mock())
+            await server.start()
+            client = HTTPBeaconNode(
+                server.base_url,
+                retryer=Retryer(lambda _d: time.time() + 10.0, _FAST))
+            try:
+                t0 = time.monotonic()
+                with pytest.raises(CharonError):
+                    await client._req("GET", "/eth/v1/not/a/route")
+                assert time.monotonic() - t0 < 2.0
+            finally:
+                await client.close()
+                await server.stop()
+
+        _run(run())

@@ -278,6 +278,46 @@ class TestBadRequestMapping:
 
         _run(run())
 
+    def test_duties_body_shape_is_enforced(self):
+        """POST duties routes: the body must be a JSON ARRAY of indices /
+        0x pubkeys. A dict used to iterate its keys, a string its
+        CHARACTERS, and `null`/`0`/`false` 500'd on iteration — every
+        non-list shape is a 400 now (_duty_body_share_pubkeys), on both
+        the attester and sync routes; `[]` stays valid (no filter)."""
+
+        async def run():
+            import aiohttp
+
+            sim = new_simnet(num_validators=1, threshold=2, num_nodes=3,
+                             use_vmock=False, genesis_delay=30.0)
+            router = VapiRouter(sim.nodes[0].vapi)
+            await router.start()
+            client = HTTPValidatorClient(router.base_url)
+            paths = ("/eth/v1/validator/duties/attester/0",
+                     "/eth/v1/validator/duties/sync/0")
+            try:
+                for path in paths:
+                    for bad in ({}, {"ids": ["1"]}, 0, False, "0xabcd"):
+                        with pytest.raises(VapiHTTPError) as exc_info:
+                            await client.raw("POST", path, json_body=bad)
+                        assert exc_info.value.status == 400, \
+                            f"{path} {bad!r}"
+                    # a literal JSON null body must 400 too, not iterate
+                    async with aiohttp.ClientSession() as sess:
+                        async with sess.post(
+                                router.base_url + path, data=b"null",
+                                headers={"Content-Type": "application/json"},
+                        ) as resp:
+                            assert resp.status == 400, path
+                    # the empty array is the spec'd "no filter" and stays OK
+                    out = await client.raw("POST", path, json_body=[])
+                    assert out["data"] == []
+            finally:
+                await client.close()
+                await router.stop()
+
+        _run(run())
+
     def test_validators_filter_body_shape_is_enforced(self):
         """POST /states/{id}/validators: a JSON `null` body (or no body at
         all) means "no filter" and returns the whole cluster; any other
