@@ -1,0 +1,136 @@
+"""bench_vapi — mainnet-traffic serving benchmark for the ValidatorAPI
+front door (docs/serving.md).
+
+Drives a fleet of simulated validator clients (each with its own keep-alive
+HTTP connection) against a VapiRouter backed by an HTTPBeaconMock, on the
+chain's own slot clock, with the SURVEY-accurate duty mix from
+charon_tpu/testutil/loadgen.DutyMix: every validator attests once per
+epoch, a fixed fraction signs sync messages every slot, epoch-start slots
+fire the selection storm (and the epoch-boundary duty-refresh burst), and
+every slot a synthetic inbound parsigex partial-signature storm
+batch-verifies on the device plane.
+
+Output idiom matches bench.py: `#`-prefixed diagnostics on stderr, ONE
+JSON line on stdout — per-route p50/p99/count, per-route error rates,
+achieved client request rate, VC-side outcome tallies, and the beacon
+mock's keep-alive accounting (connections_used vs requests_served).
+
+Default shape is the mainnet-ish run from ISSUE 7's acceptance bar:
+1024 VCs / 1024 validators on 12 s slots. `--smoke` shrinks everything to
+seconds for CI (tests/test_serving.py runs it, marked slow).
+
+Run under JAX_PLATFORMS=cpu or on real TPU hardware — the parsigex storm
+exercises whichever device plane is configured.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import sys
+import time
+
+
+def _parse_args(argv: list[str] | None) -> argparse.Namespace:
+    p = argparse.ArgumentParser(description=__doc__.split("\n\n")[0])
+    p.add_argument("--smoke", action="store_true",
+                   help="seconds-long CI shape (few VCs, sub-second slots)")
+    p.add_argument("--vcs", type=int, default=None,
+                   help="concurrent validator clients (default 1024; smoke 4)")
+    p.add_argument("--validators", type=int, default=None,
+                   help="cluster validators (default 1024; smoke 8)")
+    p.add_argument("--slots", type=int, default=None,
+                   help="slots to run (default 3)")
+    p.add_argument("--slot-seconds", type=float, default=None,
+                   help="slot duration (default 12.0; smoke 0.4)")
+    p.add_argument("--slots-per-epoch", type=int, default=8)
+    p.add_argument("--storm", type=int, default=None,
+                   help="parsigex storm validators per slot "
+                        "(default 64; smoke 4)")
+    p.add_argument("--sync-fraction", type=float, default=0.25)
+    p.add_argument("--seed", default="charon")
+    p.add_argument("--no-selection-storm", action="store_true")
+    p.add_argument("--coalesce-budget", type=float, default=12.0,
+                   help="sigagg deadline budget (s) behind the 503 shed")
+    return p.parse_args(argv)
+
+
+def _config(args: argparse.Namespace):
+    from charon_tpu.testutil.loadgen import TrafficConfig
+
+    if args.smoke:
+        # 1.0s slots: duty deadlines are slot_start + 5 slots
+        # (core/deadline.LATE_FACTOR); sub-second slots expire duties
+        # before threshold selections can round-trip the cluster.
+        defaults = dict(num_vcs=4, num_validators=8, slots=3,
+                        seconds_per_slot=1.0, storm=4, genesis_delay=0.6,
+                        vc_timeout=8.0)
+    else:
+        defaults = dict(num_vcs=1024, num_validators=1024, slots=3,
+                        seconds_per_slot=12.0, storm=64, genesis_delay=3.0,
+                        vc_timeout=30.0)
+    return TrafficConfig(
+        num_validators=args.validators or defaults["num_validators"],
+        num_vcs=args.vcs or defaults["num_vcs"],
+        seconds_per_slot=args.slot_seconds or defaults["seconds_per_slot"],
+        slots_per_epoch=args.slots_per_epoch,
+        slots=args.slots or defaults["slots"],
+        seed=args.seed,
+        sync_fraction=args.sync_fraction,
+        selection_storm=not args.no_selection_storm,
+        storm_validators=(args.storm if args.storm is not None
+                          else defaults["storm"]),
+        genesis_delay=defaults["genesis_delay"],
+        vc_timeout=defaults["vc_timeout"],
+        coalesce_budget_s=args.coalesce_budget,
+    )
+
+
+async def _run(cfg) -> dict:
+    from charon_tpu.testutil.loadgen import ServingHarness
+
+    harness = ServingHarness(cfg)
+    print(f"# bench_vapi: {cfg.num_vcs} VCs x {cfg.num_validators} "
+          f"validators, {cfg.slots} slots @ {cfg.seconds_per_slot}s, "
+          f"storm={cfg.storm_validators}", file=sys.stderr)
+    t0 = time.time()
+    await harness.start()
+    print(f"# harness up in {time.time() - t0:.1f}s "
+          f"(router {harness.router.base_url}, "
+          f"bn {harness.http_mock.base_url}, "
+          f"{len(harness.vcs)} VCs)", file=sys.stderr)
+    try:
+        report = await harness.run()
+    finally:
+        await harness.stop()
+    tail = report.to_json()
+    tail["metric"] = "vapi serving harness"
+    tail["config"] = {
+        "num_vcs": cfg.num_vcs, "num_validators": cfg.num_validators,
+        "slots": cfg.slots, "seconds_per_slot": cfg.seconds_per_slot,
+        "slots_per_epoch": cfg.slots_per_epoch,
+        "storm_validators": cfg.storm_validators, "seed": cfg.seed,
+    }
+    shed = report.client_tallies.get("shed_503", 0)
+    print(f"# {report.client_requests} client requests in "
+          f"{report.elapsed_s:.1f}s ({report.achieved_rps:.1f} req/s), "
+          f"{shed} shed with 503, "
+          f"bn keep-alive {report.bn_requests_served} reqs over "
+          f"{report.bn_connections_used} conns", file=sys.stderr)
+    for route, d in sorted(tail["routes"].items()):
+        print(f"#   {route}: n={d.get('count', 0):.0f} "
+              f"p50={d.get('p50', 0):.4f}s p99={d.get('p99', 0):.4f}s "
+              f"err={d.get('error_rate', 0):.3f}", file=sys.stderr)
+    return tail
+
+
+def main(argv: list[str] | None = None) -> None:
+    args = _parse_args(argv)
+    cfg = _config(args)
+    tail = asyncio.run(_run(cfg))
+    print(json.dumps(tail))
+
+
+if __name__ == "__main__":
+    main()

@@ -99,6 +99,12 @@ class Config:
     # per-request retry window (seconds) for beacon HTTP routes; 0 turns
     # the Retryer wiring off (single attempt, legacy behavior)
     beacon_retry_s: float = 10.0
+    # serving front door (docs/serving.md): seconds of estimated sigagg
+    # dispatch backlog before the coalescer sheds new submissions (the
+    # router answers 503 + Retry-After); None disables admission control
+    coalesce_budget_s: float | None = 12.0
+    # largest request body the validator-API router will read (413 above)
+    vapi_max_body_bytes: int = 2 * 1024 * 1024
     test: TestConfig = field(default_factory=TestConfig)
 
 
@@ -356,7 +362,8 @@ async def assemble(config: Config) -> App:
     # dispatch so sub-threshold batches still reach the TPU (SURVEY §2.4;
     # core/coalesce.py). Benefits the native RLC batch verifier too, so it
     # is on regardless of the tpu_bls feature.
-    coalescer = coalesce_mod.TblsCoalescer()
+    coalescer = coalesce_mod.TblsCoalescer(
+        deadline_budget_s=config.coalesce_budget_s)
     # duty-deadline retryer (reference app/retry): shared by the core-wire
     # async steps AND parsigex broadcast, so a peer blip re-sends partials
     # under backoff until the duty expires
@@ -437,7 +444,9 @@ async def assemble(config: Config) -> App:
     sched.subscribe_slots(recaster.on_slot)
 
     vapi_router = VapiRouter(vapi, bn_base_url=config.beacon_urls[0] if config.beacon_urls else None,
-                             host=config.vapi_host, port=config.vapi_port)
+                             host=config.vapi_host, port=config.vapi_port,
+                             coalescer=coalescer,
+                             max_body_bytes=config.vapi_max_body_bytes)
     quorum = keys.threshold
     monitoring = MonitoringAPI(config.monitoring_host, config.monitoring_port,
                                ping_service=ping, beacon=beacon, quorum=quorum,

@@ -148,6 +148,19 @@ def default_checks(quorum_peers: int,
               "hung past CHARON_TPU_SLOT_DEADLINE_S and the slot was "
               "recovered down the ladder; see docs/robustness.md)",
               lambda w: w.counter_delta("ops_sigagg_watchdog_total") > 0),
+        Check("vapi_latency_high",
+              f"validator-API route p99 above {sigagg_budget:.1f}s (a third "
+              "of slot time) — the serving front door is eating the duty "
+              "budget before any crypto happens (docs/serving.md)",
+              lambda w: w.histogram_quantile(
+                  "vapi_route_latency_seconds") > sigagg_budget),
+        Check("vapi_error_rate_high",
+              "more than 5% of validator-API requests answered 5xx in the "
+              "window (at least 20 requests) — VCs are being shed (503 "
+              "backpressure) or hitting handler failures (docs/serving.md)",
+              lambda w: (w.counter_delta("vapi_requests_total") >= 20
+                         and w.counter_delta("vapi_request_errors_total")
+                         > 0.05 * w.counter_delta("vapi_requests_total"))),
         Check("high_error_log_rate", "more than 5 error logs in the window",
               lambda w: w.counter_delta("log_messages_total", "error") > 5),
         Check("high_warning_log_rate", "more than 10 warning logs in the window",

@@ -27,9 +27,10 @@ a device with batch economics.
 from __future__ import annotations
 
 import asyncio
+import time
 
 from .. import tbls
-from ..utils import aio, log, metrics
+from ..utils import aio, faults, log, metrics
 
 _log = log.with_topic("coalesce")
 
@@ -39,6 +40,28 @@ _flush_hist = metrics.histogram(
 _wait_hist = metrics.histogram(
     "core_coalesce_wait_seconds", "Submission wait inside the window",
     ("kind",))
+_overload_c = metrics.counter(
+    "core_coalesce_overload_total",
+    "Submissions shed by the backpressure admission check", ("kind",))
+_backlog_g = metrics.gauge(
+    "core_coalesce_backlog_seconds",
+    "Estimated seconds to drain in-flight + queued fused dispatches")
+
+
+class OverloadedError(RuntimeError):
+    """The batching window cannot absorb new work inside its deadline
+    budget — either the estimated drain time of in-flight + queued fused
+    dispatches exceeds the budget, or the device plane is failing
+    dispatches wholesale (consecutive device-class flush failures) and
+    admitting more work would only grow an undeliverable backlog.
+
+    Deliberately NOT a CharonError: the router's error middleware maps
+    CharonError to 400 (client error); overload is a 503 with a
+    Retry-After hint carried in `retry_after` (seconds)."""
+
+    def __init__(self, msg: str, retry_after: float = 1.0):
+        super().__init__(msg)
+        self.retry_after = max(0.0, retry_after)
 
 
 class _Window:
@@ -193,7 +216,10 @@ class TblsCoalescer:
     """Batches aggregate+verify and bulk-verify submissions across
     concurrent duties into single fused tbls dispatches (module doc)."""
 
-    def __init__(self, window: float = 0.025, flush_at: int | None = None):
+    def __init__(self, window: float = 0.025, flush_at: int | None = None,
+                 deadline_budget_s: float | None = 12.0,
+                 overload_streak: int = 2,
+                 overload_cooldown_s: float = 5.0):
         # An EXPLICIT flush_at always wins, for both windows. The default
         # is one plane tile PER MESH DEVICE: coalescing amortizes the
         # device dispatch floor until the batch stops fitting the mesh's
@@ -217,6 +243,19 @@ class TblsCoalescer:
         self._ver = _Window("verify", window, flush_at, self._dispatch_ver)
         self.flushes = 0
         self.coalesced_flushes = 0
+        # Backpressure admission state (check_admission): estimated drain
+        # time of the dispatch backlog vs `deadline_budget_s` (None turns
+        # admission off entirely), plus a device-failure fail-fast — after
+        # `overload_streak` CONSECUTIVE device-class flush failures new
+        # work is shed for `overload_cooldown_s` (half-open style: the
+        # first successful dispatch after the cooldown clears the state).
+        self.deadline_budget_s = deadline_budget_s
+        self.overload_streak = max(1, overload_streak)
+        self.overload_cooldown_s = overload_cooldown_s
+        self._inflight = 0            # fused dispatches currently running
+        self._ewma_s = 0.0            # smoothed wall time per fused dispatch
+        self._device_fail_streak = 0  # consecutive device-class failures
+        self._overloaded_until = 0.0  # monotonic instant fail-fast expires
 
     # ---- public API ------------------------------------------------------
 
@@ -224,7 +263,9 @@ class TblsCoalescer:
         """Queue one duty's (batches, pks, signing roots); resolves to
         (agg_sigs, ok) for exactly this submission once a window flushes.
         ok=False means at least one of THIS submission's aggregates failed
-        (per-request re-verify attributes fused-batch failures)."""
+        (per-request re-verify attributes fused-batch failures). Sheds
+        with OverloadedError when admission fails (check_admission)."""
+        self.check_admission("agg")
         return await self._agg.submit(
             len(batches), (list(batches), list(pks), list(roots)))
 
@@ -233,10 +274,47 @@ class TblsCoalescer:
         """Queue one bulk verify (the parsigex inbound path); resolves to
         the validity of exactly this submission's set. key/expected/
         contributor declare the duty's contributor group for adaptive
-        close-on-quorum (_Window.submit)."""
+        close-on-quorum (_Window.submit). Sheds with OverloadedError when
+        admission fails (check_admission)."""
+        self.check_admission("verify")
         return await self._ver.submit(
             len(sigs), (list(pks), list(roots), list(sigs)),
             key=key, expected=expected, contributor=contributor)
+
+    # ---- backpressure admission ------------------------------------------
+
+    def backlog_seconds(self) -> float:
+        """Estimated seconds to drain the current dispatch backlog: fused
+        dispatches in flight plus windows with queued submissions, each
+        costed at the smoothed dispatch wall time. 0.0 until the first
+        dispatch completes (no estimate beats a wrong fail-closed)."""
+        queued = (1 if self._agg._q else 0) + (1 if self._ver._q else 0)
+        est = (self._inflight + queued) * self._ewma_s
+        _backlog_g.set(est)
+        return est
+
+    def check_admission(self, kind: str = "submit") -> None:
+        """Raise OverloadedError when new work cannot plausibly complete
+        inside the deadline budget. The router calls this on every POST
+        body read (503 + Retry-After before any decode work); the submit
+        paths above call it so in-process callers — parsigex inbound sets,
+        sigagg — shed the same way instead of growing the backlog."""
+        if self.deadline_budget_s is None:
+            return
+        now = time.monotonic()
+        if now < self._overloaded_until:
+            _overload_c.inc(kind)
+            raise OverloadedError(
+                f"device plane shedding load: {self._device_fail_streak} "
+                "consecutive device-class dispatch failures",
+                retry_after=self._overloaded_until - now)
+        est = self.backlog_seconds()
+        if est > self.deadline_budget_s:
+            _overload_c.inc(kind)
+            raise OverloadedError(
+                f"dispatch backlog {est:.2f}s exceeds the "
+                f"{self.deadline_budget_s:.1f}s deadline budget",
+                retry_after=min(est, 30.0))
 
     # ---- fused dispatches ------------------------------------------------
 
@@ -245,7 +323,44 @@ class TblsCoalescer:
         if n_reqs > 1:
             self.coalesced_flushes += 1
 
+    async def _tracked(self, inner, payloads, futs) -> None:
+        """Account one fused dispatch for admission: in-flight count, EWMA
+        wall time, and the device-class failure streak that arms the
+        fail-fast. The sigagg.pack chaos seam fires here too — the
+        coalescer's fused dispatch IS the entry into sigagg stage 1, and
+        on CPU-only hosts (native tbls backend) it is the only pack-stage
+        boundary an armed plan can reach."""
+        from ..ops import guard
+
+        self._inflight += 1
+        t0 = time.monotonic()
+        try:
+            faults.check("sigagg.pack")
+            await inner(payloads, futs)
+        except Exception as exc:
+            if guard.is_device_error(exc):
+                self._device_fail_streak += 1
+                if self._device_fail_streak >= self.overload_streak:
+                    self._overloaded_until = (
+                        time.monotonic() + self.overload_cooldown_s)
+            raise
+        else:
+            dt = time.monotonic() - t0
+            self._ewma_s = (dt if self._ewma_s == 0.0
+                            else 0.8 * self._ewma_s + 0.2 * dt)
+            self._device_fail_streak = 0
+            self._overloaded_until = 0.0
+        finally:
+            self._inflight -= 1
+            _backlog_g.set(self._inflight * self._ewma_s)
+
     async def _dispatch_agg(self, payloads, futs) -> None:
+        await self._tracked(self._dispatch_agg_inner, payloads, futs)
+
+    async def _dispatch_ver(self, payloads, futs) -> None:
+        await self._tracked(self._dispatch_ver_inner, payloads, futs)
+
+    async def _dispatch_agg_inner(self, payloads, futs) -> None:
         loop = asyncio.get_running_loop()
         self._note_flush(len(payloads))
         batches = [b for p in payloads for b in p[0]]
@@ -280,7 +395,7 @@ class TblsCoalescer:
                 None, tbls.verify_batch, p[1], p[2], s)
             _resolve(f, (s, bool(r_ok)))
 
-    async def _dispatch_ver(self, payloads, futs) -> None:
+    async def _dispatch_ver_inner(self, payloads, futs) -> None:
         loop = asyncio.get_running_loop()
         self._note_flush(len(payloads))
         pks = [k for p in payloads for k in p[0]]

@@ -25,20 +25,52 @@ class KeyShares:
     share_pubkeys: dict[PubKey, dict[int, tbls.PublicKey]] = field(default_factory=dict)
     # This node's share secrets (held by its VC; present in vmock/test setups).
     my_share_secrets: dict[PubKey, tbls.PrivateKey] = field(default_factory=dict)
-    # lazy reverse index: my share pubkey bytes -> DV root (built once;
-    # share maps are static for a run — rebuilt views carry fresh indexes)
-    _root_by_share: dict[bytes, PubKey] | None = field(
-        default=None, repr=False, compare=False)
+    # Lookup caches, built ONCE at load (__post_init__): share maps are
+    # static for a run, and at mainnet scale (100k registered validators)
+    # any per-call list() or linear scan on the duty/submit hot path turns
+    # the serving pipeline quadratic in cluster size. bench_vapi +
+    # tests/test_loadgen.py::test_keyshares_lookup_scales pin this down.
+    _roots: tuple[PubKey, ...] = field(
+        default=(), init=False, repr=False, compare=False)
+    _num_shares: int = field(default=0, init=False, repr=False, compare=False)
+    _root_by_share: dict[bytes, PubKey] = field(
+        default_factory=dict, init=False, repr=False, compare=False)
+    _my_shares: tuple[bytes, ...] = field(
+        default=(), init=False, repr=False, compare=False)
+
+    def __post_init__(self) -> None:
+        self.reindex()
+
+    def reindex(self) -> None:
+        """(Re)build the O(1) lookup caches. No production flow mutates
+        `share_pubkeys` after load, but a test fixture that hand-edits the
+        maps in place must call this to keep the caches coherent."""
+        self._roots = tuple(self.share_pubkeys)
+        self._num_shares = (len(next(iter(self.share_pubkeys.values())))
+                            if self.share_pubkeys else 0)
+        root_by_share: dict[bytes, PubKey] = {}
+        my_shares: list[bytes] = []
+        for root, shares in self.share_pubkeys.items():
+            mine = shares.get(self.my_share_idx)
+            if mine is not None:
+                b = bytes(mine)
+                root_by_share[b] = root
+                my_shares.append(b)
+        self._root_by_share = root_by_share
+        self._my_shares = tuple(my_shares)
 
     @property
-    def root_pubkeys(self) -> list[PubKey]:
-        return list(self.share_pubkeys)
+    def root_pubkeys(self) -> tuple[PubKey, ...]:
+        return self._roots
+
+    @property
+    def my_share_pubkeys(self) -> tuple[bytes, ...]:
+        """This node's share pubkeys as bytes, ordered like root_pubkeys."""
+        return self._my_shares
 
     @property
     def num_shares(self) -> int:
-        if not self.share_pubkeys:
-            return 0
-        return len(next(iter(self.share_pubkeys.values())))
+        return self._num_shares
 
     def my_share_pubkey(self, root: PubKey) -> tbls.PublicKey:
         return self.share_pubkey(root, self.my_share_idx)
@@ -51,15 +83,11 @@ class KeyShares:
 
     def root_by_share_pubkey(self, share_pk: bytes) -> PubKey:
         """Map a VC's share pubkey back to the DV root
-        (reference validatorapi.go:978-1005 pubkey mapping). O(1) via a
-        reverse index built on first use — the linear scan this replaces
-        was O(validators) per lookup and collapsed the duty pipeline at
+        (reference validatorapi.go:978-1005 pubkey mapping). O(1) via the
+        precomputed reverse index — the linear scan this replaces was
+        O(validators) per lookup and collapsed the duty pipeline at
         2000 DVs (every duties call is O(N) lookups, so the pipeline was
         quadratic in cluster size)."""
-        if self._root_by_share is None:
-            self._root_by_share = {
-                bytes(shares[self.my_share_idx]): root
-                for root, shares in self.share_pubkeys.items()}
         root = self._root_by_share.get(bytes(share_pk))
         if root is None:
             raise errors.new("unknown share pubkey",

@@ -75,6 +75,7 @@ class Component:  # lint: implements=ValidatorAPI
         self._fee_recipient = fee_recipient or (lambda _pk: "0x" + "00" * 20)
         self._builder_enabled = builder_enabled or (lambda _slot: False)
         self._index_cache: dict[int, PubKey] = {}
+        self._all_shares_by_index: dict[int, bytes] | None = None
         self._subs = []
 
     def subscribe(self, fn) -> None:
@@ -204,10 +205,15 @@ class Component:  # lint: implements=ValidatorAPI
 
     async def share_pubkeys_by_index(self, indices: list[int]) -> list[bytes]:
         """Resolve validator indices to this node's share pubkeys (used by the
-        HTTP router when a spec-standard VC posts index bodies)."""
-        all_shares = [bytes(self._keys.my_share_pubkey(r))
-                      for r in self._keys.root_pubkeys]
-        idx_to_share = await self._share_index_map(all_shares)
+        HTTP router when a spec-standard VC posts index bodies). The full
+        index→share map is cached after the first call — the validator set
+        is static per run (same justification as _index_cache), and every
+        spec-standard duties POST hits this path, so rebuilding the map
+        meant one whole-cluster BN round-trip per request."""
+        if self._all_shares_by_index is None:
+            self._all_shares_by_index = await self._share_index_map(
+                list(self._keys.my_share_pubkeys))
+        idx_to_share = self._all_shares_by_index
         return [idx_to_share[i] for i in indices if i in idx_to_share]
 
     async def attester_duties(self, epoch: int,

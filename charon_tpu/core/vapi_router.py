@@ -35,18 +35,41 @@ Intercepted surface (matching the reference's router.go endpoints table):
 from __future__ import annotations
 
 import asyncio
+import json
+import math
+import time
 
-from aiohttp import ClientSession, ClientTimeout, web
+from aiohttp import ClientSession, ClientTimeout, TCPConnector, web
 
 from ..eth2 import json_codec as jc
 from ..eth2 import spec
 from ..utils import errors, log, metrics, tracer, version
+from . import signeddata
+from .coalesce import OverloadedError, TblsCoalescer
 from .validatorapi import Component
 
 _log = log.with_topic("vapi")
 
 _req_hist = metrics.histogram("core_validatorapi_request_latency_seconds",
                               "VAPI request latency", ("endpoint",))
+
+# Serving front-door metrics (docs/serving.md): per-route latency keyed by
+# the MATCHED route pattern (slot/epoch params must not explode series
+# cardinality), requests in flight, and request/5xx counters feeding the
+# vapi_latency_high / vapi_error_rate_high health rules (app/health.py).
+_route_hist = metrics.histogram(
+    "vapi_route_latency_seconds",
+    "ValidatorAPI request latency by matched route pattern",
+    ("route", "method"))
+_inflight_g = metrics.gauge(
+    "vapi_inflight_requests", "ValidatorAPI requests currently in flight")
+_requests_c = metrics.counter(
+    "vapi_requests_total", "ValidatorAPI requests by route/method/status",
+    ("route", "method", "code"))
+_request_errors_c = metrics.counter(
+    "vapi_request_errors_total",
+    "ValidatorAPI requests answered 5xx (incl. 503 load shed)",
+    ("route", "method"))
 
 
 def _data(payload) -> web.Response:
@@ -124,14 +147,21 @@ class VapiRouter:
     """aiohttp server wrapping a validatorapi Component with BN passthrough."""
 
     def __init__(self, component: Component, bn_base_url: str | None = None,
-                 host: str = "127.0.0.1", port: int = 0):
+                 host: str = "127.0.0.1", port: int = 0,
+                 coalescer: TblsCoalescer | None = None,
+                 max_body_bytes: int = 2 * 1024 * 1024):
         self._comp = component
         self._bn_url = (bn_base_url or "").rstrip("/") or None
         self.host = host
         self.port = port
+        self._coalescer = coalescer
         self._runner: web.AppRunner | None = None
         self._proxy_session: ClientSession | None = None
-        app = web.Application()
+        # client_max_size bounds every body read at the aiohttp layer: an
+        # over-limit POST raises HTTPRequestEntityTooLarge inside
+        # request.read() and the error middleware maps it to a 413 in the
+        # beacon-API JSON error shape.
+        app = web.Application(client_max_size=max_body_bytes)
         app.router.add_get("/eth/v1/node/version", self._node_version)
         app.router.add_post("/eth/v1/validator/duties/attester/{epoch}", self._attester_duties)
         app.router.add_get("/eth/v1/validator/duties/proposer/{epoch}", self._proposer_duties)
@@ -164,6 +194,10 @@ class VapiRouter:
         app.router.add_get("/proposer_config", self._proposer_config)
         app.router.add_get("/teku_proposer_config", self._proposer_config)
         app.router.add_route("*", "/{tail:.*}", self._proxy)
+        # Middleware order matters: metrics is OUTERMOST so the per-route
+        # latency/inflight/error series include the error middleware's
+        # status mapping (a shed 503 must count toward vapi_request_errors).
+        app.middlewares.append(_metrics_middleware)
         app.middlewares.append(_error_middleware)
         app.middlewares.append(_tracing_middleware)
         self._app = app
@@ -171,7 +205,10 @@ class VapiRouter:
     # -- lifecycle -----------------------------------------------------------
 
     async def start(self) -> None:
-        self._runner = web.AppRunner(self._app, access_log=None)
+        # Short shutdown grace: handlers blocked awaiting threshold duties
+        # (selections) must not pin stop() for aiohttp's default 60s.
+        self._runner = web.AppRunner(self._app, access_log=None,
+                                     shutdown_timeout=2.0)
         await self._runner.setup()
         site = web.TCPSite(self._runner, self.host, self.port)
         await site.start()
@@ -190,6 +227,44 @@ class VapiRouter:
         return f"http://{self.host}:{self.port}"
 
     # -- intercepted handlers -------------------------------------------------
+
+    async def _strict_body(self, request: web.Request, shape: str = "list"):
+        """The ONE body-ingestion path for every intercepted POST route
+        (enforced by LINT-VAPI-010). Three jobs, in order:
+
+        1. Backpressure admission: if the sigagg dispatch backlog behind the
+           wired coalescer exceeds its deadline budget, shed the request NOW
+           — before reading or parsing the body — so an overloaded node
+           spends no parse CPU on work it will drop (OverloadedError maps to
+           503 + Retry-After in the error middleware).
+        2. Bounded read: request.read() is capped by client_max_size;
+           over-limit bodies raise HTTPRequestEntityTooLarge → 413.
+        3. Shape validation: "list" / "object" / "object_or_null". A str,
+           number, or bool where a container belongs is a 400 here, never a
+           handler iterating a string character-by-character into a 500.
+        """
+        if self._coalescer is not None:
+            self._coalescer.check_admission("vapi")
+        raw = await request.read()
+        body = json.loads(raw) if raw else None
+        if shape == "list":
+            if not isinstance(body, list):
+                raise ValueError(
+                    "request body must be a JSON array, got "
+                    f"{type(body).__name__}")
+        elif shape == "object":
+            if not isinstance(body, dict):
+                raise ValueError(
+                    "request body must be a JSON object, got "
+                    f"{type(body).__name__}")
+        elif shape == "object_or_null":
+            if body is not None and not isinstance(body, dict):
+                raise ValueError(
+                    "request body must be a JSON object or empty, got "
+                    f"{type(body).__name__}")
+        else:  # pragma: no cover — caller bug
+            raise RuntimeError(f"unknown body shape {shape!r}")
+        return body
 
     async def _node_version(self, request: web.Request) -> web.Response:
         return _data({"version": f"charon-tpu/{version.VERSION}"})
@@ -222,7 +297,8 @@ class VapiRouter:
     async def _attester_duties(self, request: web.Request) -> web.Response:
         with _req_hist.observe_time("attester_duties"):
             epoch = int(request.match_info["epoch"])
-            share_pubkeys = await self._duty_body_share_pubkeys(await request.json())
+            body = await self._strict_body(request, "list")
+            share_pubkeys = await self._duty_body_share_pubkeys(body)
             duties = await self._comp.attester_duties(epoch, share_pubkeys)
             return _data([jc.encode_attester_duty(d) for d in duties])
 
@@ -238,7 +314,8 @@ class VapiRouter:
     async def _sync_duties(self, request: web.Request) -> web.Response:
         with _req_hist.observe_time("sync_duties"):
             epoch = int(request.match_info["epoch"])
-            share_pubkeys = await self._duty_body_share_pubkeys(await request.json())
+            body = await self._strict_body(request, "list")
+            share_pubkeys = await self._duty_body_share_pubkeys(body)
             duties = await self._comp.sync_committee_duties(epoch, share_pubkeys)
             return _data([jc.encode_sync_duty(d) for d in duties])
 
@@ -251,7 +328,7 @@ class VapiRouter:
 
     async def _submit_attestations(self, request: web.Request) -> web.Response:
         with _req_hist.observe_time("submit_attestations"):
-            body = await request.json()
+            body = await self._strict_body(request, "list")
             atts = _decode(lambda: [
                 jc.decode_container(spec.Attestation, o) for o in body])
             await self._comp.submit_attestations(atts)
@@ -284,7 +361,7 @@ class VapiRouter:
 
     async def _submit_blinded_block(self, request: web.Request) -> web.Response:
         with _req_hist.observe_time("submit_blinded_block"):
-            body = await request.json()
+            body = await self._strict_body(request, "object")
             await self._comp.submit_blinded_block(
                 _decode(lambda: jc.decode_signed_beacon_block(body)))
             return web.json_response({})
@@ -292,9 +369,12 @@ class VapiRouter:
     async def _prepare_proposer(self, request: web.Request) -> web.Response:
         # accepted and dropped, like the reference (router.go:861
         # submitProposalPreparations): fee recipients come from the cluster
-        # config via /proposer_config, not per-VC preparations
-        await request.read()
-        return web.json_response({})
+        # config via /proposer_config, not per-VC preparations — but the
+        # body must still be a well-formed JSON array (the standard shape)
+        # so garbage doesn't get a silent 200
+        with _req_hist.observe_time("prepare_proposer"):
+            await self._strict_body(request, "list")
+            return web.json_response({})
 
     async def _proposer_config(self, request: web.Request) -> web.Response:
         with _req_hist.observe_time("proposer_config"):
@@ -306,7 +386,7 @@ class VapiRouter:
             for csv in request.query.getall("id", []):
                 ids.extend(x.strip() for x in csv.split(",") if x.strip())
             if request.method == "POST" and request.can_read_body:
-                body = await request.json()
+                body = await self._strict_body(request, "object_or_null")
                 for x in _decode(lambda: _ids_filter(body)):
                     ids.append(str(x))
             vals = await self._comp.get_validators(ids)
@@ -325,7 +405,7 @@ class VapiRouter:
 
     async def _submit_block(self, request: web.Request) -> web.Response:
         with _req_hist.observe_time("submit_block"):
-            body = await request.json()
+            body = await self._strict_body(request, "object")
             await self._comp.submit_block(_decode(lambda: jc.decode_signed_beacon_block(body)))
             return web.json_response({})
 
@@ -338,7 +418,7 @@ class VapiRouter:
 
     async def _submit_aggregates(self, request: web.Request) -> web.Response:
         with _req_hist.observe_time("submit_aggregates"):
-            body = await request.json()
+            body = await self._strict_body(request, "list")
             aggs = _decode(lambda: [
                 jc.decode_container(spec.SignedAggregateAndProof, o)
                 for o in body])
@@ -347,7 +427,7 @@ class VapiRouter:
 
     async def _submit_sync_messages(self, request: web.Request) -> web.Response:
         with _req_hist.observe_time("submit_sync_messages"):
-            body = await request.json()
+            body = await self._strict_body(request, "list")
             msgs = _decode(lambda: [
                 jc.decode_container(spec.SyncCommitteeMessage, o)
                 for o in body])
@@ -364,7 +444,7 @@ class VapiRouter:
 
     async def _submit_contributions(self, request: web.Request) -> web.Response:
         with _req_hist.observe_time("submit_contributions"):
-            body = await request.json()
+            body = await self._strict_body(request, "list")
             contribs = _decode(lambda: [
                 jc.decode_container(spec.SignedContributionAndProof, o)
                 for o in body])
@@ -373,25 +453,36 @@ class VapiRouter:
 
     async def _bc_selections(self, request: web.Request) -> web.Response:
         with _req_hist.observe_time("beacon_committee_selections"):
-            body = await request.json()
+            body = await self._strict_body(request, "list")
+            # Decode the wire containers, then lift them into the signeddata
+            # wrappers the Component verifies/aggregates (the wire shape has
+            # no signing-domain knowledge).
             sels = _decode(lambda: [
-                jc.decode_container(spec.BeaconCommitteeSelection, o)
-                for o in body])
+                signeddata.BeaconCommitteeSelection(
+                    w.validator_index, w.slot, bytes(w.selection_proof))
+                for w in (jc.decode_container(spec.BeaconCommitteeSelection, o)
+                          for o in body)])
             combined = await self._comp.aggregate_beacon_committee_selections(sels)
-            return _data([jc.encode_container(s) for s in combined])
+            return _data([jc.encode_container(spec.BeaconCommitteeSelection(
+                s.validator_index, s.slot, bytes(s.sig))) for s in combined])
 
     async def _sc_selections(self, request: web.Request) -> web.Response:
         with _req_hist.observe_time("sync_committee_selections"):
-            body = await request.json()
+            body = await self._strict_body(request, "list")
             sels = _decode(lambda: [
-                jc.decode_container(spec.SyncCommitteeSelection, o)
-                for o in body])
+                signeddata.SyncCommitteeSelection(
+                    w.validator_index, w.slot, w.subcommittee_index,
+                    bytes(w.selection_proof))
+                for w in (jc.decode_container(spec.SyncCommitteeSelection, o)
+                          for o in body)])
             combined = await self._comp.aggregate_sync_committee_selections(sels)
-            return _data([jc.encode_container(s) for s in combined])
+            return _data([jc.encode_container(spec.SyncCommitteeSelection(
+                s.validator_index, s.slot, s.subcommittee_index,
+                bytes(s.sig))) for s in combined])
 
     async def _submit_exit(self, request: web.Request) -> web.Response:
         with _req_hist.observe_time("voluntary_exit"):
-            body = await request.json()
+            body = await self._strict_body(request, "object")
             await self._comp.submit_voluntary_exit(
                 _decode(lambda: jc.decode_container(
                     spec.SignedVoluntaryExit, body)))
@@ -399,7 +490,7 @@ class VapiRouter:
 
     async def _register(self, request: web.Request) -> web.Response:
         with _req_hist.observe_time("register_validator"):
-            body = await request.json()
+            body = await self._strict_body(request, "list")
             regs = _decode(lambda: [
                 jc.decode_container(spec.SignedValidatorRegistration, o)
                 for o in body])
@@ -412,7 +503,12 @@ class VapiRouter:
         if self._bn_url is None:
             return _err(404, f"unknown endpoint {request.path} (no upstream BN configured)")
         if self._proxy_session is None:
-            self._proxy_session = ClientSession(timeout=ClientTimeout(total=30))
+            # Explicit keep-alive pool: one upstream BN serves thousands of
+            # proxied VC requests per slot, so per-request TCP+TLS setup is
+            # pure overhead — reuse up to 64 warm connections for 30 s.
+            self._proxy_session = ClientSession(
+                timeout=ClientTimeout(total=30),
+                connector=TCPConnector(limit=64, keepalive_timeout=30.0))
         url = self._bn_url + request.path_qs
         body = await request.read()
         try:
@@ -426,6 +522,33 @@ class VapiRouter:
         except (OSError, asyncio.TimeoutError) as exc:
             _log.warn("BN proxy failed", url=url, err=exc)
             return _err(502, f"upstream beacon node unreachable: {exc}")
+
+
+# Outermost middleware: per-route latency/inflight/request counters over
+# the FINAL response (after the error middleware mapped exceptions to
+# statuses). Routes are labeled by the matched pattern, not the raw path,
+# so {slot}/{epoch} params can't explode series cardinality.
+@web.middleware
+async def _metrics_middleware(request: web.Request, handler):
+    resource = request.match_info.route.resource
+    route = resource.canonical if resource is not None else request.path
+    method = request.method
+    _inflight_g.inc(amount=1.0)
+    t0 = time.monotonic()
+    status = 500
+    try:
+        resp = await handler(request)
+        status = resp.status
+        return resp
+    except web.HTTPException as exc:
+        status = exc.status
+        raise
+    finally:
+        _inflight_g.inc(amount=-1.0)
+        _route_hist.observe(time.monotonic() - t0, route, method)
+        _requests_c.inc(route, method, str(status))
+        if status >= 500:
+            _request_errors_c.inc(route, method)
 
 
 # Span per VC request, named by the matched route pattern so slot/epoch
@@ -446,10 +569,21 @@ async def _tracing_middleware(request: web.Request, handler):
 async def _error_middleware(request: web.Request, handler):
     try:
         return await handler(request)
+    except web.HTTPRequestEntityTooLarge:
+        # must precede the generic HTTPException reraise: an over-limit body
+        # read should answer in the beacon-API JSON error shape, not
+        # aiohttp's default HTML error page
+        return _err(413, "request body exceeds the configured size limit")
     except web.HTTPException:
         raise
     except asyncio.TimeoutError:
         return _err(408, "request timed out awaiting consensus data")
+    except OverloadedError as exc:
+        # sigagg dispatch backlog behind the deadline budget: shed with an
+        # explicit retry hint instead of queueing into a missed deadline
+        resp = _err(503, f"overloaded: {exc}")
+        resp.headers["Retry-After"] = str(max(1, math.ceil(exc.retry_after)))
+        return resp
     except (KeyError, ValueError) as exc:
         # ValueError covers JSONDecodeError and the _decode remap of
         # structurally-wrong bodies; TypeError/AttributeError stay on the

@@ -64,8 +64,14 @@ class HTTPBeaconNode:
         if self._session is None or self._session.closed:
             import aiohttp
 
+            # Explicit keep-alive pool: every duty in a slot round-trips to
+            # the BN, so the serving path must reuse warm connections
+            # instead of paying TCP setup per request (beaconmock_http's
+            # connection counters assert this reuse in tests).
             self._session = aiohttp.ClientSession(
-                timeout=aiohttp.ClientTimeout(total=self._timeout))
+                timeout=aiohttp.ClientTimeout(total=self._timeout),
+                connector=aiohttp.TCPConnector(
+                    limit=32, keepalive_timeout=30.0))
         return self._session
 
     async def close(self) -> None:

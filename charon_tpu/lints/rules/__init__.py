@@ -8,6 +8,7 @@ from .iface import ProtocolImplRule
 from .obs import DutySpanRule
 from .tpu import (DeviceDtypeRule, MeshTopologyRule, PipelineLockSyncRule,
                   PlaneStoreRoutingRule)
+from .vapi import StrictBodyRule
 
 __all__ = [
     "UntrackedTaskRule",
@@ -19,6 +20,7 @@ __all__ = [
     "MeshTopologyRule",
     "ProtocolImplRule",
     "DutySpanRule",
+    "StrictBodyRule",
     "default_rules",
 ]
 
@@ -34,4 +36,5 @@ def default_rules() -> list:
         MeshTopologyRule(),
         ProtocolImplRule(),
         DutySpanRule(),
+        StrictBodyRule(),
     ]

@@ -1,0 +1,64 @@
+"""LINT-VAPI-010 — vapi_router handlers must use the shared strict-body
+helper.
+
+The ValidatorAPI front door is the node's public attack surface: every
+intercepted POST route must ingest its body through `_strict_body`, the
+ONE path that (in order) applies coalescer backpressure admission — 503 +
+Retry-After BEFORE any parse CPU is spent — the bounded read capped by
+`client_max_size` (413), and strict container-shape validation (a scalar
+where a list belongs is a 400, never a handler iterating a string
+character-by-character into a 500). A handler that reads the request body
+directly silently opts out of all three (ISSUE 7's audit found exactly
+this class of drift).
+
+Flags: any `await request.json() / .read() / .post() / .text()` call in a
+file named `vapi_router.py` whose enclosing function is neither
+`_strict_body` itself nor `_proxy` (the BN passthrough forwards bodies
+verbatim by design — shape-validating someone else's API would break it).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable
+
+from ..engine import Finding, SourceFile
+
+_BODY_READS = ("json", "read", "post", "text")
+_ALLOWED_FUNCS = ("_strict_body", "_proxy")
+
+
+class StrictBodyRule:
+    id = "LINT-VAPI-010"
+    description = ("vapi_router handlers must route body parsing through "
+                   "the shared _strict_body helper")
+
+    def check(self, src: SourceFile) -> Iterable[Finding]:
+        if not src.rel.endswith("vapi_router.py"):
+            return
+        for node in ast.walk(src.tree):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in _BODY_READS
+                    and isinstance(node.func.value, ast.Name)
+                    and node.func.value.id == "request"):
+                continue
+            fn = self._enclosing_function(src, node)
+            if fn is not None and fn.name in _ALLOWED_FUNCS:
+                continue
+            where = fn.name if fn is not None else "<module>"
+            yield Finding(
+                path=src.rel, line=node.lineno, rule=self.id,
+                message=(f"{where} reads the request body via "
+                         f"request.{node.func.attr}(); route it through "
+                         "_strict_body so backpressure admission, the "
+                         "bounded read and shape validation all apply"))
+
+    @staticmethod
+    def _enclosing_function(src: SourceFile, node: ast.AST):
+        cur = node
+        while cur is not None:
+            cur = src.parent(cur)
+            if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                return cur
+        return None

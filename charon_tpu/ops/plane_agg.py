@@ -87,6 +87,14 @@ _shard_width = metrics.gauge(
     "ops_sigagg_shard_width",
     "Devices the current sigagg slot's validator axis is sharded over")
 
+# Whole slots queued in the pipeline (dispatched, finish not yet consumed)
+# — the serving layer's backpressure signal: core/coalesce estimates drain
+# time from its own in-flight count, and this gauge is the device-plane
+# ground truth an operator correlates a 503 shed against.
+_submit_backlog = metrics.gauge(
+    "ops_sigagg_submit_backlog",
+    "SigAggPipeline slots in flight (submitted, result not yet consumed)")
+
 
 @functools.lru_cache(maxsize=4096)
 def _lagrange(ids: tuple[int, ...]) -> tuple[int, ...]:
@@ -1024,6 +1032,13 @@ class SigAggPipeline:
         self._pending: deque = deque()
         self._pool: ThreadPoolExecutor | None = None
 
+    @property
+    def backlog(self) -> int:
+        """Slots submitted but not yet consumed (the ops_sigagg_submit_backlog
+        gauge, as a direct accessor for the serving/backpressure layer)."""
+        with self._lock:
+            return len(self._pending)
+
     def _schedule_finish(self, state, inputs, hash_fn) -> Future:
         # caller holds self._lock; scheduling only — no device sync here
         if self._pool is None:
@@ -1067,6 +1082,7 @@ class SigAggPipeline:
                 over = (self._pending.popleft()
                         if len(self._pending) > self._depth else None)
                 span.attrs["in_flight"] = len(self._pending)
+                _submit_backlog.set(float(len(self._pending)))
             # block OUTSIDE the lock: the popped slot's finish may still be
             # running on a worker; a concurrent submit packs meanwhile
             return [self._pop_result(over)] if over is not None else []
@@ -1088,6 +1104,7 @@ class SigAggPipeline:
                 over = (self._pending.popleft()
                         if len(self._pending) > self._depth else None)
                 span.attrs["in_flight"] = len(self._pending)
+                _submit_backlog.set(float(len(self._pending)))
             if over is not None:
                 # backpressure only: wait, don't .result() — the popped
                 # future's owner consumes its value/exception. Deadline-
@@ -1145,6 +1162,7 @@ class SigAggPipeline:
                         span.attrs["drained"] = len(out)
                         return out
                     entry = self._pending.popleft()
+                    _submit_backlog.set(float(len(self._pending)))
                 out.append(self._pop_result(entry))
 
     def aggregate_verify(self, batches, pks, msgs, hash_fn=None):

@@ -89,27 +89,42 @@ class BeaconMock:
             return self._duty_memo[memo_key]
         by_index = {v.index: v for v in self.validators.values()}
         duties = []
-        wanted = [i for i in indices if i in by_index]
+        wanted = set(indices) & set(by_index)
+        # Committee positions are ABSOLUTE (over the full committee), like a
+        # real BN: a VC querying only its own validators must see the same
+        # bit positions the scheduler (querying everyone) resolves, or its
+        # one-bit attestations map to the wrong validator.
+        committee = sorted(by_index)
+        posmap = {idx: pos for pos, idx in enumerate(committee)}
         for slot in range(epoch * self._spec.slots_per_epoch,
                           (epoch + 1) * self._spec.slots_per_epoch):
             if self._attest_all:
                 # Everyone attests every slot in committee 0 — maximal duty
                 # density for exercising the pipeline.
-                for pos, idx in enumerate(sorted(wanted)):
+                for idx in sorted(wanted):
                     v = by_index[idx]
                     duties.append(spec.AttesterDuty(
                         pubkey=v.pubkey, slot=slot, validator_index=idx,
-                        committee_index=0, committee_length=len(wanted),
-                        committees_at_slot=1, validator_committee_index=pos))
+                        committee_index=0, committee_length=len(committee),
+                        committees_at_slot=1,
+                        validator_committee_index=posmap[idx]))
             else:
-                # One deterministic slot per validator per epoch.
-                for pos, idx in enumerate(sorted(wanted)):
-                    if slot % self._spec.slots_per_epoch == idx % self._spec.slots_per_epoch:
+                # One deterministic slot per validator per epoch; the slot's
+                # committee is everyone assigned to it, queried or not.
+                slot_committee = [
+                    idx for idx in committee
+                    if slot % self._spec.slots_per_epoch
+                    == idx % self._spec.slots_per_epoch]
+                slot_pos = {idx: pos for pos, idx in enumerate(slot_committee)}
+                for idx in sorted(wanted):
+                    if idx in slot_pos:
                         v = by_index[idx]
                         duties.append(spec.AttesterDuty(
                             pubkey=v.pubkey, slot=slot, validator_index=idx,
-                            committee_index=0, committee_length=len(wanted),
-                            committees_at_slot=1, validator_committee_index=pos))
+                            committee_index=0,
+                            committee_length=len(slot_committee),
+                            committees_at_slot=1,
+                            validator_committee_index=slot_pos[idx]))
         if len(self._duty_memo) > 64:
             self._duty_memo.clear()
         self._duty_memo[memo_key] = duties

@@ -31,7 +31,14 @@ class HTTPBeaconMock:
         self.mock = mock
         self.host = host
         self.port = port
+        # Keep-alive accounting: requests served per TCP connection, keyed
+        # by the connection's id. A client that reuses its session shows one
+        # connection with many requests; one that reconnects per request
+        # shows connections_used == request count. tests/test_loadgen.py
+        # asserts reuse through this, and bench_vapi reports it.
+        self.connection_requests: dict[int, int] = {}
         app = web.Application()
+        app.middlewares.append(self._conn_count_middleware)
         r = app.router
         r.add_get("/eth/v1/beacon/genesis", self._genesis)
         r.add_get("/eth/v1/config/spec", self._spec)
@@ -73,6 +80,27 @@ class HTTPBeaconMock:
     @property
     def base_url(self) -> str:
         return f"http://{self.host}:{self.port}"
+
+    @property
+    def connections_used(self) -> int:
+        return len(self.connection_requests)
+
+    @property
+    def requests_served(self) -> int:
+        return sum(self.connection_requests.values())
+
+    @web.middleware
+    async def _conn_count_middleware(self, request: web.Request, handler):
+        # id(transport) is unique while the connection lives; a dead
+        # connection's id could in principle be recycled, but the counters
+        # only need to distinguish "one warm connection" from "a reconnect
+        # per request" over a short bench/test window.
+        transport = request.transport
+        if transport is not None:
+            key = id(transport)
+            self.connection_requests[key] = (
+                self.connection_requests.get(key, 0) + 1)
+        return await handler(request)
 
     # -- chain info -----------------------------------------------------------
 

@@ -1,0 +1,636 @@
+"""Loadgen — mainnet-shaped ValidatorAPI traffic model + serving harness.
+
+The pieces bench_vapi.py (and tests/test_loadgen.py) compose:
+
+  * DutyMix — a deterministic per-slot duty plan with mainnet rates: each
+    validator attests exactly once per epoch (the epoch order is a seeded
+    shuffle, slot k takes every slots_per_epoch-th validator), a fixed
+    fraction signs sync-committee messages every slot, and epoch-start slots
+    get a selection STORM (every validator submits an aggregation-selection
+    proof at once — the thundering herd the reference sees at epoch
+    boundaries). Same seed ⇒ identical plans across processes.
+
+  * SimVC — one simulated validator client: its own HTTPValidatorClient
+    (one keep-alive connection), a slice of node 0's share secrets, and the
+    honest HTTP bootstrap (GET states/head/validators with share pubkeys,
+    duties posted with decimal index bodies) a real VC performs.
+
+  * ServingHarness — wires a full simnet cluster whose node 0 speaks HTTP
+    end to end: VC fleet → VapiRouter → Component, node 0's beacon surface →
+    HTTPBeaconMock, peers driven by in-process vmocks so threshold duties
+    (selection aggregation) complete, plus a synthetic parsigex partial-
+    signature storm batch-verified on the device plane each slot.
+
+  * route_stats() — per-route p50/p99/error-rate read from the SAME
+    vapi_route_latency_seconds / vapi_requests_total series /metrics serves.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import hashlib
+import random
+import time
+from collections import Counter as TallyCounter
+from dataclasses import dataclass, field
+
+from .. import tbls
+from ..core.signeddata import SignedAttestation, SignedProposal, SignedRandao
+from ..core.signeddata import SignedSyncMessage
+from ..core.types import Duty, DutyType, ParSignedData, ParSignedDataSet
+from ..core.types import PubKey
+from ..core.vapi_router import VapiRouter
+from ..eth2 import json_codec as jc
+from ..eth2 import signing, spec
+from ..eth2.http_beacon import HTTPBeaconNode
+from ..eth2.vapi_client import HTTPValidatorClient, VapiHTTPError
+from ..utils import log, metrics
+from .beaconmock_http import HTTPBeaconMock
+from .simnet import SimCluster, new_simnet
+
+_log = log.with_topic("loadgen")
+
+
+# -- traffic model ------------------------------------------------------------
+
+@dataclass(frozen=True)
+class SlotPlan:
+    """One slot's planned VC-side work, in validator ordinals (0..n-1)."""
+
+    slot: int
+    epoch: int
+    epoch_start: bool
+    attesters: frozenset[int]      # attest this slot (1/epoch each, mainnet)
+    sync_signers: frozenset[int]   # sign a sync message this slot
+    selections: frozenset[int]     # submit selection proofs (epoch storm)
+    proposer: int                  # the MODEL's proposer pick (see note)
+
+
+class DutyMix:
+    """Deterministic mainnet-rate duty mix (SURVEY §serving traffic shape).
+
+    `proposer` in the plan is the model's own pick for rate-accounting;
+    actual proposals follow the chain's proposer_duties assignment (a VC
+    can only propose for the validator the BN says leads the slot).
+    """
+
+    def __init__(self, num_validators: int, slots_per_epoch: int,
+                 seed: str = "charon", sync_fraction: float = 0.25,
+                 selection_storm: bool = True):
+        if num_validators < 1:
+            raise ValueError("num_validators must be >= 1")
+        self.num_validators = num_validators
+        self.slots_per_epoch = slots_per_epoch
+        self.seed = seed
+        self.sync_fraction = sync_fraction
+        self.selection_storm = selection_storm
+        self._orders: dict[int, list[int]] = {}
+
+    def _epoch_order(self, epoch: int) -> list[int]:
+        order = self._orders.get(epoch)
+        if order is None:
+            order = list(range(self.num_validators))
+            # String seeds hash identically across processes (unlike object
+            # hashes under PYTHONHASHSEED randomization), so two DutyMix
+            # instances anywhere agree on every plan.
+            random.Random(f"{self.seed}:{epoch}").shuffle(order)
+            if len(self._orders) > 64:  # bounded cache for long runs
+                self._orders.clear()
+            self._orders[epoch] = order
+        return order
+
+    def plan(self, slot: int) -> SlotPlan:
+        epoch, k = divmod(slot, self.slots_per_epoch)
+        order = self._epoch_order(epoch)
+        attesters = frozenset(order[k::self.slots_per_epoch])
+        n_sync = max(1, int(self.num_validators * self.sync_fraction))
+        epoch_start = k == 0
+        selections = (frozenset(range(self.num_validators))
+                      if epoch_start and self.selection_storm else frozenset())
+        proposer = random.Random(
+            f"{self.seed}:slot:{slot}").randrange(self.num_validators)
+        return SlotPlan(slot=slot, epoch=epoch, epoch_start=epoch_start,
+                        attesters=attesters,
+                        sync_signers=frozenset(order[:n_sync]),
+                        selections=selections, proposer=proposer)
+
+
+# -- simulated validator client ----------------------------------------------
+
+class SimVC:
+    """One VC driving the router over its own keep-alive HTTP connection."""
+
+    def __init__(self, vc_idx: int, base_url: str,
+                 secrets: dict[bytes, tbls.PrivateKey],
+                 ordinal_by_share: dict[bytes, int],
+                 chain: spec.ChainSpec, stats: TallyCounter,
+                 timeout: float = 30.0):
+        self.vc_idx = vc_idx
+        self._c = HTTPValidatorClient(base_url, timeout=timeout)
+        self._secrets = secrets          # share-pubkey bytes -> share secret
+        self._ordinal = ordinal_by_share  # share-pubkey bytes -> ordinal
+        self._chain = chain
+        self._stats = stats
+        self.index_to_share: dict[int, bytes] = {}
+        self._duties_epoch: int | None = None
+        self._att_duties: list[spec.AttesterDuty] = []
+        self._pro_duties: list[spec.ProposerDuty] = []
+
+    async def close(self) -> None:
+        await self._c.close()
+
+    async def _call(self, kind: str, coro):
+        """Run one HTTP step, tallying the outcome instead of raising — a
+        real VC logs-and-continues, and the bench wants the error counts."""
+        self._stats[f"{kind}.requests"] += 1
+        try:
+            out = await coro
+        except VapiHTTPError as exc:
+            self._stats[f"{kind}.http_{exc.status}"] += 1
+            if exc.status == 503:
+                self._stats["shed_503"] += 1
+            return None
+        except asyncio.CancelledError:
+            raise
+        except (TimeoutError, asyncio.TimeoutError):
+            self._stats[f"{kind}.timeout"] += 1
+            return None
+        except Exception:  # noqa: BLE001 — transport errors tally, not raise
+            self._stats[f"{kind}.transport_error"] += 1
+            return None
+        self._stats[f"{kind}.ok"] += 1
+        return out
+
+    async def _bootstrap(self) -> bool:
+        ids = ["0x" + pk.hex() for pk in self._secrets]
+        recs = await self._call("bootstrap", self._c.get_validators(ids))
+        if recs is None:
+            return False
+        for r in recs:
+            pk = bytes.fromhex(r["validator"]["pubkey"][2:])
+            if pk in self._secrets:
+                self.index_to_share[int(r["index"])] = pk
+        return bool(self.index_to_share)
+
+    async def _refresh_duties(self, epoch: int) -> None:
+        """The epoch-boundary duty burst: every VC re-resolves duties at
+        once (spec-standard decimal-index POST body + proposer GET)."""
+        out = await self._call("duties_attester", self._c.raw(
+            "POST", f"/eth/v1/validator/duties/attester/{epoch}",
+            json_body=[str(i) for i in sorted(self.index_to_share)]))
+        if out is not None:
+            self._att_duties = [jc.decode_attester_duty(o)
+                                for o in out["data"]]
+        pro = await self._call("duties_proposer", self._c.proposer_duties(
+            epoch, list(self._secrets)))
+        if pro is not None:
+            self._pro_duties = pro
+        self._duties_epoch = epoch
+
+    def _planned(self, share_pk: bytes, chosen: frozenset[int]) -> bool:
+        o = self._ordinal.get(share_pk)
+        return o is not None and o in chosen
+
+    async def _attest(self, plan: SlotPlan) -> None:
+        atts = []
+        for duty in self._att_duties:
+            share = bytes(duty.pubkey)
+            if duty.slot != plan.slot or not self._planned(
+                    share, plan.attesters):
+                continue
+            data = await self._call("attestation_data", self._c.attestation_data(
+                plan.slot, duty.committee_index))
+            if data is None:
+                continue
+            bits = [False] * duty.committee_length
+            bits[duty.validator_committee_index] = True
+            unsigned = spec.Attestation(bits, data, b"\x00" * 96)
+            root = SignedAttestation(unsigned).signing_root(self._chain)
+            atts.append(spec.Attestation(
+                bits, data, bytes(tbls.sign(self._secrets[share], root))))
+        if atts:
+            await self._call("submit_attestations",
+                             self._c.submit_attestations(atts))
+
+    async def _sync_messages(self, plan: SlotPlan) -> None:
+        head = hashlib.sha256(f"head:{plan.slot}".encode()).digest()
+        msgs = []
+        for idx, share in self.index_to_share.items():
+            if not self._planned(share, plan.sync_signers):
+                continue
+            unsigned = spec.SyncCommitteeMessage(plan.slot, head, idx,
+                                                 b"\x00" * 96)
+            root = SignedSyncMessage(unsigned).signing_root(self._chain)
+            msgs.append(spec.SyncCommitteeMessage(
+                plan.slot, head, idx,
+                bytes(tbls.sign(self._secrets[share], root))))
+        if msgs:
+            await self._call("submit_sync_messages",
+                             self._c.submit_sync_committee_messages(msgs))
+
+    async def _selections(self, plan: SlotPlan) -> None:
+        """Epoch-boundary selection storm. This route AWAITS the cluster-
+        combined proof, so peers must contribute matching partials for it
+        to return 200 (the harness subscribes peer vmocks to do exactly
+        that)."""
+        root = signing.slot_selection_root(self._chain, plan.slot)
+        sels = []
+        for idx, share in self.index_to_share.items():
+            if not self._planned(share, plan.selections):
+                continue
+            sels.append(spec.BeaconCommitteeSelection(
+                idx, plan.slot,
+                bytes(tbls.sign(self._secrets[share], root))))
+        if sels:
+            await self._call(
+                "beacon_committee_selections",
+                self._c.aggregate_beacon_committee_selections(sels))
+
+    async def _propose(self, plan: SlotPlan) -> None:
+        for duty in self._pro_duties:
+            share = bytes(duty.pubkey)
+            if duty.slot != plan.slot or share not in self._secrets:
+                continue
+            secret = self._secrets[share]
+            randao_root = SignedRandao(
+                self._chain.epoch_of(plan.slot)).signing_root(self._chain)
+            block = await self._call("block_proposal", self._c.block_proposal(
+                plan.slot, bytes(tbls.sign(secret, randao_root))))
+            if block is None:
+                continue
+            block_root = SignedProposal(block).signing_root(self._chain)
+            await self._call("submit_block", self._c.submit_block(
+                spec.SignedBeaconBlock(
+                    block, bytes(tbls.sign(secret, block_root)))))
+
+    async def run_slot(self, plan: SlotPlan) -> None:
+        """One slot of this VC's life: bootstrap once, re-resolve duties at
+        epoch boundaries (the burst), then the slot's duty mix."""
+        if not self.index_to_share and not await self._bootstrap():
+            return
+        if self._duties_epoch != plan.epoch:
+            await self._refresh_duties(plan.epoch)
+        jobs = [self._attest(plan), self._sync_messages(plan)]
+        if plan.selections:
+            jobs.append(self._selections(plan))
+        jobs.append(self._propose(plan))
+        await asyncio.gather(*jobs)
+
+
+# -- synthetic parsigex storm -------------------------------------------------
+
+def make_parsig_storm(cluster: SimCluster, chain: spec.ChainSpec,
+                      storm_slot: int,
+                      ordinal_roots: list[PubKey]) -> list[tuple[int, Duty, ParSignedDataSet]]:
+    """Build one inbound partial-signature storm: every peer node signs a
+    synthetic attestation per listed validator with its real share secret.
+
+    Broadcast through the cluster's shared parsigex MemTransport, each
+    delivery batch-verifies on the receiving node's device plane, and node 0
+    (receiving all n-1 peers ≥ threshold) aggregates the threshold
+    signature. `storm_slot` must not collide with live duty slots — the
+    same share signing two roots for one (duty, validator) is equivocation
+    (parsigdb) — so callers use a future slot (the gater admits up to two
+    epochs ahead).
+    """
+    epoch = chain.epoch_of(storm_slot)
+    block_root = hashlib.sha256(f"storm:{storm_slot}".encode()).digest()
+    duty = Duty(storm_slot, DutyType.ATTESTER)
+    out: list[tuple[int, Duty, ParSignedDataSet]] = []
+    for node in cluster.nodes[1:]:
+        parsigs: ParSignedDataSet = {}
+        for i, root_pk in enumerate(ordinal_roots):
+            data = spec.AttestationData(
+                slot=storm_slot, index=i, beacon_block_root=block_root,
+                source=spec.Checkpoint(max(epoch - 1, 0), b"\x00" * 32),
+                target=spec.Checkpoint(epoch, b"\x01" * 32))
+            unsigned = spec.Attestation([True], data, b"\x00" * 96)
+            root = SignedAttestation(unsigned).signing_root(chain)
+            sig = tbls.sign(node.keys.my_share_secrets[root_pk], root)
+            att = spec.Attestation([True], data, bytes(sig))
+            parsigs[root_pk] = ParSignedData(SignedAttestation(att),
+                                             node.keys.my_share_idx)
+        out.append((node.idx, duty, parsigs))
+    return out
+
+
+# -- metrics tail -------------------------------------------------------------
+
+def route_stats() -> dict[str, dict[str, float]]:
+    """Per-route serving stats from the live registry — the same
+    vapi_route_latency_seconds / vapi_requests_total series /metrics
+    exports, folded to {"METHOD route": {p50, p99, count, requests,
+    errors, error_rate}}."""
+    reg = metrics.default_registry.gather()
+    out: dict[str, dict[str, float]] = {}
+    hist = reg.get("vapi_route_latency_seconds")
+    if isinstance(hist, metrics.Histogram):
+        with hist._lock:
+            keys = {k: sum(c) for k, c in hist._counts.items()}
+        for (route, method), count in keys.items():
+            d = out.setdefault(f"{method} {route}", {})
+            d["count"] = float(count)
+            d["p50"] = hist.quantile(0.5, route, method)
+            d["p99"] = hist.quantile(0.99, route, method)
+    ctr = reg.get("vapi_requests_total")
+    if isinstance(ctr, metrics.Counter):
+        with ctr._lock:
+            children = dict(ctr._children)
+        for (route, method, code), val in children.items():
+            d = out.setdefault(f"{method} {route}", {})
+            d["requests"] = d.get("requests", 0.0) + val
+            if int(code) >= 500:
+                d["errors"] = d.get("errors", 0.0) + val
+    for d in out.values():
+        reqs = d.get("requests", 0.0)
+        d.setdefault("errors", 0.0)
+        d["error_rate"] = (d["errors"] / reqs) if reqs else 0.0
+    return out
+
+
+# -- serving harness ----------------------------------------------------------
+
+@dataclass
+class TrafficConfig:
+    """Knobs for one ServingHarness run (docs/serving.md)."""
+
+    num_validators: int = 32
+    num_vcs: int = 8
+    threshold: int = 3
+    num_nodes: int = 4
+    seconds_per_slot: float = 12.0
+    slots_per_epoch: int = 8
+    slots: int = 4                 # keep < slots_per_epoch (storm headroom)
+    seed: str = "charon"
+    sync_fraction: float = 0.25
+    selection_storm: bool = True
+    storm_validators: int = 8      # parsigex storm size per slot (0 = off)
+    genesis_delay: float = 1.0
+    vc_timeout: float = 30.0
+    coalesce_budget_s: float = 12.0
+    max_body_bytes: int = 2 * 1024 * 1024
+
+
+@dataclass
+class ServingReport:
+    """What a run measured — bench_vapi serializes this as its JSON tail."""
+
+    elapsed_s: float
+    slots_run: int
+    num_vcs: int
+    num_validators: int
+    client_requests: int
+    achieved_rps: float
+    routes: dict[str, dict[str, float]]
+    client_tallies: dict[str, int]
+    bn_connections_used: int
+    bn_requests_served: int
+
+    def to_json(self) -> dict:
+        return {
+            "elapsed_s": round(self.elapsed_s, 3),
+            "slots_run": self.slots_run,
+            "num_vcs": self.num_vcs,
+            "num_validators": self.num_validators,
+            "client_requests": self.client_requests,
+            "achieved_rps": round(self.achieved_rps, 2),
+            "routes": {k: {kk: (round(vv, 6) if isinstance(vv, float) else vv)
+                           for kk, vv in d.items()}
+                       for k, d in sorted(self.routes.items())},
+            "client_tallies": dict(sorted(self.client_tallies.items())),
+            "bn_connections_used": self.bn_connections_used,
+            "bn_requests_served": self.bn_requests_served,
+        }
+
+
+class ServingHarness:
+    """A full simnet cluster with node 0's entire serving path over real
+    HTTP: VC fleet → VapiRouter (+ backpressure coalescer) → Component, and
+    node 0's beacon surface → HTTPBeaconMock via the keep-alive
+    HTTPBeaconNode client. Peers run in-process vmocks so threshold duties
+    complete, and contribute the epoch-boundary selection partials."""
+
+    def __init__(self, cfg: TrafficConfig):
+        self.cfg = cfg
+        self.stats: TallyCounter = TallyCounter()
+        self.mix = DutyMix(cfg.num_validators, cfg.slots_per_epoch,
+                           seed=cfg.seed, sync_fraction=cfg.sync_fraction,
+                           selection_storm=cfg.selection_storm)
+        self.cluster: SimCluster | None = None
+        self.router: VapiRouter | None = None
+        self.http_mock: HTTPBeaconMock | None = None
+        self.bn_client: HTTPBeaconNode | None = None
+        self.vcs: list[SimVC] = []
+        self.chain: spec.ChainSpec | None = None
+        self._ordinal_roots: list[PubKey] = []
+
+    async def start(self) -> None:
+        cfg = self.cfg
+        # The BN client is wired into node 0 at construction but only learns
+        # its real URL after the HTTP mock binds a port (base_url is read
+        # per-request, the session is lazy — late binding is safe).
+        self.bn_client = HTTPBeaconNode("http://127.0.0.1:0",
+                                        timeout=max(10.0, cfg.vc_timeout))
+        self.cluster = new_simnet(
+            num_validators=cfg.num_validators, threshold=cfg.threshold,
+            num_nodes=cfg.num_nodes, seconds_per_slot=cfg.seconds_per_slot,
+            slots_per_epoch=cfg.slots_per_epoch,
+            genesis_delay=cfg.genesis_delay, use_vmock=False,
+            node0_beacon_client=self.bn_client)
+        self.chain = self.cluster.beacon._spec
+        self.http_mock = HTTPBeaconMock(self.cluster.beacon)
+        await self.http_mock.start()
+        self.bn_client.base_url = self.http_mock.base_url
+        self.bn_client.name = self.bn_client.base_url
+
+        node0 = self.cluster.nodes[0]
+        if node0.coalescer is not None:
+            node0.coalescer.deadline_budget_s = cfg.coalesce_budget_s
+        self.router = VapiRouter(node0.vapi,
+                                 bn_base_url=self.http_mock.base_url,
+                                 coalescer=node0.coalescer,
+                                 max_body_bytes=cfg.max_body_bytes)
+        await self.router.start()
+
+        # Peers: in-process vmocks thinned to the SAME DutyMix the VC fleet
+        # follows (attest once per epoch per validator, sync partials for
+        # the plan's signers, propose, and the epoch-start selection
+        # contribution that lets node 0's awaiting selections route reach
+        # threshold and return). Un-thinned attest-all peers drown the
+        # event loop in BLS work at bench slot rates.
+        for n in self.cluster.nodes[1:]:
+            n.sched.subscribe_slots(self._peer_handler(n))
+        await self.cluster.start()
+
+        self._build_fleet()
+
+    def _peer_handler(self, node):
+        # Selections cascade sequentially through every peer's component
+        # (each awaits the cluster-combined proof before the next), so the
+        # budget spans the duty-deadline window, not one slot.
+        budget = max(4 * self.cfg.seconds_per_slot, 4.0)
+        # ordinal -> (root PubKey str, this node's share secret)
+        secrets_by_ordinal: dict[int, tuple[PubKey, tbls.PrivateKey]] = {}
+
+        async def sync_partials(slot: int, signers: frozenset[int]) -> None:
+            """Peer-side sync-message partials matching the VC fleet's
+            (same head root), so sync duties reach threshold and the full
+            sigagg device path runs."""
+            if not secrets_by_ordinal:
+                validators = self.cluster.beacon.validators
+                for root_pk, secret in node.keys.my_share_secrets.items():
+                    ordinal = validators[bytes.fromhex(root_pk[2:])].index
+                    secrets_by_ordinal[ordinal] = (root_pk, secret)
+            head = hashlib.sha256(f"head:{slot}".encode()).digest()
+            msgs = []
+            for ordinal in signers:
+                entry = secrets_by_ordinal.get(ordinal)
+                if entry is None:
+                    continue
+                _root_pk, secret = entry
+                unsigned = spec.SyncCommitteeMessage(slot, head, ordinal,
+                                                     b"\x00" * 96)
+                root = SignedSyncMessage(unsigned).signing_root(self.chain)
+                msgs.append(spec.SyncCommitteeMessage(
+                    slot, head, ordinal, bytes(tbls.sign(secret, root))))
+            if msgs:
+                await node.vapi.submit_sync_committee_messages(msgs)
+
+        async def guarded(name: str, coro) -> None:
+            try:
+                await coro
+            except Exception:  # noqa: BLE001 — peers are lenient VCs
+                self.stats[f"peer_{name}_error"] += 1
+
+        async def on_slot(slot_obj) -> None:
+            plan = self.mix.plan(slot_obj.slot)
+            jobs = []
+            if slot_obj.first_in_epoch and self.cfg.selection_storm:
+                # Selections FIRST: node 0's VCs block on the cluster-
+                # combined proofs, so peer partials are the critical path.
+                jobs.append(guarded("selection", asyncio.wait_for(
+                    node.vmock.prepare_aggregation(slot_obj.slot),
+                    timeout=budget)))
+            jobs += [
+                guarded("attest", node.vmock.attest(
+                    slot_obj.slot, validator_indices=plan.attesters)),
+                guarded("sync", sync_partials(slot_obj.slot,
+                                              plan.sync_signers)),
+                guarded("propose", node.vmock.propose(slot_obj.slot)),
+            ]
+            await asyncio.gather(*jobs)
+
+        return on_slot
+
+    def _build_fleet(self) -> None:
+        """Split node 0's share keystores across the VC fleet, ordinals
+        assigned round-robin so every VC owns ~num_validators/num_vcs."""
+        cfg = self.cfg
+        node0 = self.cluster.nodes[0]
+        validators = self.cluster.beacon.validators  # pubkey bytes -> record
+        per_vc_secrets: list[dict[bytes, tbls.PrivateKey]] = [
+            {} for _ in range(cfg.num_vcs)]
+        per_vc_ordinals: list[dict[bytes, int]] = [
+            {} for _ in range(cfg.num_vcs)]
+        ordinal_roots: list[tuple[int, PubKey]] = []
+        for root_pk, secret in node0.keys.my_share_secrets.items():
+            root_bytes = bytes.fromhex(root_pk[2:])
+            ordinal = validators[root_bytes].index
+            share_pk = bytes(tbls.secret_to_public_key(secret))
+            per_vc_secrets[ordinal % cfg.num_vcs][share_pk] = secret
+            per_vc_ordinals[ordinal % cfg.num_vcs][share_pk] = ordinal
+            ordinal_roots.append((ordinal, root_pk))
+        ordinal_roots.sort()
+        self._ordinal_roots = [pk for _, pk in ordinal_roots]
+        self.vcs = [
+            SimVC(i, self.router.base_url, per_vc_secrets[i],
+                  per_vc_ordinals[i], self.chain, self.stats,
+                  timeout=cfg.vc_timeout)
+            for i in range(cfg.num_vcs) if per_vc_secrets[i]]
+
+    async def _fire_storm(self, slot: int) -> None:
+        """Broadcast the synthetic peer partial-sig storm for this slot.
+        Targets slot + one epoch so storm roots never collide with live
+        duty roots (equivocation guard in parsigdb)."""
+        cfg = self.cfg
+        if cfg.storm_validators <= 0 or self.cluster.parsig_transport is None:
+            return
+        storm_slot = slot + cfg.slots_per_epoch
+        roots = self._ordinal_roots[:cfg.storm_validators]
+        batches = await asyncio.to_thread(
+            make_parsig_storm, self.cluster, self.chain, storm_slot, roots)
+        for from_idx, duty, parsigs in batches:
+            await self.cluster.parsig_transport.broadcast(
+                from_idx, duty, parsigs)
+            self.stats["storm_partials_sent"] += len(parsigs)
+
+    async def run(self) -> ServingReport:
+        """Drive `cfg.slots` slots of traffic on the chain's own clock."""
+        cfg, chain = self.cfg, self.chain
+        t_start = time.time()
+        slots_run = 0
+        jobs: list[asyncio.Future] = []
+        for slot in range(cfg.slots):
+            target = chain.genesis_time + slot * chain.seconds_per_slot
+            delay = target - time.time()
+            if delay > 0:
+                await asyncio.sleep(delay)
+            plan = self.mix.plan(slot)
+            _log.debug("loadgen slot", slot=slot, attesters=len(plan.attesters),
+                       selections=len(plan.selections))
+            # Slot work overlaps slot boundaries like a real VC's — duties
+            # that need the next slot's peer partials (selections, block
+            # await) keep running while the next slot's traffic starts.
+            jobs.append(asyncio.ensure_future(self._fire_storm(slot)))
+            jobs += [asyncio.ensure_future(vc.run_slot(plan))
+                     for vc in self.vcs]
+            slots_run += 1
+        # One bounded drain after the last slot: anything still pending two
+        # slot-times later is shed (cancelled) and tallied.
+        done, pending = await asyncio.wait(
+            jobs, timeout=max(2 * chain.seconds_per_slot, 4.0))
+        for p in pending:
+            p.cancel()
+            self.stats["drain_cancelled"] += 1
+        if pending:
+            await asyncio.gather(*pending, return_exceptions=True)
+        for d in done:
+            if not d.cancelled() and d.exception() is not None:
+                self.stats["slot_task_error"] += 1
+                _log.warn("loadgen slot task failed", err=d.exception())
+        elapsed = time.time() - t_start
+        client_requests = sum(v for k, v in self.stats.items()
+                              if k.endswith(".requests"))
+        return ServingReport(
+            elapsed_s=elapsed, slots_run=slots_run, num_vcs=len(self.vcs),
+            num_validators=cfg.num_validators,
+            client_requests=client_requests,
+            achieved_rps=client_requests / elapsed if elapsed > 0 else 0.0,
+            routes=route_stats(), client_tallies=dict(self.stats),
+            bn_connections_used=self.http_mock.connections_used,
+            bn_requests_served=self.http_mock.requests_served)
+
+    async def _stop_step(self, name: str, coro, timeout: float) -> None:
+        try:
+            await asyncio.wait_for(coro, timeout=timeout)
+        except (TimeoutError, asyncio.TimeoutError):
+            self.stats[f"stop_timeout_{name}"] += 1
+            _log.warn("harness stop step timed out", step=name)
+        except Exception as exc:  # noqa: BLE001 — teardown is best-effort
+            _log.warn("harness stop step failed", step=name, err=exc)
+
+    async def stop(self) -> None:
+        # Halt the cluster FIRST: schedulers stop emitting slots, so no new
+        # duty work competes with teardown (peer vmocks otherwise keep
+        # signing forever and starve the loop). Every step is bounded — a
+        # wedged component must not pin the bench/test forever.
+        if self.cluster is not None:
+            await self._stop_step("cluster", self.cluster.stop(), 15.0)
+        for vc in self.vcs:
+            await self._stop_step("vc", vc.close(), 5.0)
+        if self.router is not None:
+            await self._stop_step("router", self.router.stop(), 10.0)
+        if self.http_mock is not None:
+            await self._stop_step("beaconmock", self.http_mock.stop(), 10.0)
+        if self.bn_client is not None:
+            await self._stop_step("bn_client", self.bn_client.close(), 5.0)

@@ -44,6 +44,9 @@ class SimNode:
     consensus: object = None
     tcp_node: object = None
     fetch: object = None  # fetcher.Fetcher (builder-gate access for tests)
+    # the node's cross-duty batching window (serving harnesses wire it into
+    # a VapiRouter so backpressure 503s reflect THIS node's device backlog)
+    coalescer: coalesce_mod.TblsCoalescer | None = None
     tasks: list[asyncio.Task] = field(default_factory=list)
 
     async def start(self) -> None:
@@ -71,6 +74,9 @@ class SimCluster:
     beacon: BeaconMock
     nodes: list[SimNode]
     root_secrets: list[tbls.PrivateKey]
+    # the shared parsigex fabric (mem transport only) — serving harnesses
+    # inject synthetic peer partial-signature storms through it
+    parsig_transport: object = None
 
     async def start(self) -> None:
         # TCP fabric first: every node must be listening (ports published to
@@ -92,7 +98,8 @@ def new_simnet(num_validators: int = 2, threshold: int = 3, num_nodes: int = 4,
                verify_peer_partials: bool = True,
                consensus_type: str = "qbft",
                transport: str = "mem",
-               attest_all_every_slot: bool = True) -> SimCluster:
+               attest_all_every_slot: bool = True,
+               node0_beacon_client=None) -> SimCluster:
     """Assemble an n-node in-process cluster sharing one beaconmock.
 
     consensus_type: "qbft" (the production default, like the reference) or
@@ -100,6 +107,10 @@ def new_simnet(num_validators: int = 2, threshold: int = 3, num_nodes: int = 4,
     transport: "mem" (in-memory fabrics) or "tcp" (real sockets — the
     reference's simnet likewise runs over real TCP libp2p,
     testutil/integration/simnet_test.go).
+    node0_beacon_client: optional BeaconNode-shaped client wired into node
+    0's components INSTEAD of the in-memory mock (serving harnesses pass an
+    eth2.http_beacon.HTTPBeaconNode pointed at an HTTPBeaconMock over the
+    same BeaconMock, so node 0's whole BN surface crosses real HTTP).
     """
     root_secrets, node_keys = new_cluster_for_t(num_validators, threshold, num_nodes)
     root_pubkey_bytes = [
@@ -144,23 +155,32 @@ def new_simnet(num_validators: int = 2, threshold: int = 3, num_nodes: int = 4,
                            parsig_transports[i], num_nodes, use_vmock,
                            verify_peer_partials, consensus_type,
                            consensus_endpoints[i], identity_keys[i],
-                           identity_pubkeys)
+                           identity_pubkeys,
+                           beacon_client=(node0_beacon_client
+                                          if i == 0 else None))
         node.tcp_node = tcp_nodes[i]
         nodes.append(node)
-    return SimCluster(beacon, nodes, root_secrets)
+    return SimCluster(beacon, nodes, root_secrets,
+                      parsig_transport=(parsig_transports[0]
+                                        if transport == "mem" else None))
 
 
 def _build_node(idx: int, keys: KeyShares, beacon: BeaconMock, chain,
                 lcast_transport, parsig_transport, num_nodes: int,
                 use_vmock: bool, verify_peer_partials: bool,
                 consensus_type: str, consensus_endpoint, identity_key: bytes,
-                identity_pubkeys: dict[int, bytes]) -> SimNode:
+                identity_pubkeys: dict[int, bytes],
+                beacon_client=None) -> SimNode:
     """The reference's wireCoreWorkflow (app/app.go:333-527) in miniature."""
     deadline_fn = new_duty_deadline_func(chain)
-    valcache = ValidatorCache(beacon, list(beacon.validators))
+    # the node's BN surface: the in-memory mock, or an injected client
+    # (HTTP in serving harnesses); the validator SET still comes from the
+    # mock — it owns the chain either way
+    bn = beacon_client if beacon_client is not None else beacon
+    valcache = ValidatorCache(bn, list(beacon.validators))
 
-    sched = scheduler.Scheduler(beacon, valcache)
-    fetch = fetcher.Fetcher(beacon)
+    sched = scheduler.Scheduler(bn, valcache)
+    fetch = fetcher.Fetcher(bn)
     duty_db = dutydb.MemDB(Deadliner(deadline_fn))
     aggsig_db = aggsigdb.MemDB(Deadliner(deadline_fn))
     parsig_db = parsigdb.MemDB(keys.threshold, Deadliner(deadline_fn))
@@ -173,7 +193,7 @@ def _build_node(idx: int, keys: KeyShares, beacon: BeaconMock, chain,
         consensus = leadercast.LeaderCast(lcast_transport, idx, num_nodes)
     else:
         raise ValueError(f"unknown consensus type {consensus_type!r}")
-    vapi = validatorapi.Component(beacon, duty_db, aggsig_db, keys, chain)
+    vapi = validatorapi.Component(bn, duty_db, aggsig_db, keys, chain)
     # the same cross-duty batching window production wiring uses
     # (app/app.py assemble) — simnet pipelines continuously exercise it
     coalescer = coalesce_mod.TblsCoalescer(window=0.005)
@@ -183,7 +203,7 @@ def _build_node(idx: int, keys: KeyShares, beacon: BeaconMock, chain,
     psigex = parsigex.ParSigEx(parsig_transport, idx,
                                new_duty_gater(chain), verify_set)
     agg = sigagg.SigAgg(keys, chain, coalescer=coalescer)
-    caster = bcast.Broadcaster(beacon, chain)
+    caster = bcast.Broadcaster(bn, chain)
 
     fetch.register_agg_sig_db(aggsig_db.await_)
     fetch.register_await_attestation_data(duty_db.await_attestation)
@@ -203,4 +223,5 @@ def _build_node(idx: int, keys: KeyShares, beacon: BeaconMock, chain,
         sched.subscribe_slots(vmock.on_slot)
 
     return SimNode(idx, keys, sched, vapi, vmock, duty_db, parsig_db,
-                   aggsig_db, retryer, consensus, fetch=fetch)
+                   aggsig_db, retryer, consensus, fetch=fetch,
+                   coalescer=coalescer)

@@ -48,15 +48,22 @@ class ValidatorMock:
         except Exception as exc:  # noqa: BLE001
             _log.warn("vmock propose failed", err=exc, slot=slot_obj.slot)
 
-    async def attest(self, slot: int) -> None:
+    async def attest(self, slot: int,
+                     validator_indices: "set[int] | frozenset[int] | None" = None) -> None:
         """Fetch duties, sign attestations with share keys, submit
-        (reference validatormock/attest.go:30)."""
+        (reference validatormock/attest.go:30). `validator_indices`
+        restricts to a subset — load harnesses thin the beaconmock's
+        attest-all density back to the mainnet one-attestation-per-epoch
+        rate (testutil/loadgen.DutyMix)."""
         epoch = self._chain.epoch_of(slot)
         share_pks = list(self._share_pks)
         duties = await self._vapi.attester_duties(epoch, share_pks)
         atts = []
         for duty in duties:
             if duty.slot != slot:
+                continue
+            if (validator_indices is not None
+                    and duty.validator_index not in validator_indices):
                 continue
             data = await self._vapi.attestation_data(slot, duty.committee_index)
             bits = [False] * duty.committee_length

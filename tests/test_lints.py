@@ -763,3 +763,56 @@ def test_checked_in_baseline_is_normalized():
     assert keys == sorted(keys)
     assert all(isinstance(v, int) and v > 0 for v in raw["findings"].values())
     assert DEFAULT_BASELINE.read_text().endswith("}\n")
+
+
+# ---------------------------------------------------------------------------
+# LINT-VAPI-010 — vapi_router body ingestion through _strict_body
+# ---------------------------------------------------------------------------
+
+
+def test_vapi_rule_flags_direct_body_reads(tmp_path):
+    findings = lint_source(tmp_path, "core/vapi_router.py", """\
+        async def _submit_things(self, request):
+            body = await request.json()
+            return body
+
+        async def _other(self, request):
+            raw = await request.read()
+            txt = await request.text()
+            return raw, txt
+    """)
+    assert rules_of(findings) == ["LINT-VAPI-010"] * 3
+    assert "_submit_things" in findings[0].message
+    assert "_strict_body" in findings[0].message
+    assert findings[0].line == 2
+
+
+def test_vapi_rule_allows_strict_body_and_proxy(tmp_path):
+    findings = lint_source(tmp_path, "core/vapi_router.py", """\
+        async def _strict_body(self, request, shape="list"):
+            return await request.read()
+
+        async def _proxy(self, request):
+            return await request.read()
+
+        async def _handler(self, request):
+            return await self._strict_body(request)
+    """)
+    assert findings == []
+
+
+def test_vapi_rule_scopes_to_vapi_router_files(tmp_path):
+    findings = lint_source(tmp_path, "core/other.py", """\
+        async def _handler(self, request):
+            return await request.json()
+    """)
+    assert findings == []
+
+
+def test_vapi_rule_ignores_non_request_receivers(tmp_path):
+    findings = lint_source(tmp_path, "core/vapi_router.py", """\
+        async def _handler(self, resp, f):
+            data = await resp.json()
+            return f.read()
+    """)
+    assert findings == []

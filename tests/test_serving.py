@@ -1,0 +1,57 @@
+"""End-to-end serving benchmark smoke (bench_vapi --smoke): the full
+harness — VC fleet over HTTP, peer nodes, parsigex storm, slot clock —
+must complete and emit the JSON tail with per-route latency quantiles.
+Marked slow: spins a whole cluster plus an HTTP beacon mock for several
+real-time slots."""
+
+import json
+import os
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+@pytest.mark.slow
+class TestBenchVapiSmoke:
+    def test_smoke_run_emits_route_quantiles(self):
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        proc = subprocess.run(
+            [sys.executable, str(REPO / "bench_vapi.py"), "--smoke"],
+            capture_output=True, text=True, timeout=300, env=env,
+            cwd=str(REPO))
+        assert proc.returncode == 0, (
+            f"bench_vapi --smoke failed:\n{proc.stderr[-4000:]}")
+        # output idiom: diagnostics on stderr, ONE JSON line on stdout
+        lines = [ln for ln in proc.stdout.splitlines() if ln.strip()]
+        assert lines, "no stdout from bench_vapi"
+        tail = json.loads(lines[-1])
+
+        assert tail["metric"] == "vapi serving harness"
+        assert tail["slots_run"] >= 1
+        assert tail["client_requests"] > 0
+        assert tail["achieved_rps"] > 0
+
+        # per-route latency quantiles and error rates are the acceptance
+        # surface: every observed route reports p50 <= p99 and a rate
+        routes = tail["routes"]
+        assert routes, "no routes recorded"
+        for route, stats in routes.items():
+            assert stats["count"] > 0, route
+            assert stats["p50"] <= stats["p99"], route
+            assert 0.0 <= stats["error_rate"] <= 1.0, route
+        # the mixed duty shape reached the wire: duties + at least one
+        # signed-duty ingest route
+        assert any("/duties/" in r for r in routes)
+        assert any(r.startswith("POST /eth/v1/beacon/pool/") for r in routes)
+
+        # keep-alive accounting from the beacon mock rode along
+        assert tail["bn_requests_served"] > tail["bn_connections_used"]
+
+        # VC-side tallies: the storm fired and clients saw successes
+        tallies = tail["client_tallies"]
+        assert tallies.get("storm_partials_sent", 0) > 0
+        assert any(k.endswith(".ok") for k in tallies)

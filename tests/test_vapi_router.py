@@ -535,3 +535,115 @@ class TestErrorMapping:
                 await router.stop()
 
         _run(run())
+
+
+class TestStrictBody:
+    """ISSUE 7 strict-body audit: every intercepted POST route ingests its
+    body through the ONE shared `_strict_body` helper (LINT-VAPI-010), so
+    a scalar where a container belongs is a uniform 400 — never a handler
+    iterating a string character-by-character into a 500 — and over-limit
+    bodies are a 413 before any parse work."""
+
+    # every intercepted POST route and the body shape it requires
+    LIST_ROUTES = [
+        "/eth/v1/validator/duties/attester/0",
+        "/eth/v1/validator/duties/sync/0",
+        "/eth/v1/beacon/pool/attestations",
+        "/eth/v1/validator/aggregate_and_proofs",
+        "/eth/v1/beacon/pool/sync_committees",
+        "/eth/v1/validator/contribution_and_proofs",
+        "/eth/v1/validator/beacon_committee_selections",
+        "/eth/v1/validator/sync_committee_selections",
+        "/eth/v1/validator/register_validator",
+        "/eth/v1/validator/prepare_beacon_proposer",
+    ]
+    OBJECT_ROUTES = [
+        "/eth/v1/beacon/blocks",
+        "/eth/v2/beacon/blocks",
+        "/eth/v1/beacon/blinded_blocks",
+        "/eth/v1/beacon/pool/voluntary_exits",
+    ]
+
+    @staticmethod
+    async def _one_router(**kw):
+        import sys
+        sys.path.insert(0, __file__.rsplit("/", 1)[0])
+        from test_validatorapi import Harness
+
+        h = Harness()
+        router = VapiRouter(h.comp, **kw)
+        await router.start()
+        return h, router
+
+    def test_scalar_bodies_are_400_everywhere(self):
+        from aiohttp import ClientSession
+
+        async def run():
+            h, router = await self._one_router()
+            try:
+                async with ClientSession() as s:
+                    for path in self.LIST_ROUTES + self.OBJECT_ROUTES:
+                        for raw in (b"5", b'"str"', b"true"):
+                            resp = await s.post(router.base_url + path,
+                                                data=raw)
+                            assert resp.status == 400, (
+                                f"POST {path} body={raw!r}: {resp.status}")
+                            obj = await resp.json()
+                            assert obj["code"] == 400 and obj["message"]
+                    # wrong container kind is rejected the same way
+                    for path in self.LIST_ROUTES:
+                        resp = await s.post(router.base_url + path,
+                                            data=b"{}")
+                        assert resp.status == 400, f"POST {path} body={{}}"
+                    for path in self.OBJECT_ROUTES:
+                        resp = await s.post(router.base_url + path,
+                                            data=b"[]")
+                        assert resp.status == 400, f"POST {path} body=[]"
+            finally:
+                await router.stop()
+
+        _run(run())
+
+    def test_oversize_body_is_413(self):
+        from aiohttp import ClientSession
+
+        async def run():
+            h, router = await self._one_router(max_body_bytes=1024)
+            try:
+                async with ClientSession() as s:
+                    big = b"[" + b'"deadbeef",' * 4096 + b'"00"]'
+                    resp = await s.post(
+                        router.base_url + "/eth/v1/beacon/pool/attestations",
+                        data=big)
+                    assert resp.status == 413, resp.status
+            finally:
+                await router.stop()
+
+        _run(run())
+
+    def test_route_latency_quantiles_readable(self):
+        """vapi_route_latency_seconds{route,method} lands in the default
+        registry with the route PATTERN (not the concrete URL) and its
+        quantiles are readable (ISSUE 7 acceptance)."""
+        from aiohttp import ClientSession
+
+        from charon_tpu.utils import metrics as m
+
+        async def run():
+            h, router = await self._one_router()
+            try:
+                async with ClientSession() as s:
+                    for _ in range(3):
+                        resp = await s.get(
+                            router.base_url + "/eth/v1/node/version")
+                        assert resp.status == 200
+                hist = m.default_registry.gather()[
+                    "vapi_route_latency_seconds"]
+                q = hist.quantile(0.5, "/eth/v1/node/version", "GET")
+                assert q is not None and q >= 0
+                gauge = m.default_registry.gather()["vapi_inflight_requests"]
+                assert gauge.value() == 0  # all requests finished
+            finally:
+                await router.stop()
+
+        _run(run())
