@@ -100,6 +100,18 @@ class MetricWindow:
     def gauge_sum(self, name: str) -> float:
         return sum(v for (mname, _k), v in self.gauges.items() if mname == name)
 
+    def gauge_delta(self, name: str) -> float:
+        """Gauge movement over the buffered window (newest sum minus
+        oldest sum; can be negative). Progress rules use it: a gauge
+        that tracks a position (e.g. dkg_ceremony_state) standing still
+        across the whole window means no forward progress."""
+        if not self._snaps:
+            return 0.0
+        newest, oldest = self._snaps[-1][1], self._snaps[0][1]
+        new_sum = sum(v for (mname, _k), v in newest.items() if mname == name)
+        old_sum = sum(v for (mname, _k), v in oldest.items() if mname == name)
+        return new_sum - old_sum
+
     def gauge_values(self, name: str) -> list[float]:
         return [v for (mname, _k), v in self.gauges.items() if mname == name]
 
@@ -161,6 +173,15 @@ def default_checks(quorum_peers: int,
               lambda w: (w.counter_delta("vapi_requests_total") >= 20
                          and w.counter_delta("vapi_request_errors_total")
                          > 0.05 * w.counter_delta("vapi_requests_total"))),
+        Check("dkg_ceremony_stalled",
+              "a DKG ceremony is stuck: the node is mid-ceremony "
+              "(dkg_ceremony_state > 0), its step has not advanced across "
+              "the window, and rounds are burning retries "
+              "(dkg_round_retries_total moving) — peers are unreachable "
+              "or a barrier keeps timing out (docs/robustness.md)",
+              lambda w: (w.gauge_sum("dkg_ceremony_state") > 0
+                         and w.gauge_delta("dkg_ceremony_state") <= 0
+                         and w.counter_delta("dkg_round_retries_total") > 0)),
         Check("high_error_log_rate", "more than 5 error logs in the window",
               lambda w: w.counter_delta("log_messages_total", "error") > 5),
         Check("high_warning_log_rate", "more than 10 warning logs in the window",

@@ -1,7 +1,16 @@
 """Signed reliable broadcast for DKG messages (reference dkg/bcast/
 {client,server,impl}.go, protocol /charon/dkg/bcast/1.0.0): the sender
 k1-signs every message; receivers verify against the cluster identity before
-accepting. Messages are collected per topic for the ceremony phases."""
+accepting. Messages are collected per topic for the ceremony phases.
+
+Churn recovery: a node that was down when a peer broadcast misses that
+message forever under fire-and-forget delivery, so `gather` also PULLS —
+each poll tick it fetches missing senders' own messages over the fetch
+protocol. Only a sender's OWN signed message is ever fetched from that
+sender, so the transport-binding check (claimed == transport index)
+holds on the pulled path exactly as on the pushed one, and the pulled
+wire message re-enters `_handle` for full signature/equivocation
+verification."""
 
 from __future__ import annotations
 
@@ -16,10 +25,18 @@ from ..utils import errors, k1util, log
 _log = log.with_topic("dkg-bcast")
 
 PROTOCOL = "/charon/dkg/bcast/1.0.0"
+FETCH_PROTOCOL = "/charon/dkg/bcast/fetch/1.0.0"
 
 
 def _digest(topic: str, payload: bytes) -> bytes:
     return hashlib.sha256(b"charon-tpu/dkg-bcast" + topic.encode() + b"\x00" + payload).digest()
+
+
+class GatherTimeout(errors.CharonError, TimeoutError):
+    """gather() deadline expired short of `count` senders. Subclasses
+    TimeoutError so the guard taxonomy classifies it "timeout" and the
+    ceremony round wrapper re-enters the round (broadcast re-delivery is
+    idempotent) instead of aborting the ceremony."""
 
 
 class SignedBroadcast:
@@ -32,7 +49,10 @@ class SignedBroadcast:
         # topic -> sender idx -> payload
         self._received: dict[str, dict[int, bytes]] = defaultdict(dict)
         self._events: dict[str, asyncio.Event] = defaultdict(asyncio.Event)
+        # topic -> our own full signed wire message, served to fetchers
+        self._sent: dict[str, bytes] = {}
         node.register_handler(PROTOCOL, self._handle)
+        node.register_handler(FETCH_PROTOCOL, self._handle_fetch)
 
     async def _handle(self, sender_idx: int, raw: bytes) -> None:
         msg = json.loads(raw.decode())
@@ -56,25 +76,55 @@ class SignedBroadcast:
         self._events[topic] = asyncio.Event()
         return None
 
+    async def _handle_fetch(self, sender_idx: int, raw: bytes) -> bytes:
+        """Serve our own signed message for a topic (b"" when we have not
+        broadcast on it yet — the fetcher just retries next tick)."""
+        topic = json.loads(raw.decode())["topic"]
+        return self._sent.get(topic, b"")
+
     def broadcast(self, topic: str, payload: bytes) -> None:
         """Sign + send to all peers, and record our own contribution."""
         sig = k1util.sign(self._privkey, _digest(topic, payload))
         msg = json.dumps({"topic": topic, "payload": payload.hex(),
                           "sender": self._own_idx, "sig": sig.hex()}).encode()
         self._received[topic][self._own_idx] = payload
+        self._sent[topic] = msg
         self._node.broadcast(PROTOCOL, msg)
 
+    async def _fetch_missing(self, topic: str) -> None:
+        """Pull senders we have not heard on `topic` (their push may have
+        fired while we were down). Best-effort: a peer that is itself
+        down or has nothing yet is retried on the next gather tick."""
+        req = json.dumps({"topic": topic}).encode()
+        for idx in self._node.peers:
+            if idx in self._received[topic]:
+                continue
+            try:
+                resp = await self._node.send_receive(
+                    idx, FETCH_PROTOCOL, req, timeout=5.0)
+            except Exception as exc:  # noqa: BLE001 — peer down; next tick
+                _log.debug("dkg bcast fetch failed; will retry",
+                           topic=topic, peer=idx, err=exc)
+                continue
+            if resp:
+                # full verification: signature, sender binding, equivocation
+                await self._handle(idx, resp)
+
     async def gather(self, topic: str, count: int, timeout: float = 120.0) -> dict[int, bytes]:
-        """Await `count` distinct senders' messages on a topic."""
+        """Await `count` distinct senders' messages on a topic, pulling
+        missed broadcasts from their senders on each poll tick."""
         deadline = asyncio.get_running_loop().time() + timeout
         while len(self._received[topic]) < count:
             remaining = deadline - asyncio.get_running_loop().time()
             if remaining <= 0:
-                raise errors.new("dkg broadcast gather timeout", topic=topic,
-                                 got=len(self._received[topic]), want=count)
+                raise GatherTimeout("dkg broadcast gather timeout",
+                                    topic=topic,
+                                    got=len(self._received[topic]),
+                                    want=count)
             event = self._events[topic]
             try:
                 await asyncio.wait_for(event.wait(), min(remaining, 1.0))
             except asyncio.TimeoutError:
+                await self._fetch_missing(topic)
                 continue
         return dict(self._received[topic])

@@ -1,0 +1,95 @@
+"""Round-keyed ceremony checkpointing for resumable DKG.
+
+A DKG ceremony is a sequence of rounds fenced by sync barriers. A node
+that crashes mid-round used to abort the whole ceremony for everyone —
+every peer blocks at the next barrier until its timeout. With a
+checkpoint file in the node's data dir, a restarted node re-joins at
+the last completed round instead:
+
+  * `frost_round1` is written **before** any round-1 transmission
+    (write-ahead): it persists the secret polynomial coefficients and
+    PoK nonces, so a resumed node re-derives bit-identical round-1
+    broadcasts and shares. That matters — peers that already hold our
+    first broadcast would flag a *fresh* random polynomial as
+    equivocation; replaying the identical one is an idempotent
+    re-delivery.
+  * `keygen` / `deposit` are written **after** their barrier: every
+    peer already holds our broadcasts for the round, so a resumed node
+    skips straight past it without re-broadcasting anything.
+  * The lock-sig and node-sig rounds need no checkpoint: BLS
+    (`tbls.sign`) and RFC6979 k1 signing are deterministic, so a resumed
+    node re-broadcasts byte-identical signatures and re-delivery is
+    idempotent.
+
+The file is keyed on the cluster definition hash — a checkpoint from a
+different ceremony is discarded, never resumed into. Writes are atomic
+(tmp + rename) and 0600 like the other ceremony artifacts; `clear()`
+removes the file once the final artifacts are on disk.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from pathlib import Path
+
+from ..utils import log
+
+_log = log.with_topic("dkg-ckpt")
+
+VERSION = 1
+FILENAME = "dkg-checkpoint.json"
+
+
+class CeremonyCheckpoint:
+    """Load-or-create the per-node checkpoint for one ceremony."""
+
+    def __init__(self, data_dir: Path | str, def_hash: bytes):
+        self._path = Path(data_dir) / FILENAME
+        self._def_hash = def_hash.hex()
+        self._rounds: dict[str, dict] = {}
+        #: True when a prior run's checkpoint for THIS ceremony was found
+        #: — the node is resuming, not starting fresh.
+        self.resumed = False
+        self._load()
+
+    def _load(self) -> None:
+        try:
+            raw = json.loads(self._path.read_text())
+        except (OSError, ValueError):
+            return
+        if (raw.get("version") != VERSION
+                or raw.get("def_hash") != self._def_hash):
+            _log.info("discarding checkpoint from a different ceremony",
+                      path=str(self._path))
+            return
+        rounds = raw.get("rounds")
+        if isinstance(rounds, dict):
+            self._rounds = rounds
+            self.resumed = bool(rounds)
+            if self.resumed:
+                _log.info("resuming ceremony from checkpoint",
+                          rounds=sorted(rounds))
+
+    def get(self, round_name: str) -> dict | None:
+        """The persisted payload for a completed round, or None."""
+        return self._rounds.get(round_name)
+
+    def put(self, round_name: str, payload: dict) -> None:
+        """Persist a round's payload atomically before returning."""
+        self._rounds[round_name] = payload
+        blob = json.dumps({"version": VERSION, "def_hash": self._def_hash,
+                           "rounds": self._rounds})
+        self._path.parent.mkdir(parents=True, exist_ok=True)
+        tmp = self._path.with_suffix(".tmp")
+        tmp.write_text(blob)
+        os.chmod(tmp, 0o600)
+        os.replace(tmp, self._path)
+
+    def clear(self) -> None:
+        """Ceremony complete — the artifacts on disk supersede this."""
+        self._rounds = {}
+        try:
+            self._path.unlink()
+        except OSError:
+            pass

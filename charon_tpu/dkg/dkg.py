@@ -11,7 +11,23 @@ Step-fenced by the sync protocol (each numbered step is a barrier):
 
 The ceremony rides the real p2p fabric (authenticated-encrypted TCP
 channels); FROST round-1 commitments/PoKs go over the signed broadcast,
-secret shares over direct channels (protocol /charon/dkg/frost/2.0.0)."""
+secret shares over direct channels (protocol /charon/dkg/frost/2.0.0).
+
+Resilience model: every step is a ROUND run under `_run_round` — a
+bounded-retry wrapper that classifies failures with the guard taxonomy
+(deterministic "input"/"error" failures abort; "timeout"/"device_lost"
+and temporary network errors re-enter the round under jittered backoff,
+counted in `dkg_round_retries_total{round}`). Rounds are idempotent to
+re-entry because (a) broadcast/share re-delivery is idempotent (bcast
+equivocation checks pass on identical payloads; BLS and RFC6979 k1
+signing are deterministic) and (b) the round-keyed
+`checkpoint.CeremonyCheckpoint` write-aheads the one piece of ceremony
+randomness — the FROST round-1 polynomials/nonces — so a node that
+crashes outright and is restarted with the same data_dir re-joins at
+the last completed round with bit-identical messages instead of
+aborting the ceremony. `dkg_ceremony_state` tracks the current step
+(0 when no ceremony is running) for the `dkg_ceremony_stalled` health
+rule."""
 
 from __future__ import annotations
 
@@ -19,6 +35,7 @@ import asyncio
 import json
 from dataclasses import dataclass, field as dc_field
 from pathlib import Path
+from typing import Awaitable, Callable
 
 from .. import tbls
 from ..cluster import Lock
@@ -27,22 +44,43 @@ from ..cluster.lock import DistValidator
 from ..eth2 import deposit as deposit_mod
 from ..eth2 import enr as enr_mod
 from ..eth2 import keystore
+from ..ops import guard
 from ..p2p.node import PeerSpec, TCPNode
-from ..utils import errors, k1util, log
+from ..utils import errors, expbackoff, faults, k1util, log, metrics, retry
 from . import frost as frost_mod
 from . import keycast as keycast_mod
-from .bcast import SignedBroadcast
+from .bcast import GatherTimeout, SignedBroadcast
+from .checkpoint import CeremonyCheckpoint
 from .sync import SyncProtocol
 
 _log = log.with_topic("dkg")
 
 PROTO_FROST = "/charon/dkg/frost/2.0.0"
+PROTO_FROST_FETCH = "/charon/dkg/frost/fetch/2.0.0"
 
 STEP_CONNECTED = 1
 STEP_KEYGEN = 2
 STEP_DEPOSIT = 3
 STEP_LOCK_SIG = 4
 STEP_NODE_SIG = 5
+
+# Per-round retry budget: a round re-enters on environment-class
+# failures (barrier/gather timeouts, dropped peers, device loss) under
+# this backoff; deterministic failures (bad signature, equivocation)
+# never retry.
+ROUND_RETRIES = 3
+ROUND_BACKOFF = expbackoff.Config(
+    base=0.2, multiplier=2.0, jitter=0.1, max_delay=5.0)
+
+_retries_c = metrics.counter(
+    "dkg_round_retries_total",
+    "Ceremony round re-entries after a retryable (environment-class) "
+    "failure, by round name",
+    ("round",))
+_state_g = metrics.gauge(
+    "dkg_ceremony_state",
+    "Ceremony step the node is currently working (1 connect .. 5 "
+    "node-sig per dkg.STEP_*); 0 when no ceremony is in flight")
 
 
 @dataclass
@@ -54,6 +92,10 @@ class Config:
     data_dir: str | Path
     insecure_keystores: bool = False
     timeout: float = 180.0
+    # test/chaos seam: awaited at named ceremony points ("round:<name>"
+    # at each round attempt, "keygen:sent" after round-1 transmission) —
+    # a hook that raises simulates a crash at exactly that point
+    chaos_hook: Callable[[str], Awaitable[None]] | None = None
 
 
 @dataclass
@@ -68,18 +110,65 @@ class _FrostShares:
         self.event.set()
         self.event = asyncio.Event()
 
-    async def await_count(self, num_validators: int, count: int, timeout: float) -> None:
+    async def await_count(self, num_validators: int, count: int,
+                          timeout: float, on_stall=None) -> None:
+        """Await `count` senders' shares for every validator. `on_stall`
+        (async) runs on each poll tick that made no progress — the
+        resume path uses it to PULL shares whose push we missed while
+        down (see _run_frost's fetch responder)."""
         deadline = asyncio.get_running_loop().time() + timeout
         while True:
             if all(len(self.shares.get(v, {})) >= count for v in range(num_validators)):
                 return
             remaining = deadline - asyncio.get_running_loop().time()
             if remaining <= 0:
-                raise errors.new("timeout awaiting frost shares")
+                raise GatherTimeout("timeout awaiting frost shares")
             try:
                 await asyncio.wait_for(self.event.wait(), min(remaining, 1.0))
             except asyncio.TimeoutError:
+                if on_stall is not None:
+                    await on_stall()
                 continue
+
+
+async def _run_round(round_name: str, step: int, fn,
+                     chaos_hook=None):
+    """Run one ceremony round with bounded retry.
+
+    Sets `dkg_ceremony_state` to the round's step for the duration (it
+    stays at the failing step on abort — that frozen-gauge-plus-moving-
+    retry-counter shape is what the dkg_ceremony_stalled health rule
+    keys on). A failure is re-entered at most ROUND_RETRIES times iff
+    the guard taxonomy calls it environment-class ("timeout" /
+    "device_lost", or a temporary network error); deterministic
+    failures — bad signatures, equivocation, input errors — abort
+    immediately, and CancelledError always propagates. Rounds INCLUDE
+    their trailing barrier, so a retry re-enters the barrier too and a
+    peer that re-joined late is swept up by the re-entry."""
+    _state_g.set(float(step))
+    backoff = expbackoff.Backoff(ROUND_BACKOFF)
+    attempt = 0
+    while True:
+        try:
+            faults.check("dkg.round")
+            if chaos_hook is not None:
+                await chaos_hook(f"round:{round_name}")
+            return await fn()
+        except Exception as exc:
+            reason = guard.classify(exc)
+            retryable = reason != "input" and (
+                retry.is_temporary(exc)
+                or reason in ("timeout", "device_lost"))
+            attempt += 1
+            if not retryable or attempt > ROUND_RETRIES:
+                _log.error("dkg round failed; aborting ceremony",
+                           round=round_name, err=exc, reason=reason,
+                           attempts=attempt)
+                raise
+            _retries_c.inc(round_name)
+            _log.warn("dkg round failed; re-entering", round=round_name,
+                      err=exc, reason=reason, attempt=attempt)
+            await backoff.wait()
 
 
 async def run_dkg(config: Config) -> Lock:
@@ -115,38 +204,82 @@ async def run_dkg(config: Config) -> Lock:
     keycast_receiver = None
     if definition.dkg_algorithm == "keycast" and my_idx != 0:
         keycast_receiver = keycast_mod.Receiver(node)
+    ckpt = CeremonyCheckpoint(config.data_dir, def_hash)
     await node.start()
 
     try:
         # step 1: everyone connected, same definition
-        await sync.await_all_connected(timeout=config.timeout)
-        await sync.await_all_at_step(STEP_CONNECTED, timeout=config.timeout)
+        async def _round_connect():
+            await sync.await_all_connected(timeout=config.timeout)
+            await sync.await_all_at_step(STEP_CONNECTED,
+                                         timeout=config.timeout)
 
-        # step 2: keygen
-        if definition.dkg_algorithm == "keycast":
-            records, share_secrets = await _run_keycast(
-                node, keycast_receiver, my_idx, num_nodes, num_validators,
-                threshold, config)
-            share_pubkeys_all = [
-                [bytes.fromhex(pk) for pk in rec["share_pubkeys"]]
-                for rec in records]
-            group_pubkeys = [bytes.fromhex(rec["pubkey"]) for rec in records]
-        else:  # frost (default)
-            group_pubkeys, share_pubkeys_all, share_secrets = await _run_frost(
-                node, bcast, frost_inbox, my_idx, num_nodes, num_validators,
-                threshold, def_hash, config.timeout)
-        await sync.await_all_at_step(STEP_KEYGEN, timeout=config.timeout)
+        await _run_round("connect", STEP_CONNECTED, _round_connect,
+                         config.chaos_hook)
+
+        # step 2: keygen (checkpointed AFTER the barrier: once every peer
+        # passed it, they all hold our round-1 messages, so a resumed
+        # node can skip the round without re-broadcasting anything)
+        async def _round_keygen():
+            saved = ckpt.get("keygen")
+            if saved is not None:
+                gpks = [bytes.fromhex(h) for h in saved["group_pubkeys"]]
+                spks = [[bytes.fromhex(h) for h in row]
+                        for row in saved["share_pubkeys"]]
+                secrets = [tbls.PrivateKey(bytes.fromhex(h))
+                           for h in saved["share_secrets"]]
+            elif definition.dkg_algorithm == "keycast":
+                records, secrets = await _run_keycast(
+                    node, keycast_receiver, my_idx, num_nodes,
+                    num_validators, threshold, config)
+                spks = [[bytes.fromhex(pk) for pk in rec["share_pubkeys"]]
+                        for rec in records]
+                gpks = [bytes.fromhex(rec["pubkey"]) for rec in records]
+            else:  # frost (default)
+                gpks, spks, secrets = await _run_frost(
+                    node, bcast, frost_inbox, my_idx, num_nodes,
+                    num_validators, threshold, def_hash, config.timeout,
+                    ckpt, config.chaos_hook)
+            await sync.await_all_at_step(STEP_KEYGEN,
+                                         timeout=config.timeout)
+            if saved is None:
+                ckpt.put("keygen", {
+                    "group_pubkeys": [g.hex() for g in gpks],
+                    "share_pubkeys": [[p.hex() for p in row]
+                                      for row in spks],
+                    "share_secrets": [bytes(s).hex() for s in secrets]})
+            return gpks, spks, secrets
+
+        group_pubkeys, share_pubkeys_all, share_secrets = await _run_round(
+            "keygen", STEP_KEYGEN, _round_keygen, config.chaos_hook)
 
         # step 3: deposit data (threshold-signed per DV)
         withdrawal = _withdrawal_address20(definition)
-        deposit_sigs = await _threshold_sign_all(
-            bcast, "deposit", my_idx, threshold, share_secrets,
-            [deposit_mod.signing_root(
-                deposit_mod.new_message(tbls.PublicKey(gpk), withdrawal),
-                definition.fork_version)
-             for gpk in group_pubkeys],
-            [tbls.PublicKey(g) for g in group_pubkeys], config.timeout)
-        await sync.await_all_at_step(STEP_DEPOSIT, timeout=config.timeout)
+
+        async def _round_deposit():
+            saved = ckpt.get("deposit")
+            if saved is not None:
+                sigs = [tbls.Signature(bytes.fromhex(h))
+                        for h in saved["sigs"]]
+            else:
+                sigs = await _threshold_sign_all(
+                    bcast, "deposit", my_idx, threshold, share_secrets,
+                    [deposit_mod.signing_root(
+                        deposit_mod.new_message(
+                            tbls.PublicKey(gpk), withdrawal),
+                        definition.fork_version)
+                     for gpk in group_pubkeys],
+                    [tbls.PublicKey(g) for g in group_pubkeys],
+                    config.timeout)
+            await sync.await_all_at_step(STEP_DEPOSIT,
+                                         timeout=config.timeout)
+            if saved is None:
+                ckpt.put("deposit",
+                         {"sigs": [bytes(s).hex() for s in sigs]})
+            return sigs
+
+        deposit_sigs = await _run_round("deposit", STEP_DEPOSIT,
+                                        _round_deposit, config.chaos_hook)
 
         # build the validators + lock
         validators = []
@@ -163,33 +296,54 @@ async def run_dkg(config: Config) -> Lock:
         lock = Lock(definition=definition, validators=validators)
         lock_hash = lock.lock_hash()
 
-        # step 4: every share key signs the lock hash; aggregate all
-        my_lock_sigs = [bytes(tbls.sign(s, lock_hash)) for s in share_secrets]
-        bcast.broadcast("lock-sigs", json.dumps(
-            [s.hex() for s in my_lock_sigs]).encode())
-        all_lock = await bcast.gather("lock-sigs", num_nodes, config.timeout)
-        share_sigs = []
-        for sender in sorted(all_lock):
-            sigs = [bytes.fromhex(s) for s in json.loads(all_lock[sender].decode())]
-            if len(sigs) != num_validators:
-                raise errors.new("lock sig count mismatch", sender=sender)
-            for v, sig in enumerate(sigs):
-                share_pk = tbls.PublicKey(share_pubkeys_all[v][sender])
-                if not tbls.verify(share_pk, lock_hash, tbls.Signature(sig)):
-                    raise errors.new("invalid lock-hash share signature",
-                                     sender=sender, validator=v)
-            share_sigs.extend(sigs)
-        lock.aggregate_share_signatures([tbls.Signature(s) for s in share_sigs])
-        await sync.await_all_at_step(STEP_LOCK_SIG, timeout=config.timeout)
+        # step 4: every share key signs the lock hash; aggregate all.
+        # Not checkpointed: BLS signing is deterministic, so a re-entered
+        # (or resumed) round re-broadcasts byte-identical signatures.
+        async def _round_lock_sig():
+            my_lock_sigs = [bytes(tbls.sign(s, lock_hash))
+                            for s in share_secrets]
+            bcast.broadcast("lock-sigs", json.dumps(
+                [s.hex() for s in my_lock_sigs]).encode())
+            all_lock = await bcast.gather("lock-sigs", num_nodes,
+                                          config.timeout)
+            share_sigs = []
+            for sender in sorted(all_lock):
+                sigs = [bytes.fromhex(s)
+                        for s in json.loads(all_lock[sender].decode())]
+                if len(sigs) != num_validators:
+                    raise errors.new("lock sig count mismatch",
+                                     sender=sender)
+                for v, sig in enumerate(sigs):
+                    share_pk = tbls.PublicKey(share_pubkeys_all[v][sender])
+                    if not tbls.verify(share_pk, lock_hash,
+                                       tbls.Signature(sig)):
+                        raise errors.new("invalid lock-hash share signature",
+                                         sender=sender, validator=v)
+                share_sigs.extend(sigs)
+            lock.aggregate_share_signatures(
+                [tbls.Signature(s) for s in share_sigs])
+            await sync.await_all_at_step(STEP_LOCK_SIG,
+                                         timeout=config.timeout)
 
-        # step 5: k1 node signatures over the lock hash
-        bcast.broadcast("node-sig", k1util.sign(config.identity_key, lock_hash))
-        node_sigs = await bcast.gather("node-sig", num_nodes, config.timeout)
-        lock.node_signatures = [node_sigs[i] for i in range(num_nodes)]
-        for i, sig in enumerate(lock.node_signatures):
-            if not k1util.verify(peer_pubkeys[i], lock_hash, sig):
-                raise errors.new("invalid node signature", index=i)
-        await sync.await_all_at_step(STEP_NODE_SIG, timeout=config.timeout)
+        await _run_round("lock_sig", STEP_LOCK_SIG, _round_lock_sig,
+                         config.chaos_hook)
+
+        # step 5: k1 node signatures over the lock hash (RFC6979 k1
+        # signing is deterministic too — same idempotence as step 4)
+        async def _round_node_sig():
+            bcast.broadcast("node-sig",
+                            k1util.sign(config.identity_key, lock_hash))
+            node_sigs = await bcast.gather("node-sig", num_nodes,
+                                           config.timeout)
+            lock.node_signatures = [node_sigs[i] for i in range(num_nodes)]
+            for i, sig in enumerate(lock.node_signatures):
+                if not k1util.verify(peer_pubkeys[i], lock_hash, sig):
+                    raise errors.new("invalid node signature", index=i)
+            await sync.await_all_at_step(STEP_NODE_SIG,
+                                         timeout=config.timeout)
+
+        await _run_round("node_sig", STEP_NODE_SIG, _round_node_sig,
+                         config.chaos_hook)
 
         lock.verify()
 
@@ -214,8 +368,10 @@ async def run_dkg(config: Config) -> Lock:
             "fork_version": definition.fork_version.hex(),
         } for v in validators]
         (data_dir / "deposit-data.json").write_text(json.dumps(deposits, indent=2))
+        ckpt.clear()  # artifacts on disk supersede the checkpoint
+        _state_g.set(0.0)
         _log.info("dkg ceremony complete", validators=num_validators,
-                  lock_hash=lock_hash.hex()[:16])
+                  lock_hash=lock_hash.hex()[:16], resumed=ckpt.resumed)
         return lock
     finally:
         await node.stop()
@@ -223,7 +379,9 @@ async def run_dkg(config: Config) -> Lock:
 
 async def _run_frost(node: TCPNode, bcast: SignedBroadcast, inbox: _FrostShares,
                      my_idx: int, num_nodes: int, num_validators: int,
-                     threshold: int, def_hash: bytes, timeout: float):
+                     threshold: int, def_hash: bytes, timeout: float,
+                     ckpt: CeremonyCheckpoint | None = None,
+                     chaos_hook=None):
     """All validators' keygens in parallel (reference runFrostParallel
     dkg/frost.go:50)."""
     my_part = my_idx + 1  # 1-based participant index
@@ -231,14 +389,44 @@ async def _run_frost(node: TCPNode, bcast: SignedBroadcast, inbox: _FrostShares,
         frost_mod.Participant(my_part, threshold, num_nodes,
                               def_hash + v.to_bytes(4, "big"))
         for v in range(num_validators)]
+    # Write-ahead the round's randomness BEFORE any transmission: a node
+    # that crashes after broadcasting must replay the SAME polynomials
+    # and PoK nonces on resume — peers holding its first broadcast treat
+    # the identical replay as idempotent re-delivery, where a fresh
+    # sample would be an equivocation.
+    saved = ckpt.get("frost_round1") if ckpt is not None else None
+    if saved is not None:
+        for p, coeffs in zip(participants, saved["coeffs"]):
+            p._coeffs = [int(a) for a in coeffs]
+        nonces = [int(s) for s in saved["nonces"]]
+    else:
+        nonces = [frost_mod.Participant._rand_scalar()
+                  for _ in participants]
     # ONE batched fixed-base device dispatch for every validator's
     # commitments + PoK nonces (frost.round1_batch)
     round1_bcasts = []
     outgoing: dict[int, dict[int, int]] = {j: {} for j in range(1, num_nodes + 1)}
-    for v, (b, shares) in enumerate(frost_mod.round1_batch(participants)):
+    for v, (b, shares) in enumerate(
+            frost_mod.round1_batch(participants, nonces=nonces)):
         round1_bcasts.append(b)
         for j, share in shares.items():
             outgoing[j][v] = share
+    if saved is None and ckpt is not None:
+        ckpt.put("frost_round1", {
+            "coeffs": [[str(a) for a in p._coeffs] for p in participants],
+            "nonces": [str(n) for n in nonces]})
+
+    # serve our shares to peers that missed the push (they were down
+    # when send_async fired, or they are resuming) — keyed on the
+    # authenticated transport identity, so each peer can only ever pull
+    # the shares addressed to it
+    async def on_frost_fetch(sender_idx: int, payload: bytes) -> bytes:
+        theirs = outgoing.get(sender_idx + 1, {})
+        return json.dumps(
+            {"shares": {str(v): str(s) for v, s in theirs.items()}}).encode()
+
+    node.register_handler(PROTO_FROST_FETCH, on_frost_fetch)
+
     # broadcast commitments+PoK for all validators at once
     bcast.broadcast("frost-r1", json.dumps(
         [b.to_json() for b in round1_bcasts]).encode())
@@ -250,9 +438,32 @@ async def _run_frost(node: TCPNode, bcast: SignedBroadcast, inbox: _FrostShares,
             continue
         node.send_async(j - 1, PROTO_FROST, json.dumps(
             {"shares": {str(v): str(s) for v, s in outgoing[j].items()}}).encode())
+    if chaos_hook is not None:
+        await chaos_hook("keygen:sent")
+
+    async def _refetch_shares():
+        """Pull senders whose shares we are missing — their push retries
+        may have exhausted while we were down."""
+        for j in range(1, num_nodes + 1):
+            if j == my_part:
+                continue
+            if all(j in inbox.shares.get(v, {})
+                   for v in range(num_validators)):
+                continue
+            try:
+                resp = await node.send_receive(
+                    j - 1, PROTO_FROST_FETCH, b"{}", timeout=5.0)
+            except Exception as exc:  # noqa: BLE001 — peer down; next tick
+                _log.debug("frost share fetch failed; will retry",
+                           peer=j, err=exc)
+                continue
+            msg = json.loads(resp.decode())
+            for v_str, share in msg["shares"].items():
+                inbox.add(int(v_str), j, int(share))
 
     r1_all = await bcast.gather("frost-r1", num_nodes, timeout)
-    await inbox.await_count(num_validators, num_nodes, timeout)
+    await inbox.await_count(num_validators, num_nodes, timeout,
+                            on_stall=_refetch_shares)
 
     # verify + finalize per validator
     group_pubkeys, share_pubkeys_all, share_secrets = [], [], []

@@ -31,7 +31,13 @@ from dataclasses import dataclass, field
 from .. import tbls
 from ..crypto import fields as F
 from ..tbls.native_impl import NativeUnavailable, load_library
-from ..utils import errors
+from ..utils import errors, faults, metrics
+
+_msm_c = metrics.counter(
+    "dkg_msm_total",
+    "Share-verification checks completed per MSM path: the fused device "
+    "sweep ('device') or the per-item native lincomb ('native')",
+    ("path",))
 
 try:
     _LIB = load_library()
@@ -129,7 +135,7 @@ class Participant:
                 return s
 
 
-def round1_batch(parts: list[Participant]
+def round1_batch(parts: list[Participant], nonces: list[int] | None = None
                  ) -> list[tuple[Round1Broadcast, dict[int, int]]]:
     """Round 1 for MANY participants (a node's whole validator set) with
     the generator multiplications BATCHED: all commitments C_ik = a_ik·G
@@ -138,10 +144,18 @@ def round1_batch(parts: list[Participant]
     scalar-mul each — the ceremony keygen hot spot (BASELINE config 4;
     reference dkg/frost.go:50-86 + runFrostParallel compute them
     serially via kryptology). Off-device (or for small batches) the
-    per-participant path is used; outputs are bit-identical."""
+    per-participant path is used; outputs are bit-identical.
+
+    Replay: a participant whose `_coeffs` are already set keeps them
+    (and the caller supplies the matching PoK `nonces`) — a checkpoint-
+    resumed node re-derives bit-identical broadcasts and shares, so
+    peers that already hold its round-1 message see an idempotent
+    re-delivery instead of an equivocation."""
     for p in parts:
-        p._coeffs = [p._rand_scalar() for _ in range(p.threshold)]
-    nonces = [p._rand_scalar() for p in parts]
+        if not p._coeffs:
+            p._coeffs = [p._rand_scalar() for _ in range(p.threshold)]
+    if nonces is None:
+        nonces = [p._rand_scalar() for p in parts]
     scalars = [a for p in parts for a in p._coeffs] + nonces
     pts = _mul_gen_many(scalars)
     out = []
@@ -233,25 +247,45 @@ def verify_share(my_index: int, share: int, commitments: list[bytes]) -> None:
         raise errors.new("share does not match commitments", index=my_index)
 
 
-# Measured on v5e (BASELINE config 4) — round 5: the share verification
-# is one-shot-point bound. Round 4 measured the hybrid (native decode +
-# device sweep) at 0.4-0.7x native; round 5 built the fully-FUSED
-# one-dispatch graph (plane_agg._g1_decode_groups_sweep_jit: device
-# decompress + subgroup + sweep + reduces, no native decode, no extra
-# syncs — the same fusion that won sigagg) and it measures 0.48x at the
-# 4.8k-point ceremony shape (1.53 s device vs 0.73 s native for 1000
-# checks): the native C++ per-item lincomb at ~0.7 ms/check is simply
-# faster than shipping fresh one-shot points through the remote tunnel
-# and paying the decompress sqrt scans for a single use. The gate below
-# keeps ceremony sizes native, by measurement. This threshold sits far
-# above the 1024-lane (TILE) compile ceiling, which used to make it
-# UNREACHABLE: the fused graph could never compile at the shapes the
-# gate admitted (ADVICE round 5). g1_groups_msm now splits its device
-# path into TILE-sized chunked dispatches of the already-compiled graph
-# (plane_agg._groups_msm_chunk), so batches past the gate genuinely run
-# on device — the chunks pipeline asynchronously and the per-group
-# partial sums combine on the host.
-_DEVICE_MIN_POINTS = 16384
+# The device gate sits at the verified compile ceiling: g1_groups_msm
+# splits its device path into TILE-sized chunked dispatches of the
+# already-compiled fused graph (plane_agg._groups_msm_chunk — the same
+# chunking that made rlc_verify_dispatch compile), so ONE TILE of points
+# is the smallest batch that fills a whole dispatch and the smallest
+# shape the compile budget has actually verified. History: the gate used
+# to be 16384 — 16x the 1024-lane compile ceiling — from a round-5 v5e
+# measurement of the UNCHUNKED graph (0.48x native at the 4.8k-point
+# ceremony shape, one-shot-point bound), which made the device path
+# unreachable in production (ADVICE round 5): the fused graph could
+# never compile at the shapes the gate admitted. Post-chunking the
+# dispatch amortizes exactly like sigagg's, and batches past one TILE
+# genuinely run on device — chunks pipeline asynchronously and the
+# per-group partial sums combine on the host. Kept equal to
+# pallas_plane.TILE by a gate-logic unit test.
+_DEVICE_MIN_POINTS = 1024
+
+
+def _interpreted() -> bool:
+    """Seam over pallas_plane._interpret() for the device GATE only —
+    tests/dryruns monkeypatch the gate's platform view here without
+    changing how any kernel actually lowers."""
+    from ..ops import pallas_plane as PP
+
+    return PP._interpret()
+
+
+def device_gate(total: int) -> bool:
+    """Should a batch of `total` commitment points take the fused device
+    MSM? Three gates: size (at least one full TILE dispatch), platform
+    (interpret-mode CPU runs the graph thousands of times slower than
+    the native lincomb), and the plane circuit breaker (an OPEN breaker
+    means the device is known-dead; don't pay a doomed dispatch
+    mid-ceremony)."""
+    if total < _DEVICE_MIN_POINTS or _interpreted():
+        return False
+    from ..ops import guard
+
+    return guard.allow_device_dispatch()
 
 
 def verify_shares_batch(
@@ -268,25 +302,31 @@ def verify_shares_batch(
     i.e. a single wide G1 MSM — one device sweep for the whole ceremony
     round instead of M native lincombs. On failure (or off-device) falls
     back to per-item verify_share so the offending dealer is attributed
-    exactly as before. Raises like verify_share."""
+    exactly as before; device-class failures route through the guard
+    taxonomy (`ops.guard.note_ceremony_fallback`) so a chip lost
+    mid-ceremony feeds the same breaker/fallback counter as one lost
+    mid-duty and the result stays bit-identical on the native path.
+    Raises like verify_share."""
     total = sum(len(c) for _, _, c in items)
-    use_device = total >= _DEVICE_MIN_POINTS
-    if use_device:
-        from ..ops import pallas_plane as PP
-
-        use_device = not PP._interpret()
-    if use_device:
-        from ..tbls.tpu_impl import _DEVICE_RUNTIME_ERRORS
+    if device_gate(total):
+        from ..ops import guard
 
         try:
+            faults.check("frost.msm")
             if _verify_shares_device(items):
+                guard.BREAKER.record_success()
+                _msm_c.inc("device", amount=float(len(items)))
                 return
         except ValueError:
             pass  # invalid encoding: attribute below
-        except _DEVICE_RUNTIME_ERRORS:  # device/tunnel fault: native path
-            pass
+        except Exception as exc:  # noqa: BLE001 — classified just below
+            reason = guard.classify(exc)
+            if reason == "input":
+                raise
+            guard.note_ceremony_fallback(reason, exc)
     for my_index, share, commitments in items:
         verify_share(my_index, share, commitments)
+        _msm_c.inc("native")
 
 
 def _verify_shares_device(items) -> bool:

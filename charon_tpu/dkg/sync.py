@@ -4,7 +4,15 @@ client.go; protocol /charon/dkg/sync/1.0.0/).
 
 Every node proves it is running the same ceremony by signing the cluster
 definition hash with its identity key; steps fence ceremony phases so no
-node runs ahead before all peers finished the previous phase."""
+node runs ahead before all peers finished the previous phase.
+
+Barriers tolerate churn: a peer that crashes and re-joins mid-step is
+just a peer whose queries fail for a while — the poll loop keeps
+re-dialing it under jittered backoff until the deadline, so a late
+re-connect inside the timeout succeeds. An exhausted deadline raises
+`BarrierTimeout`, which the guard taxonomy classifies as "timeout"
+(retryable), so the ceremony round wrapper in dkg/dkg.py re-enters the
+barrier instead of aborting the ceremony."""
 
 from __future__ import annotations
 
@@ -13,11 +21,25 @@ import hashlib
 import json
 
 from ..p2p.node import TCPNode
-from ..utils import errors, k1util, log
+from ..utils import errors, expbackoff, faults, k1util, log
 
 _log = log.with_topic("dkg-sync")
 
 PROTOCOL = "/charon/dkg/sync/1.0.0"
+
+# Poll pacing between barrier sweeps: jittered so a cluster of nodes that
+# all lost the same peer don't re-dial it in lockstep, reset to the base
+# whenever a sweep makes progress.
+BARRIER_BACKOFF = expbackoff.Config(
+    base=0.1, multiplier=1.6, jitter=0.2, max_delay=1.0)
+
+
+class BarrierTimeout(errors.CharonError, TimeoutError):
+    """A sync barrier deadline expired with peers still missing/lagging.
+
+    Subclasses TimeoutError so `ops.guard.classify` files it as
+    "timeout" and `utils.retry.is_temporary` treats it as retryable —
+    the ceremony round wrapper re-enters the barrier on this."""
 
 
 def _digest(def_hash: bytes) -> bytes:
@@ -65,43 +87,58 @@ class SyncProtocol:
 
     async def await_all_connected(self, timeout: float = 60.0) -> None:
         """Block until every peer answers a sync query (reference
-        AwaitAllConnected)."""
+        AwaitAllConnected). Late joiners inside the timeout succeed: a
+        failed query just leaves the peer pending for the next sweep."""
+        faults.check("dkg.sync_barrier")
         deadline = asyncio.get_running_loop().time() + timeout
         pending = set(self._node.peers)
+        backoff = expbackoff.Backoff(BARRIER_BACKOFF)
         while pending:
+            progressed = False
             for idx in list(pending):
                 try:
                     await self._query_peer(idx)
                     pending.discard(idx)
+                    progressed = True
                 except Exception:  # noqa: BLE001 — peer not up yet
                     if asyncio.get_running_loop().time() > deadline:
-                        raise errors.new("dkg sync connect timeout",
-                                         missing=sorted(pending))
+                        raise BarrierTimeout("dkg sync connect timeout",
+                                             missing=sorted(pending))
             if pending:
-                await asyncio.sleep(0.1)
+                if progressed:
+                    backoff.reset()
+                await backoff.wait()
         _log.info("all dkg peers connected", peers=len(self._node.peers))
 
     async def await_all_at_step(self, step: int, timeout: float = 120.0) -> None:
         """Advance to `step` and block until every peer reports >= step
-        (reference AwaitAllAtStep)."""
+        (reference AwaitAllAtStep). A peer that crashed mid-step and
+        re-joins before the deadline is swept up like any other laggard."""
+        faults.check("dkg.sync_barrier")
         self.step = step
         deadline = asyncio.get_running_loop().time() + timeout
         pending = set(self._node.peers)
+        backoff = expbackoff.Backoff(BARRIER_BACKOFF)
         while pending:
+            progressed = False
             for idx in list(pending):
                 try:
                     if await self._query_peer(idx) >= step:
                         pending.discard(idx)
+                        progressed = True
                 except Exception as exc:  # noqa: BLE001 — retry until deadline
                     # a peer that already reported this step may have finished
                     # and torn down its node — count it as done
                     if self.peer_steps.get(idx, 0) >= step:
                         pending.discard(idx)
+                        progressed = True
                     else:
                         _log.debug("dkg step query failed; will retry",
                                    peer=idx, step=step, err=exc)
             if pending:
                 if asyncio.get_running_loop().time() > deadline:
-                    raise errors.new("dkg step timeout", step=step,
-                                     lagging=sorted(pending))
-                await asyncio.sleep(0.1)
+                    raise BarrierTimeout("dkg step timeout", step=step,
+                                         lagging=sorted(pending))
+                if progressed:
+                    backoff.reset()
+                await backoff.wait()

@@ -4,6 +4,7 @@ from __future__ import annotations
 
 from .aio import UntrackedTaskRule
 from .exc import BroadExceptRule, GuardSeamRule
+from .flt import FaultSiteRule
 from .iface import ProtocolImplRule
 from .obs import DutySpanRule
 from .tpu import (DeviceDtypeRule, MeshTopologyRule, PipelineLockSyncRule,
@@ -14,6 +15,7 @@ __all__ = [
     "UntrackedTaskRule",
     "BroadExceptRule",
     "GuardSeamRule",
+    "FaultSiteRule",
     "DeviceDtypeRule",
     "PlaneStoreRoutingRule",
     "PipelineLockSyncRule",
@@ -30,6 +32,7 @@ def default_rules() -> list:
         UntrackedTaskRule(),
         BroadExceptRule(),
         GuardSeamRule(),
+        FaultSiteRule(),
         DeviceDtypeRule(),
         PlaneStoreRoutingRule(),
         PipelineLockSyncRule(),

@@ -1,0 +1,52 @@
+"""LINT-FLT-011 — fault-injection sites must be literal and registered.
+
+`utils.faults.check(site)` is a zero-overhead no-op until a chaos plan is
+armed, so a typo'd or unregistered site string fails SILENTLY: the planned
+fault never fires and the chaos test proves nothing (arm() validates the
+PLAN's sites against SITES, but nothing validated the CODE's check()
+call sites until this rule). Every `faults.check(...)` call must therefore
+pass a single string literal that is present in `utils.faults.SITES` —
+computed site names would make the registry unauditable, and unregistered
+ones can never be armed.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable
+
+from ...utils.faults import SITES
+from ..engine import Finding, SourceFile
+
+
+class FaultSiteRule:
+    id = "LINT-FLT-011"
+    description = ("faults.check(...) must pass a literal site string "
+                   "registered in utils.faults.SITES — a computed or "
+                   "unregistered site can never be armed, so the planned "
+                   "fault silently never fires")
+
+    def check(self, src: SourceFile) -> Iterable[Finding]:
+        for node in ast.walk(src.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            fn = node.func
+            if not (isinstance(fn, ast.Attribute) and fn.attr == "check"
+                    and isinstance(fn.value, ast.Name)
+                    and fn.value.id == "faults"):
+                continue
+            arg = node.args[0] if node.args else None
+            if not (isinstance(arg, ast.Constant)
+                    and isinstance(arg.value, str)):
+                yield Finding(
+                    src.rel, node.lineno, self.id,
+                    "faults.check(...) must take a single string LITERAL "
+                    "site (not a variable or expression) so the SITES "
+                    "registry stays auditable")
+                continue
+            if arg.value not in SITES:
+                yield Finding(
+                    src.rel, node.lineno, self.id,
+                    f'fault site "{arg.value}" is not in utils.faults.SITES'
+                    " — register it there (with a locating comment) or fix "
+                    "the typo; an unregistered site can never be armed")

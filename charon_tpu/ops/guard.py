@@ -302,6 +302,20 @@ def note_backpressure_timeout() -> None:
     _log.warn("pipeline backpressure wait expired; releasing submitter")
 
 
+def note_ceremony_fallback(reason: str, exc: BaseException | None = None
+                           ) -> None:
+    """Ceremony-plane analogue of the ladder's native rung: a DKG/FROST
+    device dispatch (frost.msm) failed device-class and the caller is
+    degrading to the bit-identical native path. Feeds the same breaker
+    and `ops_sigagg_fallback_total{reason,native}` counter the
+    sigagg_plane_degraded health rule watches, so a chip lost mid-
+    ceremony shows up exactly like one lost mid-duty."""
+    BREAKER.record_failure()
+    _fallback_c.inc(reason, "native")
+    _log.warn("ceremony MSM degraded to native plane", reason=reason,
+              err=exc)
+
+
 def _primary_width() -> int:
     from . import mesh as mesh_mod
 

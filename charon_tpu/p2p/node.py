@@ -33,7 +33,7 @@ import struct
 from dataclasses import dataclass, field
 from typing import Awaitable, Callable
 
-from ..utils import aio, errors, expbackoff, k1util, log, metrics
+from ..utils import aio, errors, expbackoff, faults, k1util, log, metrics
 from .channel import HandshakeError, SecureChannel, TCPFrameStream
 
 _log = log.with_topic("p2p")
@@ -227,6 +227,7 @@ class TCPNode:
         payload = self._maybe_fuzz(payload)
         conn = self._conn(peer_index)
         try:
+            faults.check("p2p.send")
             resp = await conn.request(protocol, payload, timeout)
             _msg_counter.inc("out", "ok")
             return resp
@@ -271,6 +272,7 @@ class TCPNode:
             if self._closed:
                 return
             try:
+                faults.check("p2p.send")
                 await conn.send_oneway(protocol, payload)
                 _msg_counter.inc("out", "ok")
                 return

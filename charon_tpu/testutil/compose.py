@@ -154,3 +154,121 @@ class ComposeCluster:
             return path.read_text(errors="replace")
         except OSError:
             return ""
+
+
+class SimulatedCrash(RuntimeError):
+    """Raised by a ComposeDKG chaos hook to take one node down at a named
+    ceremony point. Deliberately a plain RuntimeError: the guard taxonomy
+    classifies it "error" (non-retryable in-process), so the node's
+    run_dkg aborts exactly like a real crash would — the harness then
+    re-runs it with the same data_dir and it resumes from its round
+    checkpoint."""
+
+
+@dataclass
+class ComposeDKG:
+    """In-process multi-node DKG ceremony harness with churn chaos.
+
+    Every node runs the REAL `dkg.run_dkg` over real TCP (the ceremony
+    never touches a beacon node, so no subprocess CLI is needed — one
+    event loop drives all nodes, which is also what lets the harness
+    crash a node at a deterministic ceremony point and re-join it while
+    its peers keep polling their barriers)."""
+
+    dir: Path
+    configs: list = field(default_factory=list)   # dkg.Config per node
+    resumed: list[int] = field(default_factory=list)
+
+    @classmethod
+    def generate(cls, dir, num_nodes: int = 4, num_validators: int = 2,
+                 threshold: int = 3, timeout: float = 90.0) -> "ComposeDKG":
+        """Signed definition + shared peer specs + per-node configs (the
+        same shape the ceremony tests build; the SHARED spec list is what
+        lets a restarted node publish its new port to its peers)."""
+        from ..cluster.definition import Definition, Operator
+        from ..dkg.dkg import Config
+        from ..eth2 import enr
+        from ..p2p.node import PeerSpec
+        from ..utils import k1util
+
+        dir = Path(dir)
+        identity_keys = [k1util.generate_private_key()
+                         for _ in range(num_nodes)]
+        definition = Definition(
+            name="compose-dkg", num_validators=num_validators,
+            threshold=threshold,
+            operators=[Operator(enr=enr.new(k).encode())
+                       for k in identity_keys],
+            dkg_algorithm="frost")
+        for i, k in enumerate(identity_keys):
+            definition = definition.sign_operator(i, k)
+        specs = [PeerSpec(i, k1util.public_key(k))
+                 for i, k in enumerate(identity_keys)]
+        configs = [Config(definition=definition,
+                          identity_key=identity_keys[i], node_index=i,
+                          peers=specs, data_dir=dir / f"node{i}",
+                          insecure_keystores=True, timeout=timeout)
+                   for i in range(num_nodes)]
+        return cls(dir=dir, configs=configs)
+
+    async def run(self, crash_node: int | None = None,
+                  crash_point: str = "keygen:sent") -> list:
+        """Run the ceremony on all nodes concurrently; returns the locks
+        in node order. With `crash_node` set, that node's chaos hook
+        raises SimulatedCrash the FIRST time it reaches `crash_point`
+        (dkg round points: "round:connect", "round:keygen", …, plus
+        "keygen:sent" right after round-1 transmission); the harness
+        catches the crash and re-runs the node against the same
+        data_dir, so it re-joins from its checkpoint while the other
+        nodes are still waiting at their barriers."""
+        from ..dkg.dkg import run_dkg
+
+        if crash_node is not None:
+            fired = [False]
+
+            async def hook(point: str) -> None:
+                if point == crash_point and not fired[0]:
+                    fired[0] = True
+                    raise SimulatedCrash(f"injected crash at {point}")
+
+            self.configs[crash_node].chaos_hook = hook
+        tasks = {i: asyncio.ensure_future(run_dkg(c))
+                 for i, c in enumerate(self.configs)}
+        if crash_node is not None:
+            try:
+                await tasks[crash_node]
+            except SimulatedCrash:
+                _log.info("compose dkg node crashed; re-joining",
+                          node=crash_node, point=crash_point)
+                self.configs[crash_node].chaos_hook = None
+                self.resumed.append(crash_node)
+                tasks[crash_node] = asyncio.ensure_future(
+                    run_dkg(self.configs[crash_node]))
+        return list(await asyncio.gather(
+            *(tasks[i] for i in range(len(self.configs)))))
+
+    @classmethod
+    async def run_batch(cls, dir, count: int, num_nodes: int = 4,
+                        num_validators: int = 2, threshold: int = 3,
+                        timeout: float = 90.0) -> dict:
+        """Batched multi-ceremony mode: `count` sequential fault-free
+        ceremonies in fresh subdirs (the BASELINE.json dkg benchmark
+        shape, scaled by the caller). Returns timing stats for bench /
+        dryrun JSON tails."""
+        timings = []
+        for c in range(count):
+            harness = cls.generate(Path(dir) / f"ceremony{c}",
+                                   num_nodes=num_nodes,
+                                   num_validators=num_validators,
+                                   threshold=threshold, timeout=timeout)
+            t0 = time.monotonic()
+            locks = await harness.run()
+            timings.append(time.monotonic() - t0)
+            h0 = locks[0].lock_hash()
+            if any(lk.lock_hash() != h0 for lk in locks):
+                raise RuntimeError(f"ceremony {c}: lock hashes diverge")
+        return {"count": count, "num_nodes": num_nodes,
+                "num_validators": num_validators,
+                "total_s": round(sum(timings), 3),
+                "per_ceremony_s": [round(t, 3) for t in timings],
+                "mean_s": round(sum(timings) / max(1, count), 3)}

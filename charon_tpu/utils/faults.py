@@ -52,6 +52,10 @@ SITES = (
     "mesh.resolve",     # topology probe (ops/mesh._resolve)
     "beacon.http",      # HTTPBeaconNode request attempts
     "parsigex.recv",    # inbound partial-signature handling
+    "dkg.round",        # ceremony round boundary (dkg/dkg._run_round)
+    "dkg.sync_barrier",  # stepped-rendezvous barrier entry (dkg/sync)
+    "p2p.send",         # outbound p2p send attempt (TCPNode request/oneway)
+    "frost.msm",        # fused device share-verification MSM (dkg/frost)
 )
 
 

@@ -1,0 +1,59 @@
+"""Ceremony chaos-dryrun guard: `dkg_chaos_dryrun` must complete inside a
+CI budget AND its JSON tail must carry the resilience evidence the driver
+artifact is judged on — a resumed peer, injected barrier/MSM faults, the
+native fallback, and the batched-ceremony timings.
+
+Unlike the sigagg dryruns, nothing here compiles XLA: the planned
+frost.msm fault fires BEFORE any device dispatch, so the budget is pure
+ceremony wall-clock (6 in-process 4-node DKGs plus interpreter start) —
+measured ~70 s on this box."""
+
+import json
+import subprocess
+import sys
+import tempfile
+import time
+from pathlib import Path
+
+import pytest
+
+REPO = Path(__file__).resolve().parents[1]
+BUDGET_S = 300  # ~4x the measured floor; a hang (a barrier that stopped
+                # tolerating churn, a lost node that never re-joins)
+                # blows through it unambiguously
+
+
+@pytest.mark.scale
+@pytest.mark.slow  # multi-minute subprocess; same tier as the sigagg budget
+def test_dkg_chaos_dryrun_budget_and_evidence():
+    sys.path.insert(0, str(REPO))
+    import __graft_entry__ as entry
+
+    env = entry.dryrun_env(1)  # EXACTLY the driver subprocess recipe
+    env["JAX_COMPILATION_CACHE_DIR"] = tempfile.mkdtemp(prefix="dkg_chaos_")
+    t0 = time.monotonic()
+    res = subprocess.run(
+        [sys.executable, str(REPO / "__graft_entry__.py"),
+         "dkgchaosdryrun", "1"],
+        env=env, cwd=str(REPO), capture_output=True, text=True,
+        timeout=BUDGET_S)
+    elapsed = time.monotonic() - t0
+    assert res.returncode == 0, (
+        f"dkg chaos dryrun failed rc={res.returncode} after {elapsed:.0f}s:\n"
+        + res.stdout[-2000:] + res.stderr[-2000:])
+    assert "dkg_chaos_dryrun OK" in res.stdout, res.stdout[-2000:]
+
+    tail = next(line for line in res.stdout.splitlines()
+                if line.startswith("dkg_chaos_dryrun metrics: "))
+    m = json.loads(tail.split("metrics: ", 1)[1])
+    assert m["resumed_peers"] >= 1, "no peer resumed from a checkpoint"
+    assert m["faults_injected"]["dkg.sync_barrier"] >= 1
+    assert m["faults_injected"]["frost.msm"] >= 1
+    assert sum(m["round_retries"].values()) >= 1, \
+        "the barrier fault never re-entered a round"
+    assert m["fallback_native"] >= 1, \
+        "device loss mid-MSM left no ladder evidence"
+    assert m["msm"]["native"] > 0 and m["msm"]["device"] == 0
+    assert m["batch"]["count"] == 2 and m["batch"]["total_s"] > 0
+    print(f"dkg chaos dryrun completed in {elapsed:.0f}s "
+          f"(budget {BUDGET_S}s)")

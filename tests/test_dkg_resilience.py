@@ -1,0 +1,487 @@
+"""Ceremony-resilience tests: resumable rounds, churn-tolerant barriers,
+pull-based broadcast recovery, and the FROST device-MSM guard path.
+
+The contract under test (docs/robustness.md "Ceremony resilience"):
+
+  * a node that crashes mid-round re-joins at the last completed round
+    from its data-dir checkpoint and finishes with the SAME lock as its
+    fault-free peers;
+  * sync barriers tolerate late re-connects inside the timeout and raise
+    a timeout-classified (retryable) error past it;
+  * the round wrapper re-enters timeout/device-class failures with
+    jittered backoff, aborts on input-class failures, and never swallows
+    cancellation;
+  * device loss during the frost share-verification MSM degrades to the
+    native verifier bit-identically through the guard ladder.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+
+import pytest
+
+from charon_tpu.app import health
+from charon_tpu.dkg import bcast as bcast_mod
+from charon_tpu.dkg import dkg as dkg_mod
+from charon_tpu.dkg import frost
+from charon_tpu.dkg import sync as sync_mod
+from charon_tpu.dkg.checkpoint import CeremonyCheckpoint
+from charon_tpu.ops import guard
+from charon_tpu.ops import pallas_plane as PP
+from charon_tpu.p2p.node import PeerSpec, TCPNode
+from charon_tpu.testutil import chaos
+from charon_tpu.testutil.compose import ComposeDKG
+from charon_tpu.utils import expbackoff, k1util, metrics, retry
+from charon_tpu.utils.errors import CharonError
+
+DEF_HASH = b"\xaa" * 32
+
+
+def _run(coro, timeout=120):
+    return asyncio.run(asyncio.wait_for(coro, timeout))
+
+
+def _retries_total() -> float:
+    c = metrics.default_registry.counter("dkg_round_retries_total")
+    with c._lock:
+        return sum(c._children.values())
+
+
+# ---- checkpoint ----------------------------------------------------------
+
+
+def test_checkpoint_roundtrip_and_clear(tmp_path):
+    ck = CeremonyCheckpoint(tmp_path, DEF_HASH)
+    assert not ck.resumed and ck.get("keygen") is None
+    ck.put("keygen", {"a": 1})
+    ck.put("deposit", {"b": "2"})
+
+    path = tmp_path / "dkg-checkpoint.json"
+    assert path.stat().st_mode & 0o777 == 0o600, \
+        "checkpoint holds secret polynomial coefficients; must be 0600"
+
+    ck2 = CeremonyCheckpoint(tmp_path, DEF_HASH)
+    assert ck2.resumed
+    assert ck2.get("keygen") == {"a": 1}
+    assert ck2.get("deposit") == {"b": "2"}
+
+    ck2.clear()
+    assert not path.exists()
+    assert not CeremonyCheckpoint(tmp_path, DEF_HASH).resumed
+
+
+def test_checkpoint_other_ceremony_discarded(tmp_path):
+    ck = CeremonyCheckpoint(tmp_path, DEF_HASH)
+    ck.put("keygen", {"a": 1})
+    other = CeremonyCheckpoint(tmp_path, b"\xbb" * 32)
+    assert not other.resumed and other.get("keygen") is None
+
+
+def test_checkpoint_corrupt_or_versioned_file_discarded(tmp_path):
+    path = tmp_path / "dkg-checkpoint.json"
+    path.write_text("{not json")
+    assert not CeremonyCheckpoint(tmp_path, DEF_HASH).resumed
+    path.write_text(json.dumps({"version": 999, "def_hash": DEF_HASH.hex(),
+                                "rounds": {"keygen": {}}}))
+    assert not CeremonyCheckpoint(tmp_path, DEF_HASH).resumed
+
+
+# ---- retryable-error taxonomy + the round wrapper ------------------------
+
+
+def test_barrier_and_gather_timeouts_classify_retryable():
+    """The multiple-inheritance trick the round wrapper relies on: both
+    ceremony timeout errors are CharonErrors (structured fields) AND
+    TimeoutErrors (guard files them "timeout", retry calls them
+    temporary)."""
+    for exc in (sync_mod.BarrierTimeout("x", step=2),
+                bcast_mod.GatherTimeout("y", topic="t")):
+        assert isinstance(exc, CharonError)
+        assert isinstance(exc, TimeoutError)
+        assert guard.classify(exc) == "timeout"
+        assert retry.is_temporary(exc)
+
+
+@pytest.fixture
+def fast_backoff(monkeypatch):
+    monkeypatch.setattr(dkg_mod, "ROUND_BACKOFF",
+                        expbackoff.Config(base=0.001, max_delay=0.002))
+
+
+def test_run_round_reenters_timeout_class(fast_backoff):
+    calls = []
+
+    async def fn():
+        calls.append(1)
+        if len(calls) < 3:
+            raise sync_mod.BarrierTimeout("peers lagging", step=2)
+        return "done"
+
+    base = _retries_total()
+    assert _run(dkg_mod._run_round("keygen", 2, fn)) == "done"
+    assert len(calls) == 3
+    assert _retries_total() - base == 2
+
+
+def test_run_round_aborts_input_class_immediately(fast_backoff):
+    calls = []
+
+    async def fn():
+        calls.append(1)
+        raise ValueError("equivocation detected")
+
+    base = _retries_total()
+    with pytest.raises(ValueError):
+        _run(dkg_mod._run_round("keygen", 2, fn))
+    assert len(calls) == 1, "input-class failures must not be retried"
+    assert _retries_total() == base
+
+
+def test_run_round_exhausts_retries_then_raises(fast_backoff):
+    calls = []
+
+    async def fn():
+        calls.append(1)
+        raise bcast_mod.GatherTimeout("never enough senders")
+
+    with pytest.raises(bcast_mod.GatherTimeout):
+        _run(dkg_mod._run_round("keygen", 2, fn))
+    assert len(calls) == dkg_mod.ROUND_RETRIES + 1
+
+
+def test_run_round_propagates_cancellation(fast_backoff):
+    async def main():
+        async def hang():
+            await asyncio.sleep(30)
+
+        task = asyncio.ensure_future(
+            dkg_mod._run_round("keygen", 2, hang))
+        await asyncio.sleep(0.05)
+        task.cancel()
+        with pytest.raises(asyncio.CancelledError):
+            await task
+
+    asyncio.run(main())
+
+
+def test_run_round_sets_ceremony_state_gauge(fast_backoff):
+    async def fn():
+        return None
+
+    _run(dkg_mod._run_round("deposit", 3, fn))
+    g = metrics.default_registry.gauge("dkg_ceremony_state")
+    assert g.value() == 3.0
+    g.set(0.0)  # don't leave "mid-ceremony" state for other tests
+
+
+# ---- sync barriers under churn -------------------------------------------
+
+
+def _sync_pair():
+    keys = [k1util.generate_private_key() for _ in range(2)]
+    pubs = {i: k1util.public_key(k) for i, k in enumerate(keys)}
+    specs = [PeerSpec(i, pubs[i]) for i in range(2)]
+    nodes = [TCPNode(keys[i], i, specs, own_spec=specs[i])
+             for i in range(2)]
+    syncs = [sync_mod.SyncProtocol(nodes[i], DEF_HASH, keys[i], pubs)
+             for i in range(2)]
+    return nodes, syncs
+
+
+def test_barrier_late_joiner_inside_timeout_succeeds():
+    async def run():
+        nodes, syncs = _sync_pair()
+        await nodes[0].start()
+        try:
+            async def late():
+                await asyncio.sleep(0.5)
+                await nodes[1].start()
+                await syncs[1].await_all_connected(timeout=10)
+
+            await asyncio.gather(
+                syncs[0].await_all_connected(timeout=10), late())
+        finally:
+            for n in nodes:
+                await n.stop()
+
+    _run(run(), timeout=30)
+
+
+def test_barrier_exhausted_deadline_raises_classified():
+    async def run():
+        nodes, syncs = _sync_pair()
+        await nodes[0].start()  # peer 1 never comes up
+        try:
+            with pytest.raises(sync_mod.BarrierTimeout) as ei:
+                await syncs[0].await_all_connected(timeout=1.0)
+            assert guard.classify(ei.value) == "timeout"
+        finally:
+            await nodes[0].stop()
+
+    _run(run(), timeout=30)
+
+
+def test_barrier_cancellation_propagates():
+    async def run():
+        nodes, syncs = _sync_pair()
+        await nodes[0].start()
+        try:
+            task = asyncio.ensure_future(
+                syncs[0].await_all_connected(timeout=60))
+            await asyncio.sleep(0.3)
+            task.cancel()
+            with pytest.raises(asyncio.CancelledError):
+                await task
+        finally:
+            await nodes[0].stop()
+
+    _run(run(), timeout=30)
+
+
+# ---- broadcast pull recovery ---------------------------------------------
+
+
+def test_gather_pulls_broadcast_missed_while_down():
+    """A peer that was down when a broadcast was pushed recovers it by
+    PULLING on the next gather tick — through full signature/transport
+    verification — instead of waiting forever for a push that already
+    happened."""
+    async def run():
+        keys = [k1util.generate_private_key() for _ in range(2)]
+        pubs = {i: k1util.public_key(k) for i, k in enumerate(keys)}
+        specs = [PeerSpec(i, pubs[i]) for i in range(2)]
+        nodes = [TCPNode(keys[i], i, specs, own_spec=specs[i])
+                 for i in range(2)]
+        casts = [bcast_mod.SignedBroadcast(nodes[i], keys[i], pubs, i)
+                 for i in range(2)]
+        await nodes[0].start()
+        try:
+            # node 1 is DOWN: the push's 3 send_async retries all fail
+            casts[0].broadcast("phase", b"from-zero")
+            await asyncio.sleep(1.0)  # let the retry/backoff loop exhaust
+
+            await nodes[1].start()
+            casts[1].broadcast("phase", b"from-one")
+            got = await casts[1].gather("phase", 2, timeout=15.0)
+            assert got == {0: b"from-zero", 1: b"from-one"}
+        finally:
+            for n in nodes:
+                await n.stop()
+
+    _run(run(), timeout=60)
+
+
+def test_handle_fetch_unknown_topic_returns_empty():
+    class _StubNode:
+        peers: dict = {}
+
+        def register_handler(self, proto, handler):
+            pass
+
+    sb = bcast_mod.SignedBroadcast(_StubNode(), b"\x01" * 32, {}, 0)
+    req = json.dumps({"topic": "never-broadcast"}).encode()
+    assert asyncio.run(sb._handle_fetch(1, req)) == b""
+
+
+# ---- FROST device gate + guarded MSM -------------------------------------
+
+
+def test_device_gate_logic(monkeypatch):
+    """The gate floor IS the verified compile ceiling: one pallas TILE of
+    points (the chunk size g1_groups_msm dispatches at). Below it, in
+    interpret mode, or with the breaker open, the batch goes native."""
+    assert frost._DEVICE_MIN_POINTS == PP.TILE
+
+    guard.reset_for_testing()
+    monkeypatch.setattr(frost, "_interpreted", lambda: False)
+    try:
+        assert frost.device_gate(frost._DEVICE_MIN_POINTS)
+        assert not frost.device_gate(frost._DEVICE_MIN_POINTS - 1)
+
+        monkeypatch.setattr(frost, "_interpreted", lambda: True)
+        assert not frost.device_gate(frost._DEVICE_MIN_POINTS)
+
+        monkeypatch.setattr(frost, "_interpreted", lambda: False)
+        guard.configure(threshold=1, cooldown=3600.0)
+        guard.BREAKER.record_failure()
+        assert not frost.device_gate(frost._DEVICE_MIN_POINTS), \
+            "an OPEN breaker must route ceremony MSMs native pre-dispatch"
+    finally:
+        guard.reset_for_testing()
+
+
+def test_msm_device_loss_degrades_native(monkeypatch):
+    """Device loss mid share-verification MSM rides the guard ladder to
+    the native verifier: the batch still verifies (and still REJECTS a
+    bad share), the fallback counter moves, and the breaker records the
+    failure."""
+    p = frost.Participant(1, 2, 2, b"ctx")
+    b, shares = p.round1()
+    items = [(2, shares[2], b.commitments)]
+
+    monkeypatch.setattr(frost, "_DEVICE_MIN_POINTS", 1)
+    monkeypatch.setattr(frost, "_interpreted", lambda: False)
+    msm_c = metrics.default_registry.counter("dkg_msm_total")
+    base_native = msm_c.value("native")
+    base_fb = chaos.fallback_total(reason="device_lost", target="native")
+    base_inj = chaos.injected_total("frost.msm")
+
+    guard.reset_for_testing()
+    try:
+        with chaos.armed(chaos.device_lost("frost.msm", count=2)):
+            frost.verify_shares_batch(items)  # degrades, must not raise
+            bad = [(2, shares[2] + 1, b.commitments)]
+            with pytest.raises(CharonError):
+                frost.verify_shares_batch(bad)  # native attribution intact
+    finally:
+        guard.reset_for_testing()
+
+    assert chaos.injected_total("frost.msm") - base_inj == 2
+    assert chaos.fallback_total(
+        reason="device_lost", target="native") - base_fb == 2
+    assert msm_c.value("native") - base_native >= 1
+
+
+def test_msm_input_class_error_attributes_natively(monkeypatch):
+    """An input-class (ValueError) failure on the device path is NOT a
+    device fallback: it routes to the exact per-item native verifier for
+    attribution without touching the ceremony-fallback counter or the
+    breaker — a bad dealer is a protocol fact, not a degraded plane."""
+    p = frost.Participant(1, 2, 2, b"ctx")
+    b, shares = p.round1()
+    monkeypatch.setattr(frost, "_DEVICE_MIN_POINTS", 1)
+    monkeypatch.setattr(frost, "_interpreted", lambda: False)
+
+    def bad_encoding(_items):
+        raise ValueError("G1 point not in subgroup")
+
+    monkeypatch.setattr(frost, "_verify_shares_device", bad_encoding)
+    guard.reset_for_testing()
+    base_fb = chaos.fallback_total(target="native")
+    try:
+        # a VALID batch passes via exact attribution...
+        frost.verify_shares_batch([(2, shares[2], b.commitments)])
+        # ...and a corrupted share is pinned to its dealer
+        with pytest.raises(CharonError):
+            frost.verify_shares_batch([(2, shares[2] + 1, b.commitments)])
+        assert guard.BREAKER.state == guard.CLOSED, \
+            "input-class failures must not count against the breaker"
+    finally:
+        guard.reset_for_testing()
+    assert chaos.fallback_total(target="native") == base_fb, \
+        "exact attribution must not be recorded as a degraded fallback"
+
+
+@pytest.mark.slow  # compiles the fused G1 chunk graph at one TILE on CPU
+def test_frost_batch_reaches_device_chunk_graph(monkeypatch):
+    """Reachability of the device MSM from the ceremony path: a share
+    batch past the (shrunk-to-TILE) gate must dispatch TILE-sized chunks
+    of the real fused graph and never touch the per-item native verifier.
+    This is the shape the production gate admits — the compile ceiling
+    the _DEVICE_MIN_POINTS floor is pinned to."""
+    from charon_tpu.ops import plane_agg
+
+    monkeypatch.setattr(PP, "TILE", 64)
+    monkeypatch.setattr(frost, "_DEVICE_MIN_POINTS", 64)
+    monkeypatch.setattr(frost, "_interpreted", lambda: False)
+    monkeypatch.setattr(plane_agg, "_device_path", lambda n=0: True)
+
+    spans = []
+    real_chunk = plane_agg._groups_msm_chunk
+
+    def spy_chunk(points, scalars, groups, n_groups, s, e):
+        spans.append((s, e))
+        return real_chunk(points, scalars, groups, n_groups, s, e)
+
+    monkeypatch.setattr(plane_agg, "_groups_msm_chunk", spy_chunk)
+
+    def never(*a, **kw):
+        raise AssertionError("native verify_share reached on device path")
+
+    monkeypatch.setattr(frost, "verify_share", never)
+
+    # 22 dealers x t=3 commitments = 66 points: 2 chunks at TILE=64
+    items = []
+    for dealer in range(1, 23):
+        p = frost.Participant(dealer, 3, 23, b"reach")
+        b, shares = p.round1()
+        items.append((2, shares[2], b.commitments))
+
+    guard.reset_for_testing()
+    try:
+        frost.verify_shares_batch(items)
+    finally:
+        guard.reset_for_testing()
+    assert spans == [(0, 64), (64, 66)]
+
+
+# ---- end-to-end ceremonies under churn (the acceptance criteria) ---------
+
+
+def test_ceremony_crash_resume_same_lock(tmp_path):
+    """A node crashing right after round-1 transmission re-joins from its
+    checkpoint before the barrier deadline and the ceremony completes
+    with the SAME group public key and shares as its fault-free peers."""
+    h = ComposeDKG.generate(tmp_path, num_nodes=4, num_validators=2,
+                            threshold=3, timeout=60.0)
+    locks = _run(h.run(crash_node=2, crash_point="keygen:sent"))
+    assert h.resumed == [2]
+    h0 = locks[0].lock_hash()
+    assert all(lk.lock_hash() == h0 for lk in locks)
+    for lk in locks:
+        lk.verify()
+    # the checkpoint is cleared once the artifacts are on disk
+    assert not (tmp_path / "node2" / "dkg-checkpoint.json").exists()
+    # the resumed node wrote the same artifacts as everyone else
+    disk = json.loads((tmp_path / "node2" / "cluster-lock.json").read_text())
+    assert disk["lock_hash"] == "0x" + h0.hex()
+
+
+def test_ceremony_survives_barrier_timeout_fault(tmp_path):
+    """An injected sync-barrier timeout re-enters the round (retry metric
+    moves) and the ceremony still completes with identical locks."""
+    base = _retries_total()
+    h = ComposeDKG.generate(tmp_path, num_nodes=4, num_validators=2,
+                            threshold=3, timeout=60.0)
+    with chaos.armed(chaos.timeout("dkg.sync_barrier", index=0)):
+        locks = _run(h.run())
+    h0 = locks[0].lock_hash()
+    assert all(lk.lock_hash() == h0 for lk in locks)
+    assert _retries_total() - base >= 1
+
+
+# ---- the stalled-ceremony health rule ------------------------------------
+
+
+def test_dkg_ceremony_stalled_health_rule():
+    rule = {c.name: c for c in health.default_checks(3)}[
+        "dkg_ceremony_stalled"]
+    retries = "dkg_round_retries_total"
+    state = "dkg_ceremony_state"
+
+    def window(*snaps):
+        w = health.MetricWindow()
+        for counters, gauges in snaps:
+            w._snaps.append((counters, gauges, {}))
+        return w
+
+    # mid-ceremony, step frozen, retries burning -> FAILING
+    stuck = window(({(retries, ("keygen",)): 0.0}, {(state, ()): 2.0}),
+                   ({(retries, ("keygen",)): 3.0}, {(state, ()): 2.0}))
+    assert rule.func(stuck)
+
+    # step advanced across the window -> healthy even with retries
+    moving = window(({(retries, ("keygen",)): 0.0}, {(state, ()): 2.0}),
+                    ({(retries, ("keygen",)): 3.0}, {(state, ()): 3.0}))
+    assert not rule.func(moving)
+
+    # retried-but-recovered, no longer mid-ceremony -> healthy
+    idle = window(({(retries, ("keygen",)): 0.0}, {(state, ()): 0.0}),
+                  ({(retries, ("keygen",)): 3.0}, {(state, ()): 0.0}))
+    assert not rule.func(idle)
+
+    # mid-ceremony but quietly waiting at a barrier (no retries) -> healthy
+    waiting = window(({}, {(state, ()): 2.0}), ({}, {(state, ()): 2.0}))
+    assert not rule.func(waiting)

@@ -816,3 +816,47 @@ def test_vapi_rule_ignores_non_request_receivers(tmp_path):
             return f.read()
     """)
     assert findings == []
+
+
+# ---------------------------------------------------------------------------
+# LINT-FLT-011 — fault sites must be literal and registered
+# ---------------------------------------------------------------------------
+
+
+def test_flt_rule_flags_unregistered_site(tmp_path):
+    findings = lint_source(tmp_path, "ops/x.py", """\
+        from charon_tpu.utils import faults
+
+        def go():
+            faults.check("sigagg.exeucte")
+    """)
+    assert rules_of(findings) == ["LINT-FLT-011"]
+    assert "sigagg.exeucte" in findings[0].message
+    assert findings[0].line == 4
+
+
+def test_flt_rule_flags_computed_site(tmp_path):
+    findings = lint_source(tmp_path, "dkg/x.py", """\
+        from charon_tpu.utils import faults
+
+        SITE = "dkg.round"
+
+        def go(site):
+            faults.check(site)
+            faults.check("dkg." + "round")
+            faults.check()
+    """)
+    assert rules_of(findings) == ["LINT-FLT-011"] * 3
+    assert all("LITERAL" in f.message for f in findings)
+
+
+def test_flt_rule_accepts_registered_literal_sites(tmp_path):
+    findings = lint_source(tmp_path, "dkg/x.py", """\
+        from charon_tpu.utils import faults
+
+        def go(other):
+            faults.check("dkg.round")
+            faults.check("frost.msm")
+            other.check(compute_anything())  # not the faults module
+    """)
+    assert findings == []

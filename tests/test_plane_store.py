@@ -92,6 +92,48 @@ def test_chunked_verify_decodes_each_chunk_once(store, decode_spy,
     assert spans == [(0, 64), (64, 128), (128, 150)]
 
 
+def test_varying_composition_bursts_stay_hot(store, decode_spy,
+                                             monkeypatch):
+    """Regression for the full-set-digest chunk keys: ALTERNATING >TILE
+    bursts of two different peer-set compositions must coexist in the
+    cache — each set decodes its chunks once on first sight and every
+    later burst of either set is all hits. Per-chunk content keys would
+    also pass this; what they failed (round-5) was keying chunk spans by
+    `pks[s:e]` slices so overlapping compositions aliased — the full-set
+    digest in every key keeps the two sets' chunks distinct AND stable."""
+    monkeypatch.setattr(PP, "TILE", 64)
+    monkeypatch.setattr(plane_agg, "_verify_slot_jit",
+                        lambda *a, **kw: ("slot-stub",))
+
+    native = NativeImpl()
+    msg = b"\x2a" * 32
+    n = 150  # 3 chunks at TILE=64 per set
+    sets = []
+    for _tag in range(2):
+        pks, sigs = [], []
+        for _ in range(n):
+            sk = native.generate_secret_key()
+            pks.append(bytes(native.secret_to_public_key(sk)))
+            sigs.append(bytes(native.sign(sk, msg)))
+        sets.append((pks, [msg] * n, sigs))
+
+    base = store.stats()
+    for _burst in range(3):
+        for pks, msgs, sigs in sets:  # A, B, A, B, A, B
+            state = plane_agg.rlc_verify_dispatch(pks, msgs, sigs)
+            assert state[0] == "pending"
+
+    assert len(decode_spy) == 6, "3 chunks per set, first burst only"
+    s = store.stats()
+    assert s["misses"] - base["misses"] == 6
+    assert s["hits"] - base["hits"] == 12  # bursts 2+3: 2 sets x 3 chunks
+    assert s["evictions"] - base.get("evictions", 0) == 0
+
+    digests = {plane_store.PlaneStore.digest(pks) for pks, _m, _s in sets}
+    assert len(store._entries) == 6
+    assert {k[0] for k in store._entries} == digests
+
+
 def test_distinct_sets_and_buckets_key_separately(store, decode_spy):
     base = store.stats()  # hit/miss counters are process-wide (metrics)
     a, b = _pk_set(4, tag=1), _pk_set(4, tag=2)
