@@ -88,12 +88,32 @@ def _log_micro(t_slot: float, times: list[float], cpu_throughput:
 def _enable_compile_cache() -> None:
     """Persistent JAX compilation cache (utils/jaxcache): BENCH_r05 paid
     11-14 s of setup per attempt re-compiling the same fused graphs; with
-    the cache warm only the first attempt compiles."""
+    the cache warm only the first attempt compiles. The verify graphs
+    (pairing check + h2c buckets) are AOT-lowered into the same cache so
+    the first timed slot's verification doesn't trace."""
     from charon_tpu.utils import jaxcache
 
     cache = jaxcache.enable()
     if cache:
         print(f"# compile cache: {cache}", file=sys.stderr)
+    try:
+        from charon_tpu.ops import plane_agg
+
+        warmed = plane_agg.warm_verify_graphs()
+        if warmed:
+            print(f"# device verify graphs warmed: {warmed}", file=sys.stderr)
+    except Exception as exc:  # advisory — never fail the bench attempt
+        print(f"# device verify graph warm skipped: {exc}", file=sys.stderr)
+
+
+def _pairing_paths() -> dict[str, float]:
+    """The ops_pairing_total{path} device/native split for the JSON tail —
+    the trajectory's proof the host finish is actually dead (device
+    dominant; native reserved for the guard ladder)."""
+    from charon_tpu.ops import plane_agg
+
+    return {"device": plane_agg._pairing_c.value("device"),
+            "native": plane_agg._pairing_c.value("native")}
 
 
 def _phase_quantiles(
@@ -310,6 +330,8 @@ def _measure(cpu_only: bool) -> None:
         # per-shard pack/transfer quantiles — empty on a 1-device run
         "n_devices": mesh_mod.device_count(),
         "shard_phases": _phase_quantiles("ops_sigagg_shard_seconds"),
+        # verify-path split: device lanes vs the native ctypes rung
+        "pairing_paths": _pairing_paths(),
     }))
 
 
@@ -340,6 +362,7 @@ def _micro() -> None:
         "phases": phases,
         "n_devices": mesh_mod.device_count(),
         "shard_phases": _phase_quantiles("ops_sigagg_shard_seconds"),
+        "pairing_paths": _pairing_paths(),
     }))
 
 

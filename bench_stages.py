@@ -41,6 +41,14 @@ def main() -> None:
     from charon_tpu.ops import pallas_plane as PP
     from charon_tpu.ops import plane_agg as PA
 
+    try:
+        warmed = PA.warm_verify_graphs()
+        if warmed:
+            print(f"# device verify graphs warmed: {warmed}",
+                  file=sys.stderr)
+    except Exception as exc:  # advisory — never fail the profile run
+        print(f"# device verify graph warm skipped: {exc}", file=sys.stderr)
+
     native = NativeImpl()
     msg = b"\x42" * 32
     rng = random.Random(99)
@@ -200,6 +208,11 @@ def main() -> None:
         "planestore": STORE.stats(),
         "latency_quantiles": quantiles,
         "phases": phases,
+        # verify-path split across the run: device pairing lanes vs the
+        # native ctypes rung (the "ver.hash+pairing" micro-stage above is
+        # an intentional native probe and counts toward neither)
+        "pairing_paths": {"device": PA._pairing_c.value("device"),
+                          "native": PA._pairing_c.value("native")},
         "trace_file": trace_path,
         "throughput": round(N / (stages["agg.total"] + stages["ver.total"]),
                             1)}))

@@ -259,6 +259,17 @@ async def assemble(config: Config) -> App:
                         cooldown=config.breaker_cooldown_s,
                         slot_deadline=config.slot_deadline_s)
     _select_tbls_backend(config)
+    try:
+        # AOT-lower the verify graphs (pairing check + h2c buckets) into
+        # the persistent cache so the first slot's verification doesn't
+        # pay the trace; advisory — a failure here never blocks assembly
+        from ..ops import plane_agg as plane_agg_mod
+
+        warmed = plane_agg_mod.warm_verify_graphs()
+        if warmed:
+            _log.info("device verify graphs warmed", graphs=warmed)
+    except Exception as exc:
+        _log.info("device verify graph warm skipped", err=exc)
     test = config.test
     privkey_lock = None
     if test.identity is not None:

@@ -160,6 +160,15 @@ def default_checks(quorum_peers: int,
               "hung past CHARON_TPU_SLOT_DEADLINE_S and the slot was "
               "recovered down the ladder; see docs/robustness.md)",
               lambda w: w.counter_delta("ops_sigagg_watchdog_total") > 0),
+        Check("sigagg_verify_native_residual",
+              "slot verification split across paths in the window — "
+              "ops_pairing_total{path=\"native\"} moved while "
+              "path=\"device\" was also advancing, so some slots degraded "
+              "to the ctypes rung (guard verify fallback or an "
+              "over-TILE-wide pair batch; see docs/perf.md)",
+              lambda w: (w.counter_delta("ops_pairing_total", "native") > 0
+                         and w.counter_delta("ops_pairing_total",
+                                             "device") > 0)),
         Check("vapi_latency_high",
               f"validator-API route p99 above {sigagg_budget:.1f}s (a third "
               "of slot time) — the serving front door is eating the duty "

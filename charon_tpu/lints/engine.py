@@ -38,7 +38,7 @@ from pathlib import Path
 from typing import Iterable, Protocol, runtime_checkable
 
 # Bump when rule semantics change: invalidates persisted caches.
-RULES_VERSION = 7
+RULES_VERSION = 8
 
 PARSE_RULE = "LINT-PARSE-000"
 

@@ -7,7 +7,8 @@ from .exc import BroadExceptRule, GuardSeamRule
 from .flt import FaultSiteRule
 from .iface import ProtocolImplRule
 from .obs import DutySpanRule
-from .tpu import (DeviceDtypeRule, MeshTopologyRule, PipelineLockSyncRule,
+from .tpu import (DeviceDtypeRule, MeshTopologyRule,
+                  NativePairingRoutingRule, PipelineLockSyncRule,
                   PlaneStoreRoutingRule)
 from .vapi import StrictBodyRule
 
@@ -20,6 +21,7 @@ __all__ = [
     "PlaneStoreRoutingRule",
     "PipelineLockSyncRule",
     "MeshTopologyRule",
+    "NativePairingRoutingRule",
     "ProtocolImplRule",
     "DutySpanRule",
     "StrictBodyRule",
@@ -37,6 +39,7 @@ def default_rules() -> list:
         PlaneStoreRoutingRule(),
         PipelineLockSyncRule(),
         MeshTopologyRule(),
+        NativePairingRoutingRule(),
         ProtocolImplRule(),
         DutySpanRule(),
         StrictBodyRule(),

@@ -48,6 +48,19 @@ probing module and the sigagg plane can disagree about the machine. Scope
 is the WHOLE package — not just ops/tbls — because batching knobs
 (core/coalesce) and app assembly scale off the width too; `ops/mesh.py`
 itself is the sanctioned probe and is exempt.
+
+LINT-TPU-012 (NativePairingRoutingRule) — ctypes pairing/h2c stays behind
+the guard seam. Slot verification runs on the device (plane_agg.
+_pairing_finish → one batched h2c + multi-Miller-loop + final-exp
+dispatch); the native `ct_pairing_check` / `ct_hash_to_g2` entry points
+exist only as the guard's fallback rung and the h2c cache's miss path. A
+new call site anywhere else in ops/ silently regresses verification to
+serial host work — the exact ceiling the device path removed — and
+bypasses the breaker accounting and the `ops_pairing_total{path}` split
+that make such a regression visible. Sanctioned enclosing defs:
+`guard.native_pairing_check` and `plane_agg._hash_to_g2_native` (the one
+extracted miss path both cache accessors share). Other `ct_*` natives
+(decompress bulk, g1 checks) are out of scope.
 """
 
 from __future__ import annotations
@@ -427,3 +440,36 @@ class MeshTopologyRule:
                 " route through ops.mesh (sigagg_mesh/device_count) so the "
                 "CHARON_TPU_SIGAGG_DEVICES clamp applies and every slot "
                 "shares the one cached Mesh")
+
+
+_NATIVE_PAIRING_CALLS = ("ct_pairing_check", "ct_hash_to_g2")
+# the ONLY defs allowed to touch the native pairing/h2c entry points: the
+# guard ladder's native rung and the h2c cache's shared miss path
+_PAIRING_SANCTIONED_DEFS = ("native_pairing_check", "_hash_to_g2_native")
+
+
+class NativePairingRoutingRule:
+    id = "LINT-TPU-012"
+    description = ("ctypes ct_pairing_check/ct_hash_to_g2 in ops/ are only "
+                   "sanctioned inside guard.native_pairing_check and the "
+                   "h2c cache miss path (_hash_to_g2_native) — anywhere "
+                   "else silently regresses verification to serial host")
+
+    def check(self, src: SourceFile) -> Iterable[Finding]:
+        if not src.in_dir("ops"):
+            return
+        for node in ast.walk(src.tree):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in _NATIVE_PAIRING_CALLS):
+                continue
+            encl = PlaneStoreRoutingRule._enclosing_defs(src, node)
+            if any(n in _PAIRING_SANCTIONED_DEFS for n in encl):
+                continue
+            yield Finding(
+                src.rel, node.lineno, self.id,
+                f"`{node.func.attr}` outside the sanctioned native rung "
+                "(guard.native_pairing_check / plane_agg._hash_to_g2_native)"
+                " silently bypasses the device verify path; route through "
+                "plane_agg._pairing_finish so the guard ladder and the "
+                "ops_pairing_total path split see the work")

@@ -316,6 +316,33 @@ def note_ceremony_fallback(reason: str, exc: BaseException | None = None
               err=exc)
 
 
+def note_verify_fallback(reason: str, exc: BaseException | None = None
+                         ) -> None:
+    """Verify-phase analogue of the ladder's native rung: the slot's
+    batched device pairing check (plane_agg._device_pairing_check) failed
+    device-class and the caller is re-running the same verdict through
+    native ct_pairing_check. Feeds the breaker and the
+    `ops_sigagg_fallback_total{reason,native}` counter so a chip lost
+    mid-verify shows up exactly like one lost mid-aggregation."""
+    BREAKER.record_failure()
+    _fallback_c.inc(reason, "native")
+    _log.warn("pairing verify degraded to native rung", reason=reason,
+              err=exc)
+
+
+def native_pairing_check(g1_cat: bytes, g2_cat: bytes, negs: bytes) -> bool:
+    """The native multi-pairing rung: Π e(Pᵢ, Qᵢ^±1) == 1 over compressed
+    point bytes via ctypes into native/bls12381.cpp. This is the ONE
+    sanctioned ct_pairing_check call site in ops/ (LINT-TPU-012); every
+    verify path that leaves the device funnels through here. Subgroup
+    re-checks are skipped — callers pass already-validated points."""
+    from . import plane_agg as PA
+
+    rc = PA._native_lib().ct_pairing_check(g1_cat, g2_cat, negs,
+                                           len(negs), 0)
+    return rc == 1
+
+
 def _primary_width() -> int:
     from . import mesh as mesh_mod
 

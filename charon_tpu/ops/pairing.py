@@ -261,3 +261,89 @@ def verify_batch_device(pubkeys_affine, h2c_affine, sigs_affine) -> np.ndarray:
     ok = kernel(jnp.asarray(pk_x), jnp.asarray(pk_y), jnp.asarray(h_x),
                 jnp.asarray(h_y), jnp.asarray(s_x), jnp.asarray(s_y))
     return np.asarray(ok)[:B]
+
+
+# ---------------------------------------------------------------------------
+# RLC-folded multi-pairing check kernel (the production finish path)
+# ---------------------------------------------------------------------------
+#
+# plane_agg._pairing_finish verifies one slot as Π e(Pᵢ, Qᵢ) == 1 over a
+# handful of pairs (one per distinct message plus the (−g1, S) signature
+# pair). The kernel runs every pair's Miller loop on its own batch lane,
+# tree-folds the per-lane f values into one Fq12 product, and runs a
+# SINGLE final exponentiation on the product — final_exp(Π fᵢ) == 1 is the
+# multi-pairing check (conjugation for the negative parameter commutes
+# with the product). Negations ride in the caller's G1 y-coordinates.
+
+
+def _fq12_slice(f, a: int, b: int):
+    return (tuple(c[a:b] for c in f[0]), tuple(c[a:b] for c in f[1]))
+
+
+def _fq12_fold_product(f, batch: int):
+    """Pairwise tree product over a power-of-two batch axis -> batch 1."""
+    while batch > 1:
+        half = batch // 2
+        f = T.fq12_mul(_fq12_slice(f, 0, half), _fq12_slice(f, half, batch))
+        batch = half
+    return f
+
+
+@functools.lru_cache(maxsize=8)
+def _compiled_pairing_check(batch: int):
+    @jax.jit
+    def kernel(p_x, p_y, q_x, q_y, mask):
+        f = miller_loop_pairs([(p_x, p_y)], [(q_x, q_y)])
+        f = _select_fq12(mask, f, T.fq12_one_like(q_x))
+        return final_exp_is_one(_fq12_fold_product(f, batch))
+
+    return kernel
+
+
+def _bucket_pairs(n: int) -> int:
+    b = 2
+    while b < n:
+        b *= 2
+    return b
+
+
+def pairing_check_planes(p_x, p_y, q_x, q_y) -> bool:
+    """Π e(Pᵢ, Qᵢ) == 1 over Montgomery limb planes: p_* are (n, L) affine
+    G1 coordinates, q_* are (n, 2, L) affine G2 twist coordinates, all
+    non-infinity (degenerate pairs are the caller's host-side contract —
+    see plane_agg._pairing_finish). Pads to the power-of-two bucket with
+    masked repeats of lane 0."""
+    n = p_x.shape[0]
+    if n == 0:
+        return True
+    Bp = _bucket_pairs(n)
+
+    def pad(a):
+        if Bp == n:
+            return a
+        return np.concatenate([a, np.repeat(a[:1], Bp - n, axis=0)])
+
+    mask = np.zeros(Bp, dtype=bool)
+    mask[:n] = True
+    kernel = _compiled_pairing_check(Bp)
+    ok = kernel(jnp.asarray(pad(np.asarray(p_x))),
+                jnp.asarray(pad(np.asarray(p_y))),
+                jnp.asarray(pad(np.asarray(q_x))),
+                jnp.asarray(pad(np.asarray(q_y))),
+                jnp.asarray(mask))
+    return bool(np.asarray(ok).reshape(-1)[0])
+
+
+def warm_check_buckets(buckets=(2,)) -> int:
+    """Ahead-of-time compile the bucketed multi-pairing check graphs into
+    jax's (persistent) compile cache without executing them. Returns the
+    number of graphs lowered."""
+    L = F.LIMBS
+    n = 0
+    for b in buckets:
+        fq = jax.ShapeDtypeStruct((b, L), jnp.int32)
+        fq2 = jax.ShapeDtypeStruct((b, 2, L), jnp.int32)
+        m = jax.ShapeDtypeStruct((b,), jnp.bool_)
+        _compiled_pairing_check(b).lower(fq, fq, fq2, fq2, m).compile()
+        n += 1
+    return n

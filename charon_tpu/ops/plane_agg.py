@@ -61,14 +61,17 @@ _MONT_ONE = F.fq_from_int(1)
 # Dispatch-phase latency split of the fused sigagg slot: "pack" is host
 # parse + async dispatch (_fused_dispatch), "execute" is the explicit
 # block_until_ready fence on the device graph, "drain" is the readback
-# transfer after the fence, and "finish" is the pure-host back half (emit
-# bytes + RLC folds + hash-to-curve + multi-pairing, _fused_host_finish) —
-# the stage the pipeline overlaps on its worker executor. Sub-second
-# buckets — a steady-state slot is ~0.1-0.3 s end to end.
+# transfer after the fence, "finish" is the pure-host back half (emit
+# bytes + RLC folds, _fused_host_finish) and "verify" is the slot's
+# RLC-folded pairing check (_pairing_finish — one batched device dispatch
+# of h2c + multi-Miller-loop + final-exp on the device path, the ctypes
+# native rung behind the guard otherwise). finish/verify are the stages
+# the pipeline overlaps on its worker executor. Sub-second buckets — a
+# steady-state slot is ~0.1-0.3 s end to end.
 _dispatch_hist = metrics.histogram(
     "ops_device_dispatch_seconds",
     "Fused sigagg dispatch phases: host pack, device execute, drain-side "
-    "readback transfer, host finish", ("phase",),
+    "readback transfer, host finish, pairing verify", ("phase",),
     buckets=(0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25,
              0.5, 1, 2.5, 5))
 
@@ -133,6 +136,34 @@ def _device_path(n: int = 1 << 30) -> bool:
     (tests/test_plane_agg_interp.py) — the exact code the driver benches
     must never be green-in-CI yet crash-at-bench."""
     return not PP._interpret() and n >= 64
+
+
+# Verification pairs fed to each multi-pairing path: "device" is the
+# batched TPU Miller loop + final exponentiation (ops/pairing), "native"
+# the ctypes ct_pairing_check rung behind the guard — the same
+# path-attribution shape as dkg_msm_total.
+_pairing_c = metrics.counter(
+    "ops_pairing_total",
+    "Multi-pairing verification pairs by execution path: device = batched "
+    "TPU Miller loop + final exp, native = ctypes ct_pairing_check (guard "
+    "fallback rung / hosts without an accelerator)", ("path",))
+
+# Largest pair batch the device verify takes in one dispatch — same
+# TILE-derived bound as the h2c bucket family; a slot with more distinct
+# messages than a whole plane tile goes native.
+_MAX_DEVICE_PAIRS = PP.TILE
+
+
+def _verify_device_path() -> bool:
+    """Whether _pairing_finish runs the slot verification on device.
+    CHARON_TPU_DEVICE_VERIFY=0/1 forces it off/on (tests, triage);
+    otherwise it follows the plane: real chip yes, interpret mode no (the
+    pairing graph costs minutes of XLA:CPU compile — the exact hazard
+    tests/test_device_pairing.py slow-gates)."""
+    env = os.environ.get("CHARON_TPU_DEVICE_VERIFY")
+    if env is not None:
+        return env not in ("", "0", "false")
+    return not PP._interpret()
 
 
 # ---------------------------------------------------------------------------
@@ -906,13 +937,13 @@ def _fused_readback(state, span=None):
 
 
 def _fused_host_finish(hstate, hash_fn=None):
-    """Stage 3, pure host — no device handles left: validity check, bulk
-    byte emission, RLC host folds, hash-to-curve and the native
-    multi-pairing. The heavy parts (numpy byte assembly, ctypes
-    ct_hash_to_g2/ct_pairing_check) release the GIL, so the pipeline runs
-    this on a worker thread overlapping the next slot's pack and the
-    in-flight device execute. The whole body is the "finish" phase of
-    ops_device_dispatch_seconds."""
+    """Stage 3 — validity check, bulk byte emission and RLC host folds
+    (the "finish" phase of ops_device_dispatch_seconds), then the slot's
+    pairing verification (the separately-timed "verify" phase: one
+    batched device dispatch, native ctypes rung behind the guard). The
+    heavy parts release the GIL, so the pipeline runs this on a worker
+    thread overlapping the next slot's pack and the in-flight device
+    execute."""
     faults.check("sigagg.finish")
     if hstate[0].startswith("sharded"):
         from . import sharded_plane
@@ -933,7 +964,9 @@ def _fused_host_finish(hstate, hash_fn=None):
         S = PP._host_fold(*sig_red, 2)
         pts = [(m, _unembed_g1(PP._host_fold(*pk_reds[g], 2)))
                for g, m in enumerate(group_msgs)]
-        return out, _pairing_finish(S, pts, hash_fn)
+    # _pairing_finish times itself as the "verify" phase — keeping it out
+    # of the "finish" window is what makes the two separately attributable
+    return out, _pairing_finish(S, pts, hash_fn)
 
 
 # Pipeline knobs (overridable per instance). Depth 2 = classic double
@@ -1872,7 +1905,11 @@ def _rlc_finish(state, hash_fn=None) -> bool:
 
 _H2C_CAP = int(os.environ.get("CHARON_TPU_H2C_CACHE_CAP", "4096"))
 _h2c_lock = threading.Lock()
-_h2c_cache: OrderedDict = OrderedDict()  # msg bytes -> 96-byte compressed
+# msg bytes -> [96-byte compressed, (hx, hy) affine limb planes | None].
+# The compressed form feeds the native fallback rung; the limb planes are
+# what the device pairing kernel consumes — hits hand them back directly
+# instead of re-decompressing 96 bytes per verify.
+_h2c_cache: OrderedDict = OrderedDict()
 _h2c_counter = metrics.counter(
     "ops_hash_to_g2_cache_total",
     "H(m) hash-to-curve cache lookups in _pairing_finish", ("result",))
@@ -1889,6 +1926,29 @@ def set_h2c_cache_cap(cap: int) -> int:
     return prev
 
 
+def _h2c_store(key: bytes, comp: bytes, planes) -> None:
+    with _h2c_lock:
+        if _H2C_CAP <= 0:
+            return
+        entry = _h2c_cache.get(key)
+        if entry is None:
+            _h2c_cache[key] = [comp, planes]
+        elif planes is not None and entry[1] is None:
+            entry[1] = planes
+        _h2c_cache.move_to_end(key)
+        while len(_h2c_cache) > _H2C_CAP:
+            _h2c_cache.popitem(last=False)
+
+
+def _hash_to_g2_native(key: bytes) -> bytes:
+    """The cache's native miss path, extracted so the bytes and planes
+    accessors share it: compressed H(m) via ctypes ct_hash_to_g2. This is
+    the ONE sanctioned ct_hash_to_g2 call site in ops/ (LINT-TPU-012)."""
+    out96 = (ctypes.c_uint8 * 96)()
+    _native_lib().ct_hash_to_g2(key, len(key), out96)
+    return bytes(out96)
+
+
 def hash_to_g2_cached(m: bytes) -> bytes:
     """Compressed H(m) through the bounded LRU; native ct_hash_to_g2 on a
     miss. Thread-safe — stage-3 finish workers and API verify threads
@@ -1896,56 +1956,199 @@ def hash_to_g2_cached(m: bytes) -> bytes:
     sides store the identical bytes)."""
     key = bytes(m)
     with _h2c_lock:
-        out = _h2c_cache.get(key)
-        if out is not None:
+        entry = _h2c_cache.get(key)
+        if entry is not None:
             _h2c_cache.move_to_end(key)
-    if out is not None:
+    if entry is not None:
         _h2c_counter.inc("hit")
-        return out
+        return entry[0]
     _h2c_counter.inc("miss")
-    out96 = (ctypes.c_uint8 * 96)()
-    _native_lib().ct_hash_to_g2(key, len(key), out96)
-    out = bytes(out96)
-    with _h2c_lock:
-        if _H2C_CAP > 0:
-            _h2c_cache[key] = out
-            _h2c_cache.move_to_end(key)
-            while len(_h2c_cache) > _H2C_CAP:
-                _h2c_cache.popitem(last=False)
+    out = _hash_to_g2_native(key)
+    _h2c_store(key, out, None)
     return out
 
 
-def _pairing_finish(S, group_points, hash_fn=None) -> bool:
-    """Multi-pairing over host Jacobians: S = Σ rᵢ·sigᵢ (G2) and per
-    distinct message m its P_m = Σ rᵢ·pkᵢ (G1). H(m) comes from the
-    process-wide hash_to_g2_cached unless the caller injects hash_fn."""
+def _planes_from_compressed(comp: bytes):
+    """Host decompress of a cached 96-byte H(m) into affine limb planes —
+    the plane-less entry upgrade path (entries first filled by the native
+    bytes accessor). The point was produced by hash-to-curve, so the
+    subgroup re-check is skipped."""
+    from ..crypto.curve import to_affine
+    from ..crypto.serialize import g2_from_bytes
+
+    aff = to_affine(Fq2Ops, g2_from_bytes(comp, subgroup_check=False))
+    return (F.fq2_from_ints(*aff[0]).astype(np.int32),
+            F.fq2_from_ints(*aff[1]).astype(np.int32))
+
+
+def hash_to_g2_planes(msgs):
+    """Device-ready affine H(m) limb planes for a message batch: (hx, hy)
+    numpy arrays of shape (B, 2, L). Cache hits (including plane-less
+    entries stored by the bytes accessor, upgraded in place) count as
+    "hit"; misses compute the hash — ONE bucketed device h2c dispatch for
+    the whole miss set when the device verify path is up, the native
+    bytes rung plus host decompress otherwise — and store both forms."""
+    from ..crypto.serialize import g2_affine_to_bytes
+
+    B = len(msgs)
+    L = F.LIMBS
+    hx = np.zeros((B, 2, L), np.int32)
+    hy = np.zeros((B, 2, L), np.int32)
+    derive: list[tuple[int, bytes, bytes]] = []   # (idx, key, compressed)
+    missing: list[tuple[int, bytes]] = []
+    with _h2c_lock:
+        for i, m in enumerate(msgs):
+            key = bytes(m)
+            entry = _h2c_cache.get(key)
+            if entry is None:
+                missing.append((i, key))
+                continue
+            _h2c_cache.move_to_end(key)
+            if entry[1] is None:
+                derive.append((i, key, entry[0]))
+            else:
+                hx[i], hy[i] = entry[1]
+    if B - len(missing):
+        _h2c_counter.inc("hit", amount=float(B - len(missing)))
+    for i, key, comp in derive:
+        planes = _planes_from_compressed(comp)
+        hx[i], hy[i] = planes
+        _h2c_store(key, comp, planes)
+    if not missing:
+        return hx, hy
+    _h2c_counter.inc("miss", amount=float(len(missing)))
+    if _verify_device_path():
+        from . import h2c as h2c_mod
+
+        mx, my = h2c_mod.hash_to_g2_device([k for _, k in missing])
+        for j, (i, key) in enumerate(missing):
+            planes = (mx[j], my[j])
+            hx[i], hy[i] = planes
+            aff = (F.fq2_to_ints(mx[j]), F.fq2_to_ints(my[j]))
+            _h2c_store(key, g2_affine_to_bytes(aff), planes)
+    else:
+        for i, key in missing:
+            comp = _hash_to_g2_native(key)
+            planes = _planes_from_compressed(comp)
+            hx[i], hy[i] = planes
+            _h2c_store(key, comp, planes)
+    return hx, hy
+
+
+def _device_pairing_check(S, live) -> bool:
+    """One batched device dispatch for a slot's verification: H(m) limb
+    planes from the upgraded cache (bucketed device h2c on the miss set),
+    every pair's Miller loop on its own batch lane, a single final
+    exponentiation on the RLC-folded Fq12 product. The signature pair
+    rides as (−g1, S) — negation folded into the G1 y-coordinate. Shards
+    the pair axis across the mesh when one is up."""
+    from ..crypto.curve import to_affine
+    from . import pairing as pairing_mod
+
+    L = F.LIMBS
+    n = len(live) + 1
+    p_x = np.empty((n, L), np.int32)
+    p_y = np.empty((n, L), np.int32)
+    q_x = np.empty((n, 2, L), np.int32)
+    q_y = np.empty((n, 2, L), np.int32)
+    q_x[:n - 1], q_y[:n - 1] = hash_to_g2_planes([m for m, _ in live])
+    for i, (_m, P) in enumerate(live):
+        ax, ay = to_affine(FqOps, P)
+        p_x[i] = F.fq_from_int(ax)
+        p_y[i] = F.fq_from_int(ay)
+    p_x[-1] = F.fq_from_int(pairing_mod._G1_NEG[0])
+    p_y[-1] = F.fq_from_int(pairing_mod._G1_NEG[1])
+    sx, sy = to_affine(Fq2Ops, S)
+    q_x[-1] = F.fq2_from_ints(*sx)
+    q_y[-1] = F.fq2_from_ints(*sy)
+
+    from . import mesh as mesh_mod
+
+    mesh = mesh_mod.sigagg_mesh()
+    if mesh is not None:
+        from . import sharded_plane
+
+        return sharded_plane.sharded_pairing_check(p_x, p_y, q_x, q_y, mesh)
+    return pairing_mod.pairing_check_planes(p_x, p_y, q_x, q_y)
+
+
+def _native_pairing_finish(S, live, hash_fn=None) -> bool:
+    """The verify ladder's native rung: compressed-byte pairs through the
+    guard's ctypes multi-pairing seam — same verdicts as the device path,
+    reached on interpret hosts, guard fallback, or a custom hash_fn."""
     g1_pts, g2_pts, negs = [], [], []
-    for m, P in group_points:
-        if jac_is_infinity(FqOps, P):
-            # degenerate pk combination: only consistent with S lacking any
-            # contribution from this group — the pairing check below still
-            # has to balance, so simply omit the vanished pair
-            continue
+    for m, P in live:
         g1_pts.append(g1_to_bytes(P))
         if hash_fn is None:
             g2_pts.append(hash_to_g2_cached(m))
         else:
             g2_pts.append(g2_to_bytes(hash_fn(m)))
         negs.append(0)
-
-    if jac_is_infinity(Fq2Ops, S):
-        # all signatures were infinity: valid only if every pk side vanished
-        return not g1_pts
     g1_pts.append(g1_to_bytes(g1_generator()))
     g2_pts.append(g2_to_bytes(S))
     negs.append(1)
+    _pairing_c.inc("native", amount=float(len(negs)))
+    from . import guard
 
-    lib = _native_lib()
     # inputs here are derived from already-validated points — skip the
     # per-pair subgroup scalar-muls inside the pairing decode
-    rc = lib.ct_pairing_check(b"".join(g1_pts), b"".join(g2_pts),
-                              bytes(negs), len(negs), 0)
-    return rc == 1
+    return guard.native_pairing_check(
+        b"".join(g1_pts), b"".join(g2_pts), bytes(negs))
+
+
+def _pairing_finish(S, group_points, hash_fn=None) -> bool:
+    """Multi-pairing over host Jacobians: S = Σ rᵢ·sigᵢ (G2) and per
+    distinct message m its P_m = Σ rᵢ·pkᵢ (G1). The whole check is the
+    "verify" phase of ops_device_dispatch_seconds: one batched device
+    dispatch (h2c + multi-Miller-loop + final exp) on the device path,
+    degrading through guard.note_verify_fallback to the native
+    ct_pairing_check rung on a device-class failure — same verdicts
+    either way, split by ops_pairing_total{path}. A caller-injected
+    hash_fn (test paths) always takes the native rung."""
+    with _dispatch_hist.observe_time("verify"):
+        live = []
+        for m, P in group_points:
+            if jac_is_infinity(FqOps, P):
+                # degenerate pk combination: only consistent with S lacking
+                # any contribution from this group — the pairing check below
+                # still has to balance, so simply omit the vanished pair
+                continue
+            live.append((bytes(m), P))
+        if jac_is_infinity(Fq2Ops, S):
+            # all signatures were infinity: valid only if every pk side
+            # vanished too
+            return not live
+        if (hash_fn is None and len(live) + 1 <= _MAX_DEVICE_PAIRS
+                and _verify_device_path()):
+            from . import guard
+
+            if guard.BREAKER.state != guard.OPEN:
+                try:
+                    ok = _device_pairing_check(S, live)
+                except Exception as exc:  # degrade to the native rung
+                    reason = guard.classify(exc)
+                    if reason == "input":
+                        raise
+                    guard.note_verify_fallback(reason, exc)
+                else:
+                    _pairing_c.inc("device", amount=float(len(live) + 1))
+                    return ok
+        return _native_pairing_finish(S, live, hash_fn)
+
+
+def warm_verify_graphs() -> int:
+    """AOT-compile the device verify graphs (pairing-check buckets + the
+    batch-1 h2c bucket) into the persistent JAX compile cache so the
+    first production slot doesn't eat the trace. No-op (returns 0) when
+    the device verify path is off; callers treat failures as advisory."""
+    if not _verify_device_path():
+        return 0
+    from . import h2c as h2c_mod
+    from . import pairing as pairing_mod
+
+    n = pairing_mod.warm_check_buckets((2,))
+    n += h2c_mod.warm_buckets((1,))
+    return n
 
 
 def _rlc_check(sig_plane: PP.PlanePoint, pk_plane: PP.PlanePoint,

@@ -366,12 +366,14 @@ def sharded_readback(state, span=None):
 
 
 def sharded_host_finish(hstate, hash_fn=None):
-    """Stage 3, pure host — validity check, per-chunk byte emission, RLC
-    host folds and the native multi-pairing (the "finish" phase; the
-    heavy parts release the GIL so the pipeline's stage-3 workers overlap
-    it with the next slot's pack and the in-flight execute). bad_pk
-    degrades exactly like the single-device path: aggregates computed,
-    all_valid=False."""
+    """Stage 3 — validity check, per-chunk byte emission and RLC host
+    folds (the "finish" phase), then the slot's pairing verification
+    through PA._pairing_finish (the separately-timed "verify" phase,
+    itself sharded over the mesh via sharded_pairing_check when one is
+    up). The heavy parts release the GIL so the pipeline's stage-3
+    workers overlap them with the next slot's pack and the in-flight
+    execute. bad_pk degrades exactly like the single-device path:
+    aggregates computed, all_valid=False."""
     if hstate[0] == "sharded_empty":
         return [], True
     if hstate[0] == "sharded_bad_pk":
@@ -394,7 +396,9 @@ def sharded_host_finish(hstate, hash_fn=None):
         S = PP._host_fold(SX, SY, SZ, 2)
         pts = [(m, PA._unembed_g1(PP._host_fold(PX[g], PY[g], PZ[g], 2)))
                for g, m in enumerate(group_keys)]
-        return out, PA._pairing_finish(S, pts, hash_fn)
+    # _pairing_finish times itself as the "verify" phase — kept out of the
+    # "finish" window so the two stay separately attributable
+    return out, PA._pairing_finish(S, pts, hash_fn)
 
 
 def threshold_aggregate_and_verify_sharded(
@@ -411,3 +415,79 @@ def threshold_aggregate_and_verify_sharded(
 
     state = sharded_dispatch(batches, pks, msgs, mesh, rs=rs)
     return guard.finish_slot(state, (batches, pks, msgs), hash_fn)
+
+
+@functools.lru_cache(maxsize=8)
+def _build_verify_step(mesh, Bd: int):
+    """The sharded multi-pairing check jit, cached per (mesh, per-device
+    bucket): each device Miller-loops its Bd pair lanes and tree-folds
+    them into one local Fq12 partial; the partials are all_gather'd (tiny
+    — 12 Fq elements per device) and folded in-graph, and the single
+    final exponentiation runs on the replicated product. Same verdict as
+    pairing._compiled_pairing_check on one chip."""
+    try:  # jax >= 0.6 promoted shard_map to the top level
+        from jax import shard_map
+    except ImportError:  # jax 0.4.x: experimental module, `check_rep` kwarg
+        from jax.experimental.shard_map import shard_map as _exp_shard_map
+
+        def shard_map(f, *, mesh, in_specs, out_specs, check_vma=True):
+            return _exp_shard_map(f, mesh=mesh, in_specs=in_specs,
+                                  out_specs=out_specs, check_rep=check_vma)
+    from jax.sharding import PartitionSpec as P
+
+    from . import pairing as pairing_mod
+    from . import tower as TW
+
+    D = mesh.devices.size
+
+    def _local_check(p_x, p_y, q_x, q_y, mask):
+        f = pairing_mod.miller_loop_pairs([(p_x, p_y)], [(q_x, q_y)])
+        f = pairing_mod._select_fq12(mask, f, TW.fq12_one_like(q_x))
+        f = pairing_mod._fq12_fold_product(f, Bd)
+        g = jax.lax.all_gather(f, "data")
+        parts = [(tuple(c[d] for c in g[0]), tuple(c[d] for c in g[1]))
+                 for d in range(D)]
+        while len(parts) > 1:
+            nxt = [TW.fq12_mul(parts[k], parts[k + 1])
+                   for k in range(0, len(parts) - 1, 2)]
+            if len(parts) % 2:
+                nxt.append(parts[-1])
+            parts = nxt
+        return pairing_mod.final_exp_is_one(parts[0])
+
+    return jax.jit(shard_map(
+        _local_check, mesh=mesh,
+        in_specs=(P("data"),) * 5,
+        out_specs=P(),
+        check_vma=False,
+    ))
+
+
+def sharded_pairing_check(p_x, p_y, q_x, q_y, mesh) -> bool:
+    """Π e(Pᵢ, Qᵢ) == 1 with the pair axis sharded over mesh axis "data"
+    — the mesh-wide analogue of pairing.pairing_check_planes (same plane
+    layout, same masked lane-0 padding, same verdict). Pads the pair axis
+    to D · Bd so every device gets an equal power-of-two bucket; for a
+    typical slot (a handful of messages) each device Miller-loops two
+    lanes and the collective moves one Fq12 per chip."""
+    from . import pairing as pairing_mod
+
+    n = p_x.shape[0]
+    if n == 0:
+        return True
+    D = mesh.devices.size
+    Bd = pairing_mod._bucket_pairs(-(-n // D))
+    total = D * Bd
+
+    def pad(a):
+        a = np.asarray(a)
+        if total == n:
+            return jnp.asarray(a)
+        return jnp.asarray(
+            np.concatenate([a, np.repeat(a[:1], total - n, axis=0)]))
+
+    mask = np.zeros(total, dtype=bool)
+    mask[:n] = True
+    kernel = _build_verify_step(mesh, Bd)
+    ok = kernel(pad(p_x), pad(p_y), pad(q_x), pad(q_y), jnp.asarray(mask))
+    return bool(np.asarray(ok).reshape(-1)[0])

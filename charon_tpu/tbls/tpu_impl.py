@@ -10,12 +10,16 @@ Routes the duty pipeline's hot calls onto the fused Pallas kernel plane
     serialization (the cross-implementation randomized suite, reference
     tbls/tbls_test.go:210-240, holds across the triple).
   * verify_batch — random-linear-combination batch verification: device
-    G1/G2 MSMs with 64-bit coefficients + one native multi-pairing
-    (reference hot loops: per-partial tbls.Verify in
-    core/parsigex/parsigex.go:61 and the aggregate verify in
-    core/sigagg/sigagg.go:159). Sound to 2⁻⁶⁴ per batch (eth2-client
-    batch-verification practice, blst mult-verify); a False means at least one
-    bad signature and callers attribute per-item.
+    G1/G2 MSMs with 64-bit coefficients, then the folded multi-pairing
+    check itself on device — hash-to-curve (ops/h2c.py), per-pair Miller
+    loops and one final exponentiation in a single batched dispatch
+    (plane_agg._pairing_finish), with the native ctypes ct_pairing_check
+    kept as the guard's fallback rung (reference hot loops: per-partial
+    tbls.Verify in core/parsigex/parsigex.go:61 and the aggregate verify
+    in core/sigagg/sigagg.go:159). Sound to 2⁻⁶⁴ per batch (eth2-client
+    batch-verification practice, blst mult-verify); a False means at least
+    one bad signature and callers attribute per-item. Path split is
+    observable as ops_pairing_total{path}.
 
 Everything else (keygen, split/recover, sign, single verify) delegates to
 the native C++ backend — key material never rides this backend's device

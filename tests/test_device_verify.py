@@ -1,0 +1,360 @@
+"""Coverage for the device verify path: the production `_pairing_finish`
+routing (device dispatch → guard fallback → native rung), the upgraded
+H(m) plane cache, and — behind the same RUN_SLOW_PAIRING gate as
+tests/test_device_pairing.py — the device hash-to-curve against the
+RFC 9380 vectors plus the device/native verdict oracle cross-check.
+
+The routing tests monkeypatch `_device_pairing_check` so they run on the
+tier-1 CPU backend without compiling the pairing kernel; the slow suite
+exercises the real kernels end to end.
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+import pytest
+
+from charon_tpu.crypto import curve as PC
+from charon_tpu.crypto import fields as PF
+from charon_tpu.crypto.curve import Fq2Ops, FqOps, jac_infinity, to_affine
+from charon_tpu.crypto.hash_to_curve import DST_ETH, hash_to_g2
+from charon_tpu.ops import field as DF
+from charon_tpu.ops import guard
+from charon_tpu.ops import plane_agg as PA
+
+_PAIRING_FAST = getattr(DF, "SCAN_FREE_CARRIES", False)
+_RUN_SLOW = os.environ.get("RUN_SLOW_PAIRING") == "1" or _PAIRING_FAST
+
+slow_pairing = pytest.mark.skipif(
+    not _RUN_SLOW,
+    reason="pairing/h2c kernels: CPU compile is minutes; "
+           "set RUN_SLOW_PAIRING=1")
+
+
+def _keypair(seed: int):
+    import random
+
+    k = random.Random(seed).randrange(1, PF.R)
+    return k, PC.jac_mul(FqOps, PC.g1_generator(), k)
+
+
+def _signed(seed: int, msg: bytes):
+    """(pk, S) for a valid single-signer fixture over msg."""
+    k, pk = _keypair(seed)
+    return pk, PC.jac_mul(Fq2Ops, hash_to_g2(msg, DST_ETH), k)
+
+
+@pytest.fixture
+def clean_verify_state(monkeypatch):
+    """Fresh breaker + forced-on device verify path for routing tests."""
+    guard.reset_for_testing()
+    monkeypatch.setenv("CHARON_TPU_DEVICE_VERIFY", "1")
+    yield
+    guard.reset_for_testing()
+
+
+# ---------------------------------------------------------------------------
+# Routing (tier-1 safe: the device rung is monkeypatched)
+# ---------------------------------------------------------------------------
+
+
+def test_verify_device_path_env_override(monkeypatch):
+    monkeypatch.setenv("CHARON_TPU_DEVICE_VERIFY", "1")
+    assert PA._verify_device_path() is True
+    monkeypatch.setenv("CHARON_TPU_DEVICE_VERIFY", "0")
+    assert PA._verify_device_path() is False
+    monkeypatch.setenv("CHARON_TPU_DEVICE_VERIFY", "")
+    assert PA._verify_device_path() is False
+
+
+def test_pairing_finish_device_rung_and_counter(clean_verify_state,
+                                                monkeypatch):
+    msg = b"route-device"
+    pk, S = _signed(11, msg)
+    seen = {}
+
+    def fake_check(S_in, live):
+        seen["pairs"] = len(live) + 1
+        return True
+
+    monkeypatch.setattr(PA, "_device_pairing_check", fake_check)
+    dev0 = PA._pairing_c.value("device")
+    nat0 = PA._pairing_c.value("native")
+    assert PA._pairing_finish(S, [(msg, pk)]) is True
+    assert seen["pairs"] == 2
+    assert PA._pairing_c.value("device") == dev0 + 2
+    assert PA._pairing_c.value("native") == nat0
+
+
+def test_pairing_finish_times_verify_phase(clean_verify_state, monkeypatch):
+    from charon_tpu.utils import metrics
+
+    monkeypatch.setattr(PA, "_device_pairing_check", lambda S, live: True)
+    msg = b"verify-phase"
+    pk, S = _signed(12, msg)
+
+    def verify_count():
+        for name, stats in metrics.snapshot_quantiles(
+                "ops_device_dispatch_seconds").items():
+            if 'phase="verify"' in name:
+                return stats["count"]
+        return 0
+
+    before = verify_count()
+    PA._pairing_finish(S, [(msg, pk)])
+    assert verify_count() == before + 1
+
+
+def test_pairing_finish_device_failure_degrades_native(clean_verify_state,
+                                                       monkeypatch):
+    msg = b"degrade-me"
+    pk, S = _signed(13, msg)
+
+    def boom(S_in, live):
+        raise RuntimeError("simulated XLA failure")
+
+    monkeypatch.setattr(PA, "_device_pairing_check", boom)
+    nat0 = PA._pairing_c.value("native")
+    fb0 = guard._fallback_c.value("error", "native")
+    assert PA._pairing_finish(S, [(msg, pk)]) is True  # same verdict
+    assert PA._pairing_c.value("native") == nat0 + 2
+    assert guard._fallback_c.value("error", "native") == fb0 + 1
+
+
+def test_pairing_finish_input_error_propagates(clean_verify_state,
+                                               monkeypatch):
+    msg = b"bad-input"
+    pk, S = _signed(14, msg)
+
+    def bad(S_in, live):
+        raise ValueError("malformed point")
+
+    monkeypatch.setattr(PA, "_device_pairing_check", bad)
+    with pytest.raises(ValueError):
+        PA._pairing_finish(S, [(msg, pk)])
+
+
+def test_pairing_finish_open_breaker_skips_device(clean_verify_state,
+                                                  monkeypatch):
+    msg = b"breaker-open"
+    pk, S = _signed(15, msg)
+    for _ in range(10):
+        guard.BREAKER.record_failure()
+    assert guard.BREAKER.state == guard.OPEN
+
+    def never(S_in, live):  # pragma: no cover - must not run
+        raise AssertionError("device rung dispatched with an open breaker")
+
+    monkeypatch.setattr(PA, "_device_pairing_check", never)
+    nat0 = PA._pairing_c.value("native")
+    assert PA._pairing_finish(S, [(msg, pk)]) is True
+    assert PA._pairing_c.value("native") == nat0 + 2
+
+
+def test_pairing_finish_custom_hash_fn_stays_native(clean_verify_state,
+                                                    monkeypatch):
+    msg = b"custom-hash"
+    k, pk = _keypair(16)
+    H = hash_to_g2(msg, b"OTHER-DST")
+    S = PC.jac_mul(Fq2Ops, H, k)
+
+    def never(S_in, live):  # pragma: no cover - must not run
+        raise AssertionError("custom hash_fn must take the native rung")
+
+    monkeypatch.setattr(PA, "_device_pairing_check", never)
+    ok = PA._pairing_finish(S, [(msg, pk)],
+                            hash_fn=lambda m: hash_to_g2(m, b"OTHER-DST"))
+    assert ok is True
+
+
+def test_pairing_finish_degenerate_semantics(clean_verify_state, monkeypatch):
+    monkeypatch.setattr(PA, "_device_pairing_check", lambda S, live: True)
+    inf_g1 = jac_infinity(FqOps)
+    inf_g2 = jac_infinity(Fq2Ops)
+    # all-infinity: valid iff every pk side vanished too (no dispatch)
+    assert PA._pairing_finish(inf_g2, [(b"m", inf_g1)]) is True
+    _k, pk = _keypair(17)
+    assert PA._pairing_finish(inf_g2, [(b"m", pk)]) is False
+
+
+def test_warm_verify_graphs_noop_when_disabled(monkeypatch):
+    monkeypatch.setenv("CHARON_TPU_DEVICE_VERIFY", "0")
+    assert PA.warm_verify_graphs() == 0
+
+
+def test_native_pairing_check_seam():
+    """guard.native_pairing_check is the ctypes seam: same verdict as a
+    host-computed pairing for a valid pair set."""
+    from charon_tpu.crypto.serialize import g1_to_bytes, g2_to_bytes
+
+    msg = b"seam-check"
+    pk, S = _signed(18, msg)
+    g1s = [g1_to_bytes(pk), g1_to_bytes(PC.g1_generator())]
+    g2s = [PA.hash_to_g2_cached(msg), g2_to_bytes(S)]
+    assert guard.native_pairing_check(
+        b"".join(g1s), b"".join(g2s), bytes([0, 1])) is True
+    # tampering the signature flips the verdict
+    g2s[1] = g2_to_bytes(PC.jac_mul(Fq2Ops, S, 2))
+    assert guard.native_pairing_check(
+        b"".join(g1s), b"".join(g2s), bytes([0, 1])) is False
+
+
+# ---------------------------------------------------------------------------
+# H(m) plane cache (tier-1 safe: CPU hosts compute via the native rung)
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture
+def fresh_h2c_cache():
+    with PA._h2c_lock:
+        saved = dict(PA._h2c_cache)
+        PA._h2c_cache.clear()
+    yield
+    with PA._h2c_lock:
+        PA._h2c_cache.clear()
+        PA._h2c_cache.update(saved)
+
+
+def test_hash_to_g2_planes_matches_host(fresh_h2c_cache):
+    msgs = [b"planes-a", b"planes-b"]
+    miss0 = PA._h2c_counter.value("miss")
+    hx, hy = PA.hash_to_g2_planes(msgs)
+    assert hx.shape == (2, 2, DF.LIMBS) and hx.dtype == np.int32
+    assert PA._h2c_counter.value("miss") == miss0 + 2
+    for i, m in enumerate(msgs):
+        aff = to_affine(Fq2Ops, hash_to_g2(m, DST_ETH))
+        assert DF.fq2_to_ints(hx[i]) == aff[0]
+        assert DF.fq2_to_ints(hy[i]) == aff[1]
+    # second call is pure hits returning the stored planes
+    hit0 = PA._h2c_counter.value("hit")
+    hx2, hy2 = PA.hash_to_g2_planes(msgs)
+    assert PA._h2c_counter.value("hit") == hit0 + 2
+    assert (hx2 == hx).all() and (hy2 == hy).all()
+
+
+def test_hash_to_g2_planes_upgrades_bytes_entry(fresh_h2c_cache):
+    """An entry first filled by the compressed-bytes accessor upgrades to
+    planes in place on its first planes lookup — counted as a hit, and
+    the stored compressed bytes stay byte-identical."""
+    m = b"upgrade-entry"
+    comp = PA.hash_to_g2_cached(m)
+    with PA._h2c_lock:
+        assert PA._h2c_cache[m][1] is None
+    hit0 = PA._h2c_counter.value("hit")
+    hx, hy = PA.hash_to_g2_planes([m])
+    assert PA._h2c_counter.value("hit") == hit0 + 1
+    with PA._h2c_lock:
+        assert PA._h2c_cache[m][1] is not None
+    aff = to_affine(Fq2Ops, hash_to_g2(m, DST_ETH))
+    assert DF.fq2_to_ints(hx[0]) == aff[0]
+    assert DF.fq2_to_ints(hy[0]) == aff[1]
+    assert PA.hash_to_g2_cached(m) == comp
+
+
+def test_hash_to_g2_planes_cap_zero_disables_store(fresh_h2c_cache):
+    prev = PA.set_h2c_cache_cap(0)
+    try:
+        PA.hash_to_g2_planes([b"uncached"])
+        with PA._h2c_lock:
+            assert b"uncached" not in PA._h2c_cache
+    finally:
+        PA.set_h2c_cache_cap(prev)
+
+
+def test_hash_to_g2_planes_empty_batch():
+    hx, hy = PA.hash_to_g2_planes([])
+    assert hx.shape == (0, 2, DF.LIMBS)
+
+
+# ---------------------------------------------------------------------------
+# Device kernels vs RFC 9380 + the native oracle (slow: real compiles)
+# ---------------------------------------------------------------------------
+
+
+@slow_pairing
+def test_rfc9380_vector_device():
+    """RFC 9380 J.10.1 (BLS12381G2_XMD:SHA-256_SSWU_RO_, msg='') through
+    the device SSWU + 3-isogeny + clear-cofactor kernel."""
+    from charon_tpu.ops import h2c
+
+    dst = b"QUUX-V01-CS02-with-BLS12381G2_XMD:SHA-256_SSWU_RO_"
+    hx, hy = h2c.hash_to_g2_device([b""], dst)
+    assert DF.fq2_to_ints(hx[0]) == (
+        0x0141EBFBDCA40EB85B87142E130AB689C673CF60F1A3E98D69335266F30D9B8D4AC44C1038E9DCDD5393FAF5C41FB78A,
+        0x05CB8437535E20ECFFAEF7752BADDF98034139C38452458BAEEFAB379BA13DFF5BF5DD71B72418717047F5B0F37DA03D,
+    )
+    assert DF.fq2_to_ints(hy[0]) == (
+        0x0503921D7F6A12805E72940B963C0CF3471C7B2A524950CA195D11062EE75EC076DAF2D4BC358C4B190C0C98064FDD92,
+        0x12424AC32561493F3FE3C260708A12B7C620E7BE00099A974E259DDC7D1F6395C3C811CDD19F1E8DBF3E9ECFDCBAB8D6,
+    )
+
+
+@slow_pairing
+def test_device_h2c_matches_host_reference():
+    from charon_tpu.ops import h2c
+
+    msgs = [b"", b"abc", b"abcdef0123456789", b"q" * 128, b"a" * 517]
+    hx, hy = h2c.hash_to_g2_device(msgs, DST_ETH)
+    for i, m in enumerate(msgs):
+        aff = to_affine(Fq2Ops, hash_to_g2(m, DST_ETH))
+        assert DF.fq2_to_ints(hx[i]) == aff[0], m
+        assert DF.fq2_to_ints(hy[i]) == aff[1], m
+
+
+def _finish_verdict_both_paths(monkeypatch, S, pts):
+    """(device verdict, native verdict) for the same _pairing_finish
+    inputs — the oracle equality the acceptance criteria pin."""
+    monkeypatch.setenv("CHARON_TPU_DEVICE_VERIFY", "1")
+    dev = PA._pairing_finish(S, pts)
+    monkeypatch.setenv("CHARON_TPU_DEVICE_VERIFY", "0")
+    nat = PA._pairing_finish(S, pts)
+    return dev, nat
+
+
+def _non_subgroup_g2():
+    """A point on the G2 curve but outside the r-torsion subgroup."""
+    from charon_tpu.crypto.curve import B_G2, g2_in_subgroup, to_jacobian
+
+    x1 = 0
+    while True:
+        x = (5, x1)
+        y2 = PF.fq2_add(PF.fq2_mul(PF.fq2_sqr(x), x), B_G2)
+        y = PF.fq2_sqrt(y2)
+        if y is not None:
+            pt = to_jacobian(Fq2Ops, (x, y))
+            if not g2_in_subgroup(pt):
+                return pt
+        x1 += 1
+
+
+@slow_pairing
+def test_device_native_verdict_oracle(monkeypatch):
+    """Device verdicts == native ct_pairing_check on good, tampered,
+    bad_pk-degraded, identity-point, and non-subgroup batches."""
+    guard.reset_for_testing()
+    m1, m2 = b"oracle-1", b"oracle-2"
+    k1, pk1 = _keypair(31)
+    k2, pk2 = _keypair(32)
+    S = PC.jac_add(Fq2Ops,
+                   PC.jac_mul(Fq2Ops, hash_to_g2(m1, DST_ETH), k1),
+                   PC.jac_mul(Fq2Ops, hash_to_g2(m2, DST_ETH), k2))
+    good = [(m1, pk1), (m2, pk2)]
+
+    cases = {
+        "good": (S, good, True),
+        "tampered": (PC.jac_mul(Fq2Ops, S, 3), good, False),
+        "bad_pk": (S, [(m1, pk2), (m2, pk1)], False),
+        "identity": (jac_infinity(Fq2Ops), good, False),
+        "non_subgroup": (_non_subgroup_g2(), good, False),
+    }
+    for name, (S_c, pts, want) in cases.items():
+        dev, nat = _finish_verdict_both_paths(monkeypatch, S_c, pts)
+        assert dev == nat == want, (name, dev, nat, want)
+
+
+@slow_pairing
+def test_warm_verify_graphs_counts(monkeypatch):
+    monkeypatch.setenv("CHARON_TPU_DEVICE_VERIFY", "1")
+    assert PA.warm_verify_graphs() == 2  # one pairing bucket + one h2c

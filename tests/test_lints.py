@@ -860,3 +860,51 @@ def test_flt_rule_accepts_registered_literal_sites(tmp_path):
             other.check(compute_anything())  # not the faults module
     """)
     assert findings == []
+
+
+# ---------------------------------------------------------------------------
+# LINT-TPU-012 — native pairing/h2c stays behind the guard seam
+# ---------------------------------------------------------------------------
+
+
+def test_pairing_rule_flags_stray_native_calls(tmp_path):
+    findings = lint_source(tmp_path, "ops/x.py", """\
+        def verify_slot(lib, g1, g2, negs, key, out):
+            rc = lib.ct_pairing_check(g1, g2, negs, len(negs), 0)
+            lib.ct_hash_to_g2(key, len(key), out)
+            return rc == 1
+    """)
+    assert rules_of(findings) == ["LINT-TPU-012"] * 2
+    assert "ct_pairing_check" in findings[0].message
+    assert "native rung" in findings[0].message
+
+
+def test_pairing_rule_sanctions_guard_rung_and_cache_miss(tmp_path):
+    findings = lint_source(tmp_path, "ops/x.py", """\
+        def native_pairing_check(g1_cat, g2_cat, negs):
+            rc = _native_lib().ct_pairing_check(g1_cat, g2_cat, negs,
+                                                len(negs), 0)
+            return rc == 1
+
+        def _hash_to_g2_native(key):
+            out96 = _buf()
+            _native_lib().ct_hash_to_g2(key, len(key), out96)
+            return bytes(out96)
+    """)
+    assert findings == []
+
+
+def test_pairing_rule_ignores_other_natives_and_dirs(tmp_path):
+    # other ct_* entry points (decompress, g1 checks) are out of scope
+    findings = lint_source(tmp_path, "ops/x.py", """\
+        def load(lib, xs, n, out):
+            lib.ct_g2_uncompress_bulk(xs, n, out)
+            lib.ct_g1_check(xs, n)
+    """)
+    assert findings == []
+    # and the rule only scopes to ops/
+    findings = lint_source(tmp_path, "crypto/x.py", """\
+        def host_check(lib, g1, g2, negs):
+            return lib.ct_pairing_check(g1, g2, negs, len(negs), 0) == 1
+    """)
+    assert findings == []

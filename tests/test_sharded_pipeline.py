@@ -58,11 +58,17 @@ def _run_check(n_devices: int, *extra: str) -> str:
     return res.stdout
 
 
+@pytest.mark.slow
 def test_sharded_4dev_bit_identical_and_tamper():
     """4-device mesh, V=6 (V % D != 0, trailing shard all padding): valid
     slot verifies bit-identical to the native oracle, tampered slot flips
     the RLC verdict, and the 1-device passthrough rerun (override=1)
-    produces byte-identical aggregates."""
+    produces byte-identical aggregates.
+
+    Slow tier: the 4-dev graph re-traces ~3 min per run even with a warm
+    .jax_cache (trace/lower time dominates, which the XLA cache can't
+    amortize) — the 3-dev check below keeps a sharded end-to-end compile
+    in tier-1, and the 8-dev multichip dryrun covers wide bit-identity."""
     _run_check(4, "--single-device-compare")
 
 
