@@ -26,6 +26,12 @@ from ..utils import log, metrics, tracer
 
 _log = log.with_topic("monitoring")
 
+# readyz polls the BN sync status anyway — exporting it lets the health
+# rules (app/health.py) and dashboards see the same signal
+_syncing_gauge = metrics.gauge(
+    "app_beacon_node_syncing",
+    "1 while the upstream beacon node reports it is syncing")
+
 READY_OK = "ok"
 
 
@@ -81,7 +87,9 @@ class MonitoringAPI:
         problems = []
         if self._beacon is not None:
             try:
-                if await self._beacon.node_syncing():
+                syncing = await self._beacon.node_syncing()
+                _syncing_gauge.set(1.0 if syncing else 0.0)
+                if syncing:
                     problems.append("beacon node syncing")
             except Exception:  # noqa: BLE001 — unreachable BN = not ready
                 problems.append("beacon node unreachable")

@@ -17,7 +17,7 @@ from ..core.types import pubkey_from_bytes
 from ..eth2 import deposit as deposit_mod
 from ..eth2 import enr as enr_mod
 from ..eth2 import keystore
-from ..utils import errors, k1util
+from ..utils import errors, k1util, secretio
 from .combine import combine
 from .definition import Definition, Operator
 from .lock import DistValidator, Lock
@@ -96,8 +96,7 @@ def create_cluster(name: str, num_validators: int, num_nodes: int, threshold: in
         node_dir = out_dir / f"node{i}"
         node_dir.mkdir(parents=True, exist_ok=True)
         key_path = node_dir / "charon-enr-private-key"
-        key_path.write_text(identity_keys[i].hex())
-        key_path.chmod(0o600)  # identity key material must not be world-readable
+        secretio.write_secret_text(key_path, identity_keys[i].hex())
         from .lock import save as save_lock
 
         save_lock(lock, str(node_dir / "cluster-lock.json"))

@@ -22,7 +22,7 @@ import os
 import sys
 from pathlib import Path
 
-from ..utils import k1util, log, version
+from ..utils import k1util, log, secretio, version
 
 ENV_PREFIX = "CHARON_"
 
@@ -375,8 +375,7 @@ def _cmd_create(args: argparse.Namespace) -> int:
             print(f"identity key already exists at {key_path}", file=sys.stderr)
             return 1
         key = k1util.generate_private_key()
-        key_path.write_text(key.hex())
-        key_path.chmod(0o600)
+        secretio.write_secret_text(key_path, key.hex())
         print(enr_mod.new(key).encode())
         return 0
     if args.create_command == "dkg":
@@ -467,8 +466,7 @@ def _cmd_relay(args: argparse.Namespace) -> int:
         key = bytes.fromhex(key_path.read_text().strip())
     else:
         key = k1util.generate_private_key()
-        key_path.write_text(key.hex())
-        key_path.chmod(0o600)
+        secretio.write_secret_text(key_path, key.hex())
 
     async def serve():
         relay = RelayServer(key, host, port)

@@ -13,6 +13,7 @@ parsigex/memory.go).
 
 from __future__ import annotations
 
+import asyncio
 from typing import Awaitable, Callable
 
 from .. import tbls
@@ -41,7 +42,10 @@ def new_eth2_verifier(chain: ChainSpec, keys: KeyShares) -> VerifyFunc:
             raise errors.new("unverifiable partial data type",
                              kind=type(data).__name__)
         share_pk = keys.share_pubkey(pubkey, psd.share_idx)
-        if not data.verify(chain, share_pk):
+        # pairing check runs ~ms in the native library: hop off the loop
+        loop = asyncio.get_running_loop()
+        ok = await loop.run_in_executor(None, data.verify, chain, share_pk)
+        if not ok:
             raise errors.new("invalid partial signature", duty=str(duty),
                              pubkey=pubkey[:10], share_idx=psd.share_idx)
 
@@ -79,11 +83,17 @@ def new_batch_eth2_verifier(chain: ChainSpec, keys: KeyShares,
                                       expected=keys.num_shares - 1,
                                       contributor=sender):
                 return
-        elif tbls.verify_batch(pks, roots, sigs):
-            return
+        else:
+            # batch pairing work blocks for ~ms in the backend: hop off
+            # the loop so concurrent duties keep flowing
+            loop = asyncio.get_running_loop()
+            if await loop.run_in_executor(None, tbls.verify_batch,
+                                          pks, roots, sigs):
+                return
         # Batch failed: identify culprit(s) individually.
+        loop = asyncio.get_running_loop()
         for (pubkey, psd), pk, root, sig in zip(parsigs.items(), pks, roots, sigs):
-            if not tbls.verify(pk, root, sig):
+            if not await loop.run_in_executor(None, tbls.verify, pk, root, sig):
                 raise errors.new("invalid partial signature", duty=str(duty),
                                  pubkey=pubkey[:10], share_idx=psd.share_idx)
         # Batch verify failed but every signature passed individually: the

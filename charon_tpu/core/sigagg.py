@@ -11,6 +11,8 @@ the primary TPU dispatch of the whole pipeline (north-star sigagg config:
 
 from __future__ import annotations
 
+import asyncio
+
 from .. import tbls
 from ..eth2.spec import ChainSpec
 from ..utils import errors, log, metrics, tracer
@@ -95,13 +97,18 @@ class SigAgg:
                 with _agg_hist.time(str(duty.type)), \
                         tracer.start_span("sigagg/aggregate+verify",
                                           duty=str(duty), batch=len(batches)):
-                    agg_sigs, ok = tbls.threshold_aggregate_verify_batch(
-                        batches, pk_bytes, roots)
+                    # the submit front door runs the fused dispatch + device
+                    # fence on the pipeline's finish pool, keeping the event
+                    # loop free while the device works
+                    agg_sigs, ok = await asyncio.wrap_future(
+                        tbls.threshold_aggregate_verify_submit(
+                            batches, pk_bytes, roots))
         else:
             with _agg_hist.time(str(duty.type)), \
                     tracer.start_span("sigagg/aggregate", duty=str(duty),
                                       batch=len(batches)):
-                agg_sigs = tbls.threshold_aggregate_batch(batches)
+                agg_sigs = await asyncio.get_running_loop().run_in_executor(
+                    None, tbls.threshold_aggregate_batch, batches)
 
         signed: SignedDataSet = {}
         verify_pks: list[tbls.PublicKey] = []
@@ -113,18 +120,21 @@ class SigAgg:
                 verify_pks.append(pubkey_to_bytes(pubkey))
                 verify_roots.append(data.signing_root(self._chain))
 
+        loop = asyncio.get_running_loop()
         if verify_pks:
-            ok = tbls.verify_batch(
-                verify_pks, verify_roots,
-                [signed[pk].signature() for pk in pubkeys
-                 if isinstance(signed[pk], _Eth2Signed)])
+            verify_sigs = [signed[pk].signature() for pk in pubkeys
+                           if isinstance(signed[pk], _Eth2Signed)]
+            ok = await loop.run_in_executor(
+                None, tbls.verify_batch, verify_pks, verify_roots, verify_sigs)
         if verify_pks or all_eth2:
             if not ok:
                 # Identify the failing aggregate individually.
                 for pubkey in pubkeys:
                     data = signed[pubkey]
-                    if isinstance(data, _Eth2Signed) and not data.verify(
-                            self._chain, pubkey_to_bytes(pubkey)):
+                    if isinstance(data, _Eth2Signed) and not await \
+                            loop.run_in_executor(None, data.verify,
+                                                 self._chain,
+                                                 pubkey_to_bytes(pubkey)):
                         raise errors.new("aggregate signature verification failed",
                                          duty=str(duty), pubkey=pubkey[:10])
                 raise errors.new("batch aggregate verification failed", duty=str(duty))

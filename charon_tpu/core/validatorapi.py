@@ -15,6 +15,7 @@ wrapping it for real VCs lives alongside it in vapi_router.py.
 
 from __future__ import annotations
 
+import asyncio
 import dataclasses
 import time
 from typing import Awaitable, Callable
@@ -253,7 +254,7 @@ class Component:  # lint: implements=ValidatorAPI
             pubkey = self._dutydb.pubkey_by_attestation(
                 slot, att.data.index, set_bits[0])
             data = SignedAttestation(att)
-            self._verify_partial(pubkey, data)
+            await self._verify_partial(pubkey, data)
             duty = Duty(slot, DutyType.ATTESTER)
             by_duty.setdefault(duty, {})[pubkey] = ParSignedData(
                 data, self._keys.my_share_idx)
@@ -298,7 +299,7 @@ class Component:  # lint: implements=ValidatorAPI
         epoch = self._chain.epoch_of(slot)
         pubkey = await self._proposer_pubkey(slot)
         randao = SignedRandao(epoch, bytes(randao_reveal))
-        self._verify_partial(pubkey, randao)
+        await self._verify_partial(pubkey, randao)
         duty = Duty(slot, DutyType.RANDAO)
         await self._emit(duty, {pubkey: ParSignedData(randao, self._keys.my_share_idx)})
         _submit_counter.inc("randao")
@@ -322,7 +323,7 @@ class Component:  # lint: implements=ValidatorAPI
         if pubkey is None:
             pubkey = await self._proposer_pubkey(slot)
         data = SignedProposal(block.message, bytes(block.signature))
-        self._verify_partial(pubkey, data)
+        await self._verify_partial(pubkey, data)
         _submit_counter.inc("block")
         await self._emit(Duty(slot, DutyType.PROPOSER),
                          {pubkey: ParSignedData(data, self._keys.my_share_idx)})
@@ -353,7 +354,7 @@ class Component:  # lint: implements=ValidatorAPI
         out = []
         for sel in selections:
             pubkey = await self._pubkey_by_index(sel.validator_index)
-            self._verify_partial(pubkey, sel)
+            await self._verify_partial(pubkey, sel)
             duty = Duty(sel.slot, DutyType.PREPARE_AGGREGATOR)
             await self._emit(duty, {pubkey: ParSignedData(sel, self._keys.my_share_idx)})
             combined = await self._aggsigdb.await_(duty, pubkey,
@@ -376,7 +377,7 @@ class Component:  # lint: implements=ValidatorAPI
         for agg in aggs:
             pubkey = await self._pubkey_by_index(agg.message.aggregator_index)
             data = SignedAggregateAndProof(agg.message, bytes(agg.signature))
-            self._verify_partial(pubkey, data)
+            await self._verify_partial(pubkey, data)
             duty = Duty(agg.message.aggregate.data.slot, DutyType.AGGREGATOR)
             await self._emit(duty, {pubkey: ParSignedData(data, self._keys.my_share_idx)})
         _submit_counter.inc("aggregate_and_proof", amount=len(aggs))
@@ -389,7 +390,7 @@ class Component:  # lint: implements=ValidatorAPI
         for msg in msgs:
             pubkey = await self._pubkey_by_index(msg.validator_index)
             data = SignedSyncMessage(msg)
-            self._verify_partial(pubkey, data)
+            await self._verify_partial(pubkey, data)
             duty = Duty(msg.slot, DutyType.SYNC_MESSAGE)
             await self._emit(duty, {pubkey: ParSignedData(data, self._keys.my_share_idx)})
         _submit_counter.inc("sync_message", amount=len(msgs))
@@ -400,7 +401,7 @@ class Component:  # lint: implements=ValidatorAPI
         out = []
         for sel in selections:
             pubkey = await self._pubkey_by_index(sel.validator_index)
-            self._verify_partial(pubkey, sel)
+            await self._verify_partial(pubkey, sel)
             duty = Duty(sel.slot, DutyType.PREPARE_SYNC_CONTRIBUTION)
             await self._emit(duty, {pubkey: ParSignedData(sel, self._keys.my_share_idx)})
             combined = await self._aggsigdb.await_(duty, pubkey,
@@ -422,7 +423,7 @@ class Component:  # lint: implements=ValidatorAPI
         for c in contribs:
             pubkey = await self._pubkey_by_index(c.message.aggregator_index)
             data = SignedSyncContributionAndProof(c.message, bytes(c.signature))
-            self._verify_partial(pubkey, data)
+            await self._verify_partial(pubkey, data)
             duty = Duty(c.message.contribution.slot, DutyType.SYNC_CONTRIBUTION)
             await self._emit(duty, {pubkey: ParSignedData(data, self._keys.my_share_idx)})
         _submit_counter.inc("contribution_and_proof", amount=len(contribs))
@@ -433,7 +434,7 @@ class Component:  # lint: implements=ValidatorAPI
         """reference validatorapi.go:581 SubmitVoluntaryExit."""
         pubkey = await self._pubkey_by_index(exit_.message.validator_index)
         data = SignedExit(exit_.message, bytes(exit_.signature))
-        self._verify_partial(pubkey, data)
+        await self._verify_partial(pubkey, data)
         # Exits have no deadline; duty slot anchors at the current slot.
         slot = max(self._chain.slot_at(self._clock()), 0)
         _submit_counter.inc("voluntary_exit")
@@ -453,7 +454,7 @@ class Component:  # lint: implements=ValidatorAPI
             root_reg = dataclasses.replace(reg.message,
                                            pubkey=pubkey_to_bytes(pubkey))
             data = SignedRegistration(root_reg, bytes(reg.signature))
-            self._verify_partial(pubkey, data)
+            await self._verify_partial(pubkey, data)
             by_duty[pubkey] = ParSignedData(data, self._keys.my_share_idx)
         if by_duty:
             _submit_counter.inc("validator_registration", amount=len(regs))
@@ -461,11 +462,14 @@ class Component:  # lint: implements=ValidatorAPI
 
     # -- helpers -------------------------------------------------------------
 
-    def _verify_partial(self, pubkey: PubKey, data: _Eth2Signed) -> None:
+    async def _verify_partial(self, pubkey: PubKey, data: _Eth2Signed) -> None:
         """Verify a partial signature against this node's share public key
-        (reference verifyPartialSig validatorapi.go:1063)."""
+        (reference verifyPartialSig validatorapi.go:1063). The pairing check
+        blocks for ~ms in the native backend, so it hops off the event loop."""
         share_pk = self._keys.my_share_pubkey(pubkey)
-        if not data.verify(self._chain, share_pk):
+        ok = await asyncio.get_running_loop().run_in_executor(
+            None, data.verify, self._chain, share_pk)
+        if not ok:
             raise errors.new("invalid partial signature from VC",
                              pubkey=pubkey[:10], kind=type(data).__name__)
 

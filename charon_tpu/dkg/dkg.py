@@ -46,7 +46,7 @@ from ..eth2 import enr as enr_mod
 from ..eth2 import keystore
 from ..ops import guard
 from ..p2p.node import PeerSpec, TCPNode
-from ..utils import errors, expbackoff, faults, k1util, log, metrics, retry
+from ..utils import errors, expbackoff, faults, k1util, log, metrics, retry, secretio
 from . import frost as frost_mod
 from . import keycast as keycast_mod
 from .bcast import GatherTimeout, SignedBroadcast
@@ -356,8 +356,7 @@ async def run_dkg(config: Config) -> Lock:
         keystore.store_keys(share_secrets, data_dir / "validator_keys",
                             insecure=config.insecure_keystores)
         key_path = data_dir / "charon-enr-private-key"
-        key_path.write_text(config.identity_key.hex())
-        key_path.chmod(0o600)
+        secretio.write_secret_text(key_path, config.identity_key.hex())
         deposits = [{
             "pubkey": v.public_key.hex(),
             "withdrawal_credentials": deposit_mod.withdrawal_credentials_from_address(

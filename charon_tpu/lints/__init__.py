@@ -13,12 +13,22 @@ the codebase states in prose —
                  device arrays; no host syncs in @jax.jit bodies
   LINT-IFACE-004 core/ components implement their claimed protocol
 
+Since RULES_VERSION 9 the engine is whole-program: a project index +
+call graph (`project.py`) and a forward taint framework (`dataflow.py`)
+back three interprocedural rules —
+
+  LINT-SEC-013   secret key material must not reach observable sinks
+  LINT-ASY-014   no blocking calls reachable from the core/p2p duty path
+  LINT-OBS-015   health-read metric names registered and documented
+
 Run `python -m charon_tpu.lints [paths]`; see docs/lints.md.
 """
 
 from .engine import (  # noqa: F401
+    RULES_VERSION,
     Engine,
     Finding,
+    ProjectRule,
     Rule,
     SourceFile,
     baseline_counts,
@@ -26,4 +36,5 @@ from .engine import (  # noqa: F401
     new_findings,
     write_baseline,
 )
+from .project import ProjectIndex  # noqa: F401
 from .rules import default_rules  # noqa: F401

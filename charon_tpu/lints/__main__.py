@@ -1,15 +1,20 @@
-"""CLI: `python -m charon_tpu.lints [paths] [--json] [--baseline-update]`.
+"""CLI: `python -m charon_tpu.lints [paths] [--format=json] [--changed BASE]`.
 
 Exit codes: 0 = no findings beyond the baseline, 1 = new findings,
-2 = usage error. `--json` emits a machine-readable report (per-rule counts
-plus every finding) so CI can diff finding counts across PRs the way
-bench.py's --json output is diffed.
+2 = usage error. `--format=json` emits a stable machine-readable report
+(per-rule counts plus every finding) so CI can diff finding counts across
+PRs the way bench.py's --json output is diffed; `--json` is a back-compat
+alias. `--changed BASE` narrows the *report* to files changed since a git
+base (or listed in a manifest file) plus everything that imports them —
+the whole-program index is still built over the full tree, so
+interprocedural findings stay sound; only the reporting is filtered.
 """
 
 from __future__ import annotations
 
 import argparse
 import json
+import subprocess
 import sys
 from pathlib import Path
 
@@ -25,8 +30,17 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("paths", nargs="*",
                    help="files/directories to lint (default: the charon_tpu "
                         "package)")
+    p.add_argument("--format", choices=("text", "json"), default=None,
+                   dest="format",
+                   help="report format (default: text); json is stable and "
+                        "CI-consumable")
     p.add_argument("--json", action="store_true", dest="as_json",
-                   help="emit a JSON report (counts + findings) for CI diffs")
+                   help="alias for --format=json (back-compat)")
+    p.add_argument("--changed", default=None, metavar="BASE",
+                   help="report only findings in files changed since git "
+                        "rev BASE (or listed, one per line, in a manifest "
+                        "file at BASE) plus their transitive importers; the "
+                        "whole-program analysis still covers the full tree")
     p.add_argument("--baseline", default=str(DEFAULT_BASELINE),
                    help="baseline file of grandfathered findings "
                         "(default: charon_tpu/lints/baseline.json)")
@@ -44,8 +58,49 @@ def build_parser() -> argparse.ArgumentParser:
     return p
 
 
+def changed_rels(base: str, root: Path) -> set[str] | None:
+    """Changed file rels from a manifest file or `git diff --name-only`.
+    Returns None (with a message on stderr) when the base is unusable."""
+    manifest = Path(base)
+    if manifest.is_file():
+        return {line.strip() for line in manifest.read_text().splitlines()
+                if line.strip()}
+    try:
+        out = subprocess.run(
+            ["git", "diff", "--name-only", base, "--"],
+            cwd=root, capture_output=True, text=True, timeout=60)
+    except (OSError, subprocess.SubprocessError) as exc:
+        print(f"error: --changed: {exc}", file=sys.stderr)
+        return None
+    if out.returncode != 0:
+        print(f"error: --changed: git diff failed: "
+              f"{out.stderr.strip()}", file=sys.stderr)
+        return None
+    return {line.strip() for line in out.stdout.splitlines() if line.strip()}
+
+
+def affected_rels(changed: set[str], import_graph: dict[str, list[str]]) -> set[str]:
+    """changed ∪ every file whose import closure contains a changed file —
+    a finding in an importer can appear/disappear when its dependency
+    changes (the same relation the engine's fingerprints key on)."""
+    importers: dict[str, set[str]] = {}
+    for rel, imports in import_graph.items():
+        for dep in imports:
+            importers.setdefault(dep, set()).add(rel)
+    affected = set(changed)
+    frontier = list(changed)
+    while frontier:
+        dep = frontier.pop()
+        for rel in importers.get(dep, ()):
+            if rel not in affected:
+                affected.add(rel)
+                frontier.append(rel)
+    return affected
+
+
 def main(argv: list[str] | None = None) -> int:
     args = build_parser().parse_args(argv)
+    fmt = args.format or ("json" if args.as_json else "text")
 
     paths = [Path(p) for p in args.paths]
     if not paths:
@@ -61,6 +116,14 @@ def main(argv: list[str] | None = None) -> int:
     eng = engine.Engine(cache_path=args.cache)
     findings = eng.lint_paths(paths, root=args.root)
 
+    if args.changed is not None:
+        root = Path(args.root) if args.root else Path.cwd()
+        changed = changed_rels(args.changed, root)
+        if changed is None:
+            return 2
+        affected = affected_rels(changed, eng.import_graph)
+        findings = [f for f in findings if f.path in affected]
+
     if args.baseline_update:
         engine.write_baseline(args.baseline, findings)
         print(f"baseline: wrote {len(findings)} finding(s) "
@@ -71,13 +134,14 @@ def main(argv: list[str] | None = None) -> int:
     baseline = {} if args.no_baseline else engine.load_baseline(args.baseline)
     new = engine.new_findings(findings, baseline)
 
-    if args.as_json:
+    if fmt == "json":
         counts: dict[str, int] = {}
         for f in findings:
             counts[f.rule] = counts.get(f.rule, 0) + 1
         new_set = set(new)
         report = {
-            "version": 1,
+            "version": 2,
+            "rules_version": engine.RULES_VERSION,
             "total": len(findings),
             "new": len(new),
             "baselined": len(findings) - len(new),

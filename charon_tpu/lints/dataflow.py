@@ -1,0 +1,695 @@
+"""Forward-dataflow taint framework over the project index (LINT-SEC-013).
+
+A small interprocedural analysis, deliberately bounded:
+
+  * **shapes** — the abstract value lattice.  ``Atom`` carries a frozenset
+    of origins (``src:<name>`` for configured sources, ``param:<i>`` for a
+    function's own parameters); ``Tup``/``Seq``/``Map`` keep one level of
+    container structure so ``round1_batch``'s ``list[(public_broadcast,
+    secret_shares)]`` shape survives destructuring at call sites.  Depth
+    is capped; anything deeper collapses to an ``Atom`` of all origins.
+  * **summaries** — each function is analysed once per fixpoint pass into
+    a ``Summary``: its return shape (in terms of ``param:<i>`` and
+    ``src:`` origins) and its *sink obligations* (parameters that flow
+    into a sink somewhere inside it, transitively).  Call sites
+    instantiate summaries by substituting argument origins for params, so
+    a source in module A reaching a log call in module C through a helper
+    in module B is reported — at the call site that passed the tainted
+    value in.
+  * **fixpoint** — functions are analysed in callee-first (DFS postorder)
+    order, twice; recursion cycles fall back to the conservative
+    propagate-everything summary and stabilise on the second pass.
+
+Sinks (checked whenever a tainted value reaches one):
+
+  ``log``          args/kwargs of ``.debug/.info/.warn/.error`` on a logger
+                   (a ``log.with_topic`` module binding or a ``log``-named
+                   receiver)
+  ``exception``    args/kwargs of ``errors.new`` / ``errors.wrap``, or any
+                   raised expression carrying taint
+  ``metric-label`` args of ``.inc/.set/.observe`` on a metric binding
+  ``format``       f-string interpolation, ``repr()``, ``str.format``,
+                   ``%``-formatting
+  ``file-write``   ``.write_text/.write_bytes/.write`` args outside the
+                   sanctioned secret-write modules (``dkg/checkpoint.py``,
+                   ``utils/secretio.py``)
+
+Sanitizers cut taint at the call: hashing/encryption (``sha256``,
+``encrypt``, ``aes128ctr``), public derivations (``secret_to_public_key``,
+``public_key``, ``sign``, ``g_mul``), the ``Round1Broadcast`` constructor
+(its fields are public commitments/PoK values), and size/type probes.
+Serialization (``str``/``bytes``/``.hex()``/``json.dumps``) *propagates*
+taint — the sanctioned checkpoint path serializes secrets on purpose; what
+matters is where the serialized value lands.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from typing import Iterable
+
+from .project import (FunctionInfo, ModuleInfo, ProjectIndex, dotted_endswith,
+                      matches_any, _flatten)
+
+# deep enough that `enumerate(round1_batch(...))` — Seq(Tup((i, Tup((bcast,
+# shares_map))))) — survives destructuring without collapsing to an Atom
+_MAX_DEPTH = 4
+_LOG_METHODS = {"debug", "info", "warn", "warning", "error", "critical",
+                "exception"}
+_METRIC_METHODS = {"inc", "set", "observe"}
+_WRITE_METHODS = {"write_text", "write_bytes", "write"}
+
+
+# -- shapes -----------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class Atom:
+    origins: frozenset = frozenset()
+
+
+@dataclass(frozen=True)
+class Tup:
+    elems: tuple
+
+
+@dataclass(frozen=True)
+class Seq:
+    elem: "Shape"
+
+
+@dataclass(frozen=True)
+class Map:
+    key: "Shape"
+    val: "Shape"
+
+
+Shape = object
+CLEAN = Atom()
+
+
+def origins_of(shape: Shape) -> frozenset:
+    if isinstance(shape, Atom):
+        return shape.origins
+    if isinstance(shape, Tup):
+        out: frozenset = frozenset()
+        for e in shape.elems:
+            out |= origins_of(e)
+        return out
+    if isinstance(shape, Seq):
+        return origins_of(shape.elem)
+    if isinstance(shape, Map):
+        return origins_of(shape.key) | origins_of(shape.val)
+    return frozenset()
+
+
+def collapse(shape: Shape) -> Atom:
+    return Atom(origins_of(shape))
+
+
+def _depth(shape: Shape) -> int:
+    if isinstance(shape, Tup):
+        return 1 + max((_depth(e) for e in shape.elems), default=0)
+    if isinstance(shape, Seq):
+        return 1 + _depth(shape.elem)
+    if isinstance(shape, Map):
+        return 1 + max(_depth(shape.key), _depth(shape.val))
+    return 0
+
+
+def bound(shape: Shape) -> Shape:
+    return collapse(shape) if _depth(shape) > _MAX_DEPTH else shape
+
+
+def join(a: Shape, b: Shape) -> Shape:
+    if a == b:
+        return a
+    if a == CLEAN:  # the empty Atom is bottom: joining it keeps structure
+        return b
+    if b == CLEAN:
+        return a
+    if isinstance(a, Tup) and isinstance(b, Tup) and len(a.elems) == len(b.elems):
+        return Tup(tuple(join(x, y) for x, y in zip(a.elems, b.elems)))
+    if isinstance(a, Seq) and isinstance(b, Seq):
+        return Seq(join(a.elem, b.elem))
+    if isinstance(a, Map) and isinstance(b, Map):
+        return Map(join(a.key, b.key), join(a.val, b.val))
+    return Atom(origins_of(a) | origins_of(b))
+
+
+def elem_of(shape: Shape) -> Shape:
+    """Shape of one iteration element."""
+    if isinstance(shape, Seq):
+        return shape.elem
+    if isinstance(shape, Tup):
+        out: Shape = CLEAN
+        for e in shape.elems:
+            out = join(out, e)
+        return out
+    if isinstance(shape, Map):
+        return shape.key
+    return shape
+
+
+def index_of(shape: Shape, key: object = None) -> Shape:
+    """Shape of `shape[key]` (constant int keys project tuple elements)."""
+    if isinstance(shape, Tup):
+        if isinstance(key, int) and -len(shape.elems) <= key < len(shape.elems):
+            return shape.elems[key]
+        return elem_of(shape)
+    if isinstance(shape, Seq):
+        return shape.elem
+    if isinstance(shape, Map):
+        return shape.val
+    return shape
+
+
+def subst(shape: Shape, argmap: dict[str, frozenset]) -> Shape:
+    """Replace param:<i> origins with caller-side origin sets."""
+    if isinstance(shape, Atom):
+        out: frozenset = frozenset()
+        for o in shape.origins:
+            out |= argmap.get(o, frozenset({o}) if not o.startswith("param:")
+                              else frozenset())
+        return Atom(out)
+    if isinstance(shape, Tup):
+        return Tup(tuple(subst(e, argmap) for e in shape.elems))
+    if isinstance(shape, Seq):
+        return Seq(subst(shape.elem, argmap))
+    if isinstance(shape, Map):
+        return Map(subst(shape.key, argmap), subst(shape.val, argmap))
+    return shape
+
+
+# -- config / results -------------------------------------------------------
+
+
+@dataclass
+class TaintConfig:
+    """What taints, what cleans, where writes are sanctioned.  Entries are
+    dotted-suffix matched; single-component entries also match bare
+    attribute calls on unresolved receivers (``p._eval(j)``)."""
+
+    call_sources: tuple = ()
+    attr_sources: tuple = ()
+    sanitizers: tuple = ()
+    write_exempt_modules: tuple = ()
+
+
+@dataclass
+class SinkHit:
+    """A parameter of a function reaching a sink inside it (transitively)."""
+
+    kind: str
+    params: frozenset          # param indices (ints) that reach the sink
+    detail: str
+
+
+@dataclass
+class Summary:
+    ret: Shape = CLEAN
+    sink_params: tuple = ()    # tuple[SinkHit, ...]
+
+
+@dataclass(frozen=True, order=True)
+class TaintFinding:
+    path: str
+    line: int
+    kind: str
+    detail: str
+    origins: tuple             # sorted src names, "src:" stripped
+
+
+def _default_summary(n_params: int) -> Summary:
+    return Summary(ret=Atom(frozenset(f"param:{i}" for i in range(n_params))))
+
+
+# -- the analysis -----------------------------------------------------------
+
+
+class TaintAnalysis:
+    def __init__(self, index: ProjectIndex, config: TaintConfig):
+        self.index = index
+        self.config = config
+        self.summaries: dict[str, Summary] = {}
+        self.findings: set[TaintFinding] = set()
+        self._collect = False  # findings recorded only on the final pass
+
+    def run(self) -> list[TaintFinding]:
+        order = self._postorder()
+        for qual in order:               # pass 1: build summaries bottom-up
+            self.summaries[qual] = self._analyse(self.index.functions[qual])
+        self._collect = True
+        for qual in order:               # pass 2: stable summaries, report
+            self.summaries[qual] = self._analyse(self.index.functions[qual])
+        return sorted(self.findings)
+
+    def _postorder(self) -> list[str]:
+        """Callee-first DFS postorder over internal call edges, cycle-safe."""
+        seen: set[str] = set()
+        order: list[str] = []
+        for start in sorted(self.index.functions):
+            if start in seen:
+                continue
+            stack: list[tuple[str, int]] = [(start, 0)]
+            seen.add(start)
+            while stack:
+                qual, i = stack.pop()
+                edges = [e for e in self.index.out_edges(qual) if e.internal]
+                if i < len(edges):
+                    stack.append((qual, i + 1))
+                    nxt = edges[i].callee
+                    if nxt not in seen and nxt in self.index.functions:
+                        seen.add(nxt)
+                        stack.append((nxt, 0))
+                else:
+                    order.append(qual)
+        return order
+
+    def summary_of(self, qual: str) -> Summary:
+        s = self.summaries.get(qual)
+        if s is not None:
+            return s
+        fn = self.index.functions.get(qual)
+        return _default_summary(len(fn.params) if fn else 0)
+
+    def _analyse(self, fn: FunctionInfo) -> Summary:
+        try:
+            return _FunctionWalker(self, fn).run()
+        except RecursionError:  # pathological nesting: stay conservative
+            return _default_summary(len(fn.params))
+
+    def report(self, mod: ModuleInfo, line: int, kind: str, detail: str,
+               origins: Iterable[str]) -> None:
+        if not self._collect:
+            return
+        srcs = tuple(sorted(o[4:] for o in origins if o.startswith("src:")))
+        if srcs:
+            self.findings.add(TaintFinding(
+                path=mod.src.rel, line=line, kind=kind, detail=detail,
+                origins=srcs))
+
+
+class _FunctionWalker:
+    """One function's flow-insensitive-ish transfer (two passes over the
+    body so loop-carried and use-before-def flows stabilise)."""
+
+    def __init__(self, analysis: TaintAnalysis, fn: FunctionInfo):
+        self.a = analysis
+        self.fn = fn
+        self.mod = fn.module
+        self.cfg = analysis.config
+        self.env: dict[str, Shape] = {}
+        self.ret: Shape = CLEAN
+        self.sink_params: dict[tuple[str, str], set] = {}
+        for i, p in enumerate(fn.params):
+            self.env[p] = Atom(frozenset({f"param:{i}"}))
+
+    def run(self) -> Summary:
+        body = getattr(self.fn.node, "body", None)
+        if not isinstance(body, list):         # lambda: body is an expression
+            self.ret = self.eval(self.fn.node.body)
+        else:
+            for _ in range(2):
+                for stmt in body:
+                    self.stmt(stmt)
+        hits = tuple(
+            SinkHit(kind=k, params=frozenset(
+                int(o[6:]) for o in origins if o.startswith("param:")),
+                detail=d)
+            for (k, d), origins in sorted(self.sink_params.items())
+            if any(o.startswith("param:") for o in origins))
+        return Summary(ret=bound(self.ret), sink_params=hits)
+
+    # -- statements --------------------------------------------------------
+
+    def stmt(self, node: ast.stmt) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            return  # nested defs are their own FunctionInfo
+        if isinstance(node, ast.Assign):
+            val = self.eval(node.value)
+            for t in node.targets:
+                self.assign(t, val)
+        elif isinstance(node, ast.AnnAssign) and node.value is not None:
+            self.assign(node.target, self.eval(node.value))
+        elif isinstance(node, ast.AugAssign):
+            val = self.eval(node.value)
+            if isinstance(node.target, ast.Name):
+                cur = self.env.get(node.target.id, CLEAN)
+                self.env[node.target.id] = join(cur, collapse(val))
+        elif isinstance(node, ast.Return):
+            if node.value is not None:
+                self.ret = join(self.ret, self.eval(node.value))
+        elif isinstance(node, ast.Expr):
+            self.eval(node.value)
+        elif isinstance(node, (ast.If, ast.While)):
+            self.eval(node.test)
+            for s in node.body + node.orelse:
+                self.stmt(s)
+        elif isinstance(node, ast.For) or isinstance(node, ast.AsyncFor):
+            it = self.eval(node.iter)
+            self.assign(node.target, elem_of(it), strong=True)
+            for s in node.body + node.orelse:
+                self.stmt(s)
+        elif isinstance(node, (ast.With, ast.AsyncWith)):
+            for item in node.items:
+                v = self.eval(item.context_expr)
+                if item.optional_vars is not None:
+                    self.assign(item.optional_vars, v)
+            for s in node.body:
+                self.stmt(s)
+        elif isinstance(node, ast.Try):
+            for s in (node.body + node.orelse + node.finalbody):
+                self.stmt(s)
+            for h in node.handlers:
+                for s in h.body:
+                    self.stmt(s)
+        elif isinstance(node, ast.Raise):
+            if node.exc is not None:
+                shape = self.eval(node.exc)
+                self.sink(node.exc.lineno, "exception", "raised expression",
+                          origins_of(shape))
+        elif isinstance(node, (ast.Delete, ast.Pass, ast.Break,
+                               ast.Continue, ast.Import, ast.ImportFrom,
+                               ast.Global, ast.Nonlocal)):
+            pass
+        elif isinstance(node, ast.Assert):
+            self.eval(node.test)
+            if node.msg is not None:
+                shape = self.eval(node.msg)
+                self.sink(node.msg.lineno, "exception", "assert message",
+                          origins_of(shape))
+
+    def assign(self, target: ast.expr, val: Shape,
+               strong: bool = False) -> None:
+        """strong=True rebinds instead of joining — used for loop and
+        comprehension targets, which Python rebinds fresh each iteration
+        (otherwise a same-named loop variable elsewhere in the function
+        would smear its taint into this one across fixpoint passes)."""
+        if isinstance(target, ast.Name):
+            prev = self.env.get(target.id)
+            if strong or prev is None:
+                self.env[target.id] = val
+            else:
+                self.env[target.id] = join(prev, val)
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            for i, t in enumerate(target.elts):
+                if isinstance(t, ast.Starred):
+                    self.assign(t.value, collapse(val), strong)
+                else:
+                    self.assign(t, index_of(val, i), strong)
+        elif isinstance(target, ast.Subscript):
+            # `d[k] = v` on a local: fold the store into the container shape
+            self.eval(target.value)
+            if isinstance(target.value, ast.Name):
+                name = target.value.id
+                key = self.eval(target.slice)
+                cur = self.env.get(name, CLEAN)
+                self.env[name] = bound(join(cur, Map(collapse(key),
+                                                     collapse(val))))
+        elif isinstance(target, ast.Attribute):
+            # field stores are not tracked (documented limitation);
+            # still evaluate for sink effects
+            self.eval(target.value)
+
+    # -- expressions -------------------------------------------------------
+
+    def eval(self, node: ast.expr) -> Shape:  # noqa: C901 — one dispatch table
+        if isinstance(node, ast.Name):
+            return self.env.get(node.id, CLEAN)
+        if isinstance(node, ast.Constant):
+            return CLEAN
+        if isinstance(node, ast.Call):
+            return self.eval_call(node)
+        if isinstance(node, ast.Attribute):
+            base = self.eval(node.value)
+            if node.attr in self.cfg.attr_sources:
+                return Atom(origins_of(base) | {f"src:{node.attr}"})
+            return collapse(base)
+        if isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+            elems = tuple(self.eval(e) for e in node.elts
+                          if not isinstance(e, ast.Starred))
+            if isinstance(node, ast.Tuple):
+                return bound(Tup(elems))
+            out: Shape = CLEAN
+            for e in elems:
+                out = join(out, e)
+            return bound(Seq(out))
+        if isinstance(node, ast.Dict):
+            k: Shape = CLEAN
+            v: Shape = CLEAN
+            for kn, vn in zip(node.keys, node.values):
+                if kn is not None:
+                    k = join(k, self.eval(kn))
+                v = join(v, self.eval(vn))
+            return bound(Map(k, v))
+        if isinstance(node, (ast.ListComp, ast.SetComp, ast.GeneratorExp)):
+            self._bind_comprehension(node.generators)
+            return bound(Seq(collapse(self.eval(node.elt))))
+        if isinstance(node, ast.DictComp):
+            self._bind_comprehension(node.generators)
+            return bound(Map(collapse(self.eval(node.key)),
+                             collapse(self.eval(node.value))))
+        if isinstance(node, ast.Subscript):
+            base = self.eval(node.value)
+            key = node.slice.value if isinstance(node.slice, ast.Constant) else None
+            return index_of(base, key)
+        if isinstance(node, ast.JoinedStr):
+            out: frozenset = frozenset()
+            for part in node.values:
+                if isinstance(part, ast.FormattedValue):
+                    shape = self.eval(part.value)
+                    self.sink(node.lineno, "format", "f-string interpolation",
+                              origins_of(shape))
+                    out |= origins_of(shape)
+            return Atom(out)
+        if isinstance(node, ast.FormattedValue):
+            return self.eval(node.value)
+        if isinstance(node, (ast.BinOp, ast.BoolOp, ast.Compare, ast.UnaryOp)):
+            parts: list[ast.expr] = []
+            if isinstance(node, ast.BinOp):
+                parts = [node.left, node.right]
+                if (isinstance(node.op, ast.Mod)
+                        and isinstance(node.left, ast.Constant)
+                        and isinstance(node.left.value, str)):
+                    rhs = self.eval(node.right)
+                    self.sink(node.lineno, "format", "%-formatting",
+                              origins_of(rhs))
+                    return collapse(rhs)
+            elif isinstance(node, ast.BoolOp):
+                parts = node.values
+            elif isinstance(node, ast.Compare):
+                parts = [node.left] + list(node.comparators)
+            else:
+                parts = [node.operand]
+            out: Shape = CLEAN
+            for p in parts:
+                out = join(out, collapse(self.eval(p)))
+            return out
+        if isinstance(node, ast.IfExp):
+            self.eval(node.test)
+            return join(self.eval(node.body), self.eval(node.orelse))
+        if isinstance(node, ast.Await):
+            return self.eval(node.value)
+        if isinstance(node, (ast.Starred,)):
+            return self.eval(node.value)
+        if isinstance(node, ast.Lambda):
+            return CLEAN
+        if isinstance(node, ast.NamedExpr):
+            val = self.eval(node.value)
+            self.assign(node.target, val)
+            return val
+        return CLEAN
+
+    def _bind_comprehension(self, generators) -> None:
+        for gen in generators:
+            self.assign(gen.target, elem_of(self.eval(gen.iter)), strong=True)
+            for cond in gen.ifs:
+                self.eval(cond)
+
+    # -- calls -------------------------------------------------------------
+
+    def eval_call(self, node: ast.Call) -> Shape:
+        dotted = _flatten(node.func)
+        expanded = self._expand(dotted)
+        resolved = self._resolve(dotted)
+        attr = dotted.rpartition(".")[2] if dotted else ""
+        arg_shapes = [self.eval(a) for a in node.args]
+        kw_shapes = {kw.arg: self.eval(kw.value) for kw in node.keywords}
+        recv_shape = (self.eval(node.func.value)
+                      if isinstance(node.func, ast.Attribute) else CLEAN)
+        all_args: list[Shape] = [recv_shape] if isinstance(
+            node.func, ast.Attribute) else []
+        all_args += arg_shapes + list(kw_shapes.values())
+
+        self._check_sinks(node, dotted, expanded, resolved, attr,
+                          arg_shapes, kw_shapes)
+
+        # container mutators write back into the receiver's tracked shape
+        # (`out.append((b, shares))` keeps `out` carrying the tuple shape)
+        if isinstance(node.func, ast.Attribute) and attr in {
+                "append", "add", "insert", "extend", "update", "push"}:
+            base = node.func.value
+            while isinstance(base, (ast.Subscript, ast.Attribute)):
+                base = base.value
+            if isinstance(base, ast.Name) and arg_shapes:
+                cur = self.env.get(base.id, CLEAN)
+                if isinstance(node.func.value, ast.Name) and attr in {
+                        "append", "add"}:
+                    add = Seq(arg_shapes[-1])
+                elif attr in {"extend", "update"}:
+                    add = arg_shapes[0]
+                else:
+                    merged: frozenset = frozenset()
+                    for s in arg_shapes:
+                        merged |= origins_of(s)
+                    add = Atom(merged)
+                self.env[base.id] = bound(join(cur, add))
+            return CLEAN
+
+        # container accessors and shape-aware builtins keep structure precise
+        # (otherwise `for k, v in d.items()` smears value taint onto keys)
+        if isinstance(node.func, ast.Attribute) and not node.args \
+                and attr in {"items", "keys", "values"}:
+            if isinstance(recv_shape, Map):
+                if attr == "items":
+                    return bound(Seq(Tup((recv_shape.key, recv_shape.val))))
+                if attr == "keys":
+                    return bound(Seq(recv_shape.key))
+                return bound(Seq(recv_shape.val))
+            return collapse(recv_shape)
+        if dotted == "enumerate" and node.args:
+            return bound(Seq(Tup((CLEAN, elem_of(arg_shapes[0])))))
+        if dotted == "zip" and node.args:
+            return bound(Seq(Tup(tuple(elem_of(s) for s in arg_shapes))))
+        if dotted in {"sorted", "list", "tuple", "set", "frozenset",
+                      "reversed", "iter", "dict"} and node.args:
+            return arg_shapes[0]
+
+        names = [n for n in (expanded, resolved) if n]
+        for name in names:
+            if matches_any(name, self.cfg.sanitizers):
+                return CLEAN
+        src = None
+        for name in names:
+            src = matches_any(name, self.cfg.call_sources)
+            if src:
+                break
+        if src is not None:
+            return Atom(frozenset({f"src:{src}"}))
+
+        fn = self.index_fn(resolved)
+        if fn is not None:
+            return self._apply_summary(node, fn, arg_shapes, recv_shape)
+        # unresolved: conservative propagation through the call
+        out: frozenset = frozenset()
+        for s in all_args:
+            out |= origins_of(s)
+        return Atom(out)
+
+    def index_fn(self, resolved: str | None) -> FunctionInfo | None:
+        if resolved is None:
+            return None
+        fn = self.a.index.functions.get(resolved)
+        if fn is not None:
+            return fn
+        cls = self.a.index.classes.get(resolved)
+        if cls is not None:
+            return cls.methods.get("__init__")
+        return None
+
+    def _apply_summary(self, node: ast.Call, fn: FunctionInfo,
+                       arg_shapes: list[Shape], recv_shape: Shape) -> Shape:
+        summary = self.a.summary_of(fn.qualname)
+        # bind positional args to params; methods called on an instance get
+        # the receiver as param 0 (self)
+        bound_args: list[Shape] = []
+        if fn.class_name and isinstance(node.func, ast.Attribute) \
+                and fn.params and fn.params[0] == "self":
+            bound_args.append(recv_shape)
+        bound_args += arg_shapes
+        argmap = {f"param:{i}": origins_of(s)
+                  for i, s in enumerate(bound_args)}
+        for hit in summary.sink_params:
+            origins: frozenset = frozenset()
+            for i in hit.params:
+                if i < len(bound_args):
+                    origins |= origins_of(bound_args[i])
+            if origins:
+                short = fn.qualname.rpartition(".")[2] if not fn.class_name \
+                    else ".".join(fn.qualname.rsplit(".", 2)[1:])
+                self.sink(node.lineno, hit.kind,
+                          f"argument of {short}() ({hit.detail})", origins)
+        return subst(summary.ret, argmap)
+
+    # -- sinks -------------------------------------------------------------
+
+    def _check_sinks(self, node: ast.Call, dotted: str | None, expanded,
+                     resolved, attr: str, arg_shapes, kw_shapes) -> None:
+        line = node.lineno
+        tainted = frozenset()
+        for s in list(arg_shapes) + list(kw_shapes.values()):
+            tainted |= origins_of(s)
+        if not tainted:
+            return
+        recv = dotted.rpartition(".")[0] if dotted and "." in dotted else ""
+        if attr in _LOG_METHODS and self._is_logger(recv):
+            self.sink(line, "log", f"{recv}.{attr}()", tainted)
+        for name in (expanded, resolved):
+            if name and (dotted_endswith(name, "errors.new")
+                         or dotted_endswith(name, "errors.wrap")):
+                self.sink(line, "exception", f"{attr}()", tainted)
+                break
+        if attr in _METRIC_METHODS and self._is_metric(recv):
+            self.sink(line, "metric-label", f"{recv}.{attr}()", tainted)
+        if dotted == "repr":
+            self.sink(line, "format", "repr()", tainted)
+        if (attr == "format" and isinstance(node.func, ast.Attribute)
+                and isinstance(node.func.value, ast.Constant)):
+            self.sink(line, "format", "str.format()", tainted)
+        if attr in _WRITE_METHODS and not matches_any(
+                self.mod.name, self.cfg.write_exempt_modules):
+            self.sink(line, "file-write", f".{attr}()", tainted)
+
+    def _is_logger(self, recv: str) -> bool:
+        base = recv.split(".")[0]
+        if base in {"log", "_log", "logger", "_logger"}:
+            return True
+        b = self.mod.bindings.get(base)
+        return b is not None and dotted_endswith(b.target, "with_topic")
+
+    def _is_metric(self, recv: str) -> bool:
+        base = recv.split(".")[0]
+        b = self.mod.bindings.get(base)
+        if b is None:
+            return False
+        tail = b.target.rpartition(".")[2]
+        return tail in {"counter", "gauge", "histogram"}
+
+    def sink(self, line: int, kind: str, detail: str,
+             origins: frozenset) -> None:
+        if not origins:
+            return
+        self.a.report(self.mod, line, kind, detail, origins)
+        key = (kind, detail)
+        self.sink_params.setdefault(key, set()).update(
+            o for o in origins if o.startswith("param:"))
+
+    # -- name resolution ---------------------------------------------------
+
+    def _expand(self, dotted: str | None) -> str | None:
+        if not dotted:
+            return None
+        head, _, rest = dotted.partition(".")
+        target = self.mod.imports.get(head)
+        if target:
+            return f"{target}.{rest}" if rest else target
+        return dotted
+
+    def _resolve(self, dotted: str | None) -> str | None:
+        if not dotted:
+            return None
+        idx = self.a.index
+        return idx.resolve(f"{self.mod.name}.{dotted}") or idx.resolve(dotted)

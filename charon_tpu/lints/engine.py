@@ -1,14 +1,26 @@
 """AST lint engine: file discovery, directive parsing, caching, baseline.
 
 The engine is deliberately small: a `Rule` is any object with an `id`, a
-`description`, and a `check(SourceFile) -> Iterable[Finding]` method. The
-engine owns everything rules should not have to re-implement —
+`description`, and a `check(SourceFile) -> Iterable[Finding]` method; a
+*project* rule instead (or additionally) has `check_project(index, root)`
+plus a `project_scope` of `"file"` or `"tree"` and sees the whole-program
+`ProjectIndex` (lints/project.py). The engine owns everything rules should
+not have to re-implement —
 
-  * parsing each file once into an AST with a parent map,
+  * parsing each file once into an AST with a parent map (a per-process
+    memo shares parses between the per-file and project stages;
+    `Engine.stats["parsed"]` counts real `ast.parse` calls),
   * `# lint:` comment directives (suppressions and protocol claims),
-  * content-hash keyed per-file caching (linting the whole tree twice in
-    one process, e.g. the CLI followed by the self-check test, parses each
-    file once; `--cache PATH` persists across runs),
+  * caching with dependency fingerprints (`--cache PATH` persists across
+    runs). Four buckets: per-file findings and per-file import lists are
+    keyed by content hash; `project_scope="file"` findings (secret taint —
+    sound under the file's own import closure) are keyed by a *dependency
+    fingerprint*, the hash of the content keys of the file's transitive
+    import closure, so editing an imported module invalidates dependents;
+    `project_scope="tree"` findings (reachability / global consistency)
+    are keyed by a tree key over every fingerprint plus any non-Python
+    inputs a rule declares via a `doc_rel` attribute. A clean re-run hits
+    all four buckets and parses nothing,
   * the baseline: grandfathered findings are identified by a line-free
     `rule|path|message` key so unrelated edits above a finding don't churn
     the baseline, and only counts *above* the baselined count are "new".
@@ -38,7 +50,7 @@ from pathlib import Path
 from typing import Iterable, Protocol, runtime_checkable
 
 # Bump when rule semantics change: invalidates persisted caches.
-RULES_VERSION = 8
+RULES_VERSION = 9
 
 PARSE_RULE = "LINT-PARSE-000"
 
@@ -133,8 +145,27 @@ class Rule(Protocol):
     def check(self, src: SourceFile) -> Iterable[Finding]: ...
 
 
+@runtime_checkable
+class ProjectRule(Protocol):
+    """Whole-program rule: sees the shared ProjectIndex instead of one file.
+
+    `project_scope` declares what the rule's findings for a file depend on:
+    "file" — only that file's transitive import closure (cacheable per
+    dependency fingerprint); "tree" — the whole tree (reachability crosses
+    *importer* boundaries, or the check is a global consistency pass)."""
+
+    id: str
+    description: str
+    project_scope: str
+
+    def check_project(self, index, root: Path) -> Iterable[Finding]: ...
+
+
+_CACHE_BUCKETS = ("files", "imports", "project_files", "project_tree")
+
+
 class Engine:
-    """Runs rules over files with per-file content-hash caching."""
+    """Runs rules over files with dependency-fingerprinted caching."""
 
     def __init__(self, rules: list[Rule] | None = None,
                  cache_path: Path | str | None = None):
@@ -144,15 +175,26 @@ class Engine:
             rules = default_rules()
         self.rules: list[Rule] = list(rules)
         self.cache_path = Path(cache_path) if cache_path else None
-        self._cache: dict[str, list[dict]] = {}
+        self._cache: dict[str, dict] = {b: {} for b in _CACHE_BUCKETS}
         self._cache_dirty = False
+        # content_key -> SourceFile | SyntaxError: one parse per content
+        # per process, shared by the per-file and project stages
+        self._sources: dict[str, SourceFile | SyntaxError] = {}
+        self.stats = {"parsed": 0}
+        # populated by lint_paths for CLI consumers (--changed, manifests)
+        self.fingerprints: dict[str, str] = {}
+        self.import_graph: dict[str, list[str]] = {}
+        self.tree_key: str | None = None
         if self.cache_path is not None and self.cache_path.exists():
             try:
                 raw = json.loads(self.cache_path.read_text())
                 if raw.get("version") == RULES_VERSION:
-                    self._cache = raw.get("files", {})
+                    for bucket in _CACHE_BUCKETS:
+                        got = raw.get(bucket, {})
+                        if isinstance(got, dict):
+                            self._cache[bucket] = got
             except (ValueError, OSError):
-                self._cache = {}
+                pass
 
     # -- discovery ---------------------------------------------------------
 
@@ -183,47 +225,195 @@ class Engine:
                    root: Path | str | None = None) -> list[Finding]:
         """Lint files/directories; paths in findings are relative to `root`
         (default: the current working directory). Run from the repo root —
-        or pass it — so baseline paths stay stable."""
+        or pass it — so baseline paths stay stable. Runs the per-file rules
+        over each file, then the project rules over the whole set."""
         root = Path(root) if root is not None else Path.cwd()
-        findings: list[Finding] = []
+        entries: list[tuple[Path, str, str, str]] = []
         for path in self.discover(paths):
             try:
                 rel = path.resolve().relative_to(root.resolve()).as_posix()
             except ValueError:  # outside root: keep it lintable anyway
                 rel = path.as_posix()
-            findings.extend(self.lint_file(path, rel))
+            text = path.read_text()
+            entries.append((path, rel, text, self._content_key(rel, text)))
+        findings: list[Finding] = []
+        for path, rel, text, key in entries:
+            findings.extend(self._file_stage(path, rel, text, key))
+        findings.extend(self._project_stage(entries, root))
         self._save_cache()
         return sorted(findings)
 
-    def lint_file(self, path: Path, rel: str) -> list[Finding]:
-        text = Path(path).read_text()
-        key = hashlib.sha256(
+    @staticmethod
+    def _content_key(rel: str, text: str) -> str:
+        return hashlib.sha256(
             f"{RULES_VERSION}|{rel}|".encode() + text.encode()).hexdigest()
-        cached = self._cache.get(key)
+
+    def lint_file(self, path: Path, rel: str) -> list[Finding]:
+        """Per-file rules only (no project stage); kept for targeted use."""
+        text = Path(path).read_text()
+        return self._file_stage(Path(path), rel, text,
+                                self._content_key(rel, text))
+
+    def _file_stage(self, path: Path, rel: str, text: str,
+                    key: str) -> list[Finding]:
+        cached = self._cache["files"].get(key)
         if cached is not None:
             return [Finding(**d) for d in cached]
-        findings = self._run_rules(path, rel, text)
-        self._cache[key] = [dataclasses.asdict(f) for f in findings]
+        findings = self._run_rules(path, rel, text, key)
+        self._cache["files"][key] = [dataclasses.asdict(f) for f in findings]
         self._cache_dirty = True
         return findings
 
-    def _run_rules(self, path: Path, rel: str, text: str) -> list[Finding]:
-        try:
-            src = SourceFile(Path(path), rel, text)
-        except SyntaxError as exc:
-            return [Finding(rel, exc.lineno or 0, PARSE_RULE,
-                            f"file does not parse: {exc.msg}")]
+    def _source_for(self, path: Path, rel: str, text: str,
+                    key: str) -> SourceFile | SyntaxError:
+        got = self._sources.get(key)
+        if got is None:
+            try:
+                got = SourceFile(Path(path), rel, text)
+                self.stats["parsed"] += 1
+            except SyntaxError as exc:
+                got = exc
+            self._sources[key] = got
+        return got
+
+    def _run_rules(self, path: Path, rel: str, text: str,
+                   key: str) -> list[Finding]:
+        src = self._source_for(path, rel, text, key)
+        if isinstance(src, SyntaxError):
+            return [Finding(rel, src.lineno or 0, PARSE_RULE,
+                            f"file does not parse: {src.msg}")]
         out: list[Finding] = []
         for rule in self.rules:
-            for f in rule.check(src):
+            check = getattr(rule, "check", None)
+            if check is None:  # project-only rule
+                continue
+            for f in check(src):
                 if not src.suppressed(f.rule, f.line):
                     out.append(f)
         return sorted(out)
 
+    # -- project stage -------------------------------------------------------
+
+    def _project_stage(self, entries: list[tuple[Path, str, str, str]],
+                       root: Path) -> list[Finding]:
+        from .project import ProjectIndex, imported_module_rels
+
+        self.fingerprints = {}
+        self.import_graph = {}
+        self.tree_key = None
+        if not entries:
+            return []
+        rel_to_key = {rel: key for _, rel, _, key in entries}
+
+        # import lists, from cache where possible: this is what lets a clean
+        # re-run compute every fingerprint without a single ast.parse
+        for path, rel, text, key in entries:
+            imp = self._cache["imports"].get(key)
+            if imp is None:
+                src = self._source_for(path, rel, text, key)
+                imp = ([] if isinstance(src, SyntaxError)
+                       else imported_module_rels(src))
+                self._cache["imports"][key] = imp
+                self._cache_dirty = True
+            self.import_graph[rel] = sorted(
+                r for r in imp if r in rel_to_key and r != rel)
+
+        # dependency fingerprint: content keys over the transitive import
+        # closure (cycle-safe via the visited set)
+        for rel in rel_to_key:
+            closure = {rel}
+            stack = [rel]
+            while stack:
+                for dep in self.import_graph.get(stack.pop(), ()):
+                    if dep not in closure:
+                        closure.add(dep)
+                        stack.append(dep)
+            h = hashlib.sha256(f"{RULES_VERSION}|".encode())
+            for dep in sorted(closure):
+                h.update(rel_to_key[dep].encode())
+            self.fingerprints[rel] = h.hexdigest()
+
+        project_rules = [r for r in self.rules
+                         if hasattr(r, "check_project")]
+        if not project_rules:
+            return []
+
+        # tree key: every fingerprint plus non-Python rule inputs (docs)
+        th = hashlib.sha256(f"{RULES_VERSION}|tree|".encode())
+        for rel in sorted(self.fingerprints):
+            th.update(self.fingerprints[rel].encode())
+        doc_rels = sorted({getattr(r, "doc_rel", "")
+                           for r in project_rules} - {""})
+        for doc_rel in doc_rels:
+            th.update(doc_rel.encode())
+            doc = root / doc_rel
+            if doc.exists():
+                th.update(hashlib.sha256(doc.read_bytes()).digest())
+        self.tree_key = th.hexdigest()
+
+        findings: list[Finding] = []
+        to_run: list = []
+        for rule in project_rules:
+            cached = self._cached_project(rule)
+            if cached is None:
+                to_run.append(rule)
+            else:
+                findings.extend(cached)
+        if not to_run:
+            return findings
+
+        # at least one rule misses: parse everything, build the shared index
+        src_by_rel: dict[str, SourceFile] = {}
+        for path, rel, text, key in entries:
+            src = self._source_for(path, rel, text, key)
+            if not isinstance(src, SyntaxError):
+                src_by_rel[rel] = src
+        index = ProjectIndex.build(src_by_rel.values())
+        for rule in to_run:
+            raw = sorted(rule.check_project(index, root))
+            kept = []
+            for f in raw:
+                src = src_by_rel.get(f.path)
+                if src is not None and src.suppressed(f.rule, f.line):
+                    continue
+                kept.append(f)
+            findings.extend(kept)
+            self._store_project(rule, kept)
+        return findings
+
+    def _cached_project(self, rule) -> list[Finding] | None:
+        if getattr(rule, "project_scope", "tree") == "file":
+            out: list[Finding] = []
+            for rel, fp in self.fingerprints.items():
+                cached = self._cache["project_files"].get(
+                    f"{rule.id}|{rel}|{fp}")
+                if cached is None:
+                    return None
+                out.extend(Finding(**d) for d in cached)
+            return out
+        cached = self._cache["project_tree"].get(f"{rule.id}|{self.tree_key}")
+        if cached is None:
+            return None
+        return [Finding(**d) for d in cached]
+
+    def _store_project(self, rule, kept: list[Finding]) -> None:
+        if getattr(rule, "project_scope", "tree") == "file":
+            grouped: dict[str, list[Finding]] = {
+                rel: [] for rel in self.fingerprints}
+            for f in kept:
+                grouped.setdefault(f.path, []).append(f)
+            for rel, fp in self.fingerprints.items():
+                self._cache["project_files"][f"{rule.id}|{rel}|{fp}"] = [
+                    dataclasses.asdict(f) for f in grouped[rel]]
+        else:
+            self._cache["project_tree"][f"{rule.id}|{self.tree_key}"] = [
+                dataclasses.asdict(f) for f in kept]
+        self._cache_dirty = True
+
     def _save_cache(self) -> None:
         if self.cache_path is None or not self._cache_dirty:
             return
-        payload = {"version": RULES_VERSION, "files": self._cache}
+        payload = {"version": RULES_VERSION, **self._cache}
         try:
             self.cache_path.parent.mkdir(parents=True, exist_ok=True)
             self.cache_path.write_text(json.dumps(payload))

@@ -1,0 +1,776 @@
+"""Whole-program project index: modules, symbols, imports, and a call graph.
+
+Built once per lint run over every parsed ``SourceFile`` and shared by all
+project rules (engine.lint_paths builds it; rules receive it via
+``ProjectRule.check_project``).  Three layers:
+
+  * **module/symbol table** — dotted module names derived from paths
+    relative to the lint root (``charon_tpu/dkg/frost.py`` →
+    ``charon_tpu.dkg.frost``), per-module maps of top-level functions,
+    classes (with methods), module-level call bindings (``_log =
+    log.with_topic("x")``), imports (absolute, relative, aliased), star
+    imports, and ``__init__.py`` re-exports — resolvable through
+    ``ProjectIndex.resolve``.
+  * **call graph** — one ``CallEdge`` per call site / function reference,
+    resolved precisely where the receiver is known (imports, self-methods,
+    locally-constructed instances, annotations) and by name (CHA over
+    ``methods_by_name``, plus ``# lint: implements=`` protocol claims)
+    otherwise.  Edges carry a ``kind``: ``call`` (synchronous), ``ref``
+    (function value taken — may be called), ``executor`` (handed to a
+    sanctioned executor boundary: ``run_in_executor``, ``.submit``,
+    ``asyncio.to_thread``, ``aio.spawn`` — severed by the async-blocking
+    rule, traversed by taint).
+  * **traversal** — ``reachable`` walks edges cycle-safely (visited set);
+    ``callers_of`` inverts the graph for sink-to-root reporting.
+
+The index is deliberately approximate where Python is dynamic: unresolved
+attribute calls fall back to class-hierarchy-analysis by method name with a
+stoplist of generic names, so rules stay high-signal.  ``functools.partial``
+and bare function references create ``ref`` edges; decorated and async defs
+index like plain ones.
+"""
+
+from __future__ import annotations
+
+import ast
+import builtins
+from dataclasses import dataclass, field
+from typing import Iterable
+
+from .engine import SourceFile
+
+# Attribute names too generic for name-based (CHA) call resolution.
+_CHA_STOPLIST = {
+    "get", "set", "put", "add", "pop", "update", "items", "keys", "values",
+    "append", "extend", "remove", "clear", "copy", "clone", "close", "open",
+    "read", "write", "start", "stop", "run", "send", "recv", "join", "split",
+    "strip", "encode", "decode", "format", "hex", "index", "count", "sort",
+    "setdefault", "name", "value", "result", "submit", "done", "wait",
+}
+
+# Call shapes that hand work to another thread/task: edges created from
+# their argument expressions are marked kind="executor" (the sanctioned
+# sanitizer seam for LINT-ASY-014; taint still flows through them).
+_EXECUTOR_ATTRS = {"run_in_executor", "submit", "to_thread", "spawn"}
+_EXECUTOR_SUFFIXES = (
+    "asyncio.to_thread", "aio.spawn", "threshold_aggregate_verify_submit",
+)
+
+_BUILTIN_NAMES = frozenset(dir(builtins))
+
+
+def dotted_endswith(dotted: str, suffix: str) -> bool:
+    """True if `dotted` equals `suffix` or ends with `.suffix`."""
+    return dotted == suffix or dotted.endswith("." + suffix)
+
+
+def matches_any(dotted: str | None, suffixes: Iterable[str]) -> str | None:
+    """First suffix in `suffixes` that `dotted` matches, else None."""
+    if not dotted:
+        return None
+    for s in suffixes:
+        if dotted_endswith(dotted, s):
+            return s
+    return None
+
+
+@dataclass
+class CallEdge:
+    caller: str            # qualname of the enclosing function ("" = module top level)
+    callee: str            # resolved dotted name (internal qualname or external)
+    kind: str              # "call" | "ref" | "executor"
+    line: int
+    internal: bool         # callee is a FunctionInfo in this index
+    precise: bool          # resolved through scope/imports, not name-based CHA
+
+
+@dataclass
+class BindingInfo:
+    """Module-level `name = callee(args...)` binding (log topics, metrics)."""
+
+    name: str
+    target: str            # resolved dotted callee of the RHS call
+    const_args: tuple      # constant positional args (metric names etc.)
+    line: int
+
+
+@dataclass
+class FunctionInfo:
+    qualname: str
+    name: str
+    module: "ModuleInfo"
+    node: ast.AST
+    is_async: bool
+    class_name: str | None = None
+    decorators: list[str] = field(default_factory=list)
+    params: list[str] = field(default_factory=list)
+    # param name -> annotation dotted name (best effort)
+    annotations: dict[str, str] = field(default_factory=dict)
+
+
+@dataclass
+class ClassInfo:
+    qualname: str
+    name: str
+    module: "ModuleInfo"
+    node: ast.ClassDef
+    bases: list[str] = field(default_factory=list)
+    methods: dict[str, FunctionInfo] = field(default_factory=dict)
+    protocols: list[str] = field(default_factory=list)  # implements= claims
+    is_protocol: bool = False
+
+
+@dataclass
+class ModuleInfo:
+    name: str
+    src: SourceFile
+    is_init: bool
+    imports: dict[str, str] = field(default_factory=dict)
+    star_imports: list[str] = field(default_factory=list)
+    functions: dict[str, FunctionInfo] = field(default_factory=dict)
+    classes: dict[str, ClassInfo] = field(default_factory=dict)
+    bindings: dict[str, BindingInfo] = field(default_factory=dict)
+
+    @property
+    def package(self) -> str:
+        if self.is_init:
+            return self.name
+        return self.name.rpartition(".")[0]
+
+
+def module_name_for(rel: str) -> tuple[str, bool]:
+    """Dotted module name for a root-relative posix path."""
+    parts = rel[:-3].split("/") if rel.endswith(".py") else rel.split("/")
+    is_init = parts[-1] == "__init__"
+    if is_init:
+        parts = parts[:-1]
+    return ".".join(p for p in parts if p), is_init
+
+
+def imported_module_rels(src: SourceFile) -> list[str]:
+    """Root-relative paths of modules `src` imports — resolved textually
+    (no index needed) so the engine can fingerprint dependencies from a
+    cached import list without re-parsing.  Returns candidate rel paths;
+    the engine keeps the ones that exist in the linted file set."""
+    name, is_init = module_name_for(src.rel)
+    base = name.split(".") if name else []
+    if not is_init and base:
+        pkg = base[:-1]
+    else:
+        pkg = base
+    out: set[str] = set()
+
+    def add(dotted: str) -> None:
+        if not dotted:
+            return
+        p = dotted.replace(".", "/")
+        out.add(p + ".py")
+        out.add(p + "/__init__.py")
+
+    for node in ast.walk(src.tree):
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                add(alias.name)
+        elif isinstance(node, ast.ImportFrom):
+            if node.level:
+                anchor = pkg[:len(pkg) - (node.level - 1)] if node.level > 1 else pkg
+                prefix = ".".join(anchor)
+                target = f"{prefix}.{node.module}" if node.module else prefix
+            else:
+                target = node.module or ""
+            add(target)
+            # `from x import y` where y is itself a module
+            for alias in node.names:
+                if alias.name != "*":
+                    add(f"{target}.{alias.name}" if target else alias.name)
+    return sorted(out)
+
+
+class ProjectIndex:
+    """Symbol table + call graph over one lint run's files."""
+
+    def __init__(self, root_name: str = ""):
+        self.modules: dict[str, ModuleInfo] = {}
+        self.by_rel: dict[str, ModuleInfo] = {}
+        self.functions: dict[str, FunctionInfo] = {}
+        self.classes: dict[str, ClassInfo] = {}
+        self.methods_by_name: dict[str, list[FunctionInfo]] = {}
+        self.implementers: dict[str, list[ClassInfo]] = {}
+        self.edges: dict[str, list[CallEdge]] = {}
+        self._rev: dict[str, list[CallEdge]] | None = None
+
+    # -- construction ------------------------------------------------------
+
+    @classmethod
+    def build(cls, files: Iterable[SourceFile]) -> "ProjectIndex":
+        idx = cls()
+        for src in files:
+            idx._add_module(src)
+        idx._link()
+        for mod in idx.modules.values():
+            _GraphBuilder(idx, mod).run()
+        return idx
+
+    def _add_module(self, src: SourceFile) -> None:
+        name, is_init = module_name_for(src.rel)
+        mod = ModuleInfo(name=name, src=src, is_init=is_init)
+        self.modules[name] = mod
+        self.by_rel[src.rel] = mod
+        _SymbolCollector(self, mod).visit(src.tree)
+
+    def _link(self) -> None:
+        """Second pass: protocol-claim registry + method name index."""
+        for cls_info in self.classes.values():
+            for proto in cls_info.protocols:
+                self.implementers.setdefault(proto, []).append(cls_info)
+            # name-match: a class whose bases include an indexed Protocol
+            for base in cls_info.bases:
+                tail = base.rpartition(".")[2]
+                for other in self.classes.values():
+                    if other.is_protocol and other.name == tail:
+                        self.implementers.setdefault(tail, []).append(cls_info)
+        for fn in self.functions.values():
+            if fn.class_name:
+                self.methods_by_name.setdefault(fn.name, []).append(fn)
+
+    # -- resolution --------------------------------------------------------
+
+    def resolve(self, dotted: str, _seen: frozenset = frozenset()) -> str | None:
+        """Resolve a dotted name to an indexed qualname (function, class,
+        binding, or module) following import re-export chains cycle-safely.
+        Returns the canonical qualname or None for externals."""
+        if dotted in _seen:
+            return None
+        _seen = _seen | {dotted}
+        if dotted in self.functions or dotted in self.classes or dotted in self.modules:
+            return dotted
+        # longest module prefix + remaining attribute path
+        parts = dotted.split(".")
+        for cut in range(len(parts) - 1, 0, -1):
+            mod = self.modules.get(".".join(parts[:cut]))
+            if mod is None:
+                continue
+            rest = parts[cut:]
+            return self._resolve_in_module(mod, rest, _seen)
+        return None
+
+    def _resolve_in_module(self, mod: ModuleInfo, rest: list[str],
+                           _seen: frozenset) -> str | None:
+        head, tail = rest[0], rest[1:]
+        if head in mod.functions and not tail:
+            return mod.functions[head].qualname
+        if head in mod.classes:
+            cls_info = mod.classes[head]
+            if not tail:
+                return cls_info.qualname
+            if tail[0] in cls_info.methods and len(tail) == 1:
+                return cls_info.methods[tail[0]].qualname
+            return None
+        if head in mod.bindings and not tail:
+            return f"{mod.name}.{head}"
+        if head in mod.imports:
+            target = mod.imports[head]
+            return self.resolve(".".join([target] + tail), _seen)
+        for starred in mod.star_imports:
+            smod = self.modules.get(starred)
+            if smod is not None:
+                got = self._resolve_in_module(smod, rest, _seen)
+                if got is not None:
+                    return got
+        # `pkg.sub` attribute access on a package resolves to the submodule
+        sub = f"{mod.name}.{head}"
+        if sub in self.modules:
+            return self.resolve(".".join([sub] + tail), _seen) or sub
+        return None
+
+    def binding_for(self, qualname: str) -> BindingInfo | None:
+        mod_name, _, name = qualname.rpartition(".")
+        mod = self.modules.get(mod_name)
+        if mod is not None:
+            return mod.bindings.get(name)
+        return None
+
+    # -- traversal ---------------------------------------------------------
+
+    def out_edges(self, qualname: str) -> list[CallEdge]:
+        return self.edges.get(qualname, [])
+
+    def reachable(self, roots: Iterable[str],
+                  kinds: tuple[str, ...] = ("call", "ref"),
+                  ) -> dict[str, tuple[str, ...]]:
+        """Qualnames reachable from `roots` over edges of `kinds`, mapped to
+        one shortest call path (root, ..., qualname). Cycle-safe."""
+        paths: dict[str, tuple[str, ...]] = {}
+        queue: list[str] = []
+        for r in roots:
+            if r not in paths:
+                paths[r] = (r,)
+                queue.append(r)
+        while queue:
+            cur = queue.pop(0)
+            for e in self.out_edges(cur):
+                if e.kind not in kinds or not e.internal:
+                    continue
+                if e.callee not in paths:
+                    paths[e.callee] = paths[cur] + (e.callee,)
+                    queue.append(e.callee)
+        return paths
+
+    def callers_of(self, qualname: str) -> list[CallEdge]:
+        if self._rev is None:
+            rev: dict[str, list[CallEdge]] = {}
+            for edges in self.edges.values():
+                for e in edges:
+                    rev.setdefault(e.callee, []).append(e)
+            self._rev = rev
+        return self._rev.get(qualname, [])
+
+
+class _SymbolCollector(ast.NodeVisitor):
+    """First pass over one module: defs, classes, imports, bindings."""
+
+    def __init__(self, idx: ProjectIndex, mod: ModuleInfo):
+        self.idx = idx
+        self.mod = mod
+        self._class_stack: list[ClassInfo] = []
+        self._fn_stack: list[FunctionInfo] = []
+
+    # imports ---------------------------------------------------------------
+
+    def visit_Import(self, node: ast.Import) -> None:
+        for alias in node.names:
+            if alias.asname:
+                self.mod.imports[alias.asname] = alias.name
+            else:
+                top = alias.name.split(".")[0]
+                self.mod.imports[top] = top
+
+    def visit_ImportFrom(self, node: ast.ImportFrom) -> None:
+        if node.level:
+            pkg = self.mod.package.split(".") if self.mod.package else []
+            anchor = pkg[:len(pkg) - (node.level - 1)] if node.level > 1 else pkg
+            prefix = ".".join(anchor)
+            base = f"{prefix}.{node.module}" if node.module else prefix
+        else:
+            base = node.module or ""
+        for alias in node.names:
+            if alias.name == "*":
+                self.mod.star_imports.append(base)
+            else:
+                target = f"{base}.{alias.name}" if base else alias.name
+                self.mod.imports[alias.asname or alias.name] = target
+
+    # defs ------------------------------------------------------------------
+
+    def _qual(self, name: str) -> str:
+        if self._fn_stack:
+            return f"{self._fn_stack[-1].qualname}.{name}"
+        if self._class_stack:
+            return f"{self._class_stack[-1].qualname}.{name}"
+        return f"{self.mod.name}.{name}"
+
+    def _handle_def(self, node, is_async: bool) -> None:
+        qual = self._qual(node.name)
+        in_class = bool(self._class_stack) and not self._fn_stack
+        info = FunctionInfo(
+            qualname=qual, name=node.name, module=self.mod, node=node,
+            is_async=is_async,
+            class_name=self._class_stack[-1].name if in_class else None,
+            decorators=[_flatten(d) or "" for d in node.decorator_list],
+            params=[a.arg for a in node.args.args],
+            annotations={a.arg: _flatten(a.annotation) or ""
+                         for a in node.args.args if a.annotation},
+        )
+        self.idx.functions[qual] = info
+        if in_class:
+            self._class_stack[-1].methods[node.name] = info
+        elif not self._fn_stack:
+            self.mod.functions[node.name] = info
+        else:  # nested def: visible to the call-graph pass via local scope
+            self.mod.functions.setdefault(node.name, info)
+        self._fn_stack.append(info)
+        for child in node.body:
+            self.visit(child)
+        self._fn_stack.pop()
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        self._handle_def(node, is_async=False)
+
+    def visit_AsyncFunctionDef(self, node: ast.AsyncFunctionDef) -> None:
+        self._handle_def(node, is_async=True)
+
+    def visit_ClassDef(self, node: ast.ClassDef) -> None:
+        qual = self._qual(node.name)
+        bases = [_flatten(b) or "" for b in node.bases]
+        claims = list(self.mod.src.implements.get(node.lineno, []))
+        claims += self.mod.src.implements.get(node.lineno - 1, [])
+        info = ClassInfo(
+            qualname=qual, name=node.name, module=self.mod, node=node,
+            bases=bases, protocols=claims,
+            is_protocol=any(b.rpartition(".")[2] == "Protocol" for b in bases))
+        self.idx.classes[qual] = info
+        if not self._class_stack and not self._fn_stack:
+            self.mod.classes[node.name] = info
+        self._class_stack.append(info)
+        for child in node.body:
+            self.visit(child)
+        self._class_stack.pop()
+
+    # module-level bindings --------------------------------------------------
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        if (not self._class_stack and not self._fn_stack
+                and isinstance(node.value, ast.Call)
+                and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)):
+            target = _flatten(node.value.func)
+            if target:
+                name = node.targets[0].id
+                const_args = tuple(
+                    a.value for a in node.value.args
+                    if isinstance(a, ast.Constant))
+                self.mod.bindings[name] = BindingInfo(
+                    name=name, target=target, const_args=const_args,
+                    line=node.lineno)
+        self.generic_visit(node)
+
+
+class _GraphBuilder(ast.NodeVisitor):
+    """Second pass over one module: call edges with scope-aware resolution."""
+
+    def __init__(self, idx: ProjectIndex, mod: ModuleInfo):
+        self.idx = idx
+        self.mod = mod
+        self._fn_stack: list[FunctionInfo] = []
+        self._class_stack: list[ClassInfo] = []
+        # per-function local maps: var -> class qualname / function qualname
+        self._local_types: list[dict[str, str]] = []
+        self._local_fns: list[dict[str, str]] = []
+        self._executor_depth = 0
+        # Call nodes directly under an Await: name-based (CHA) resolution
+        # filters candidates by async-ness — `await x.aggregate_verify(...)`
+        # cannot land on a synchronous method of the same name
+        self._awaited: set[int] = set()
+
+    def run(self) -> None:
+        self.visit(self.mod.src.tree)
+
+    # scope bookkeeping ------------------------------------------------------
+
+    @property
+    def _caller(self) -> str:
+        return self._fn_stack[-1].qualname if self._fn_stack else self.mod.name
+
+    def _enter_fn(self, info: FunctionInfo) -> None:
+        self._fn_stack.append(info)
+        types: dict[str, str] = {}
+        for pname, ann in info.annotations.items():
+            resolved = self._resolve_dotted(ann)
+            if resolved and resolved in self.idx.classes:
+                types[pname] = resolved
+        self._local_types.append(types)
+        self._local_fns.append({})
+
+    def _exit_fn(self) -> None:
+        self._fn_stack.pop()
+        self._local_types.pop()
+        self._local_fns.pop()
+
+    def visit_FunctionDef(self, node):  # also nested defs
+        info = self.idx.functions.get(self._qual_of(node.name))
+        if info is None or info.node is not node:
+            info = self._find_info(node)
+        if self._fn_stack:
+            self._local_fns[-1][node.name] = info.qualname
+            self._edge(info.qualname, "ref", node.lineno, precise=True)
+        for dec in node.decorator_list:
+            self.visit(dec)
+        self._enter_fn(info)
+        for child in node.body:
+            self.visit(child)
+        self._exit_fn()
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_ClassDef(self, node: ast.ClassDef) -> None:
+        info = self.idx.classes.get(self._qual_of(node.name))
+        self._class_stack.append(info) if info else None
+        for child in node.body:
+            self.visit(child)
+        if info:
+            self._class_stack.pop()
+
+    def _qual_of(self, name: str) -> str:
+        if self._fn_stack:
+            return f"{self._fn_stack[-1].qualname}.{name}"
+        if self._class_stack:
+            return f"{self._class_stack[-1].qualname}.{name}"
+        return f"{self.mod.name}.{name}"
+
+    def _find_info(self, node) -> FunctionInfo:
+        for fn in self.idx.functions.values():
+            if fn.node is node:
+                return fn
+        # unreachable in practice; synthesize so traversal stays total
+        qual = self._qual_of(getattr(node, "name", "<lambda>"))
+        info = FunctionInfo(qualname=qual, name=getattr(node, "name", "<lambda>"),
+                            module=self.mod, node=node,
+                            is_async=isinstance(node, ast.AsyncFunctionDef))
+        self.idx.functions[qual] = info
+        return info
+
+    def visit_Lambda(self, node: ast.Lambda) -> None:
+        qual = f"{self._caller}.<lambda:{node.lineno}>"
+        info = self.idx.functions.get(qual)
+        if info is None:
+            info = FunctionInfo(qualname=qual, name="<lambda>", module=self.mod,
+                                node=node, is_async=False,
+                                params=[a.arg for a in node.args.args])
+            self.idx.functions[qual] = info
+        self._edge(qual, "executor" if self._executor_depth else "ref",
+                   node.lineno, precise=True)
+        self._fn_stack.append(info)
+        self._local_types.append({})
+        self._local_fns.append({})
+        self.visit(node.body)
+        self._exit_fn()
+
+    # assignments feed local type/function tracking --------------------------
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        if self._fn_stack and len(node.targets) == 1 and isinstance(
+                node.targets[0], ast.Name):
+            name = node.targets[0].id
+            if isinstance(node.value, ast.Call):
+                callee = _flatten(node.value.func)
+                resolved = self._resolve_dotted(callee) if callee else None
+                if resolved and resolved in self.idx.classes:
+                    self._local_types[-1][name] = resolved
+                # track futures minted by submit-shaped calls so `.result()`
+                # sinks can tell a pool future from an asyncio future
+                attr = callee.rpartition(".")[2] if callee else ""
+                if attr in _EXECUTOR_ATTRS or attr.endswith("_submit"):
+                    self._local_types[-1][name] = "<pool-future>"
+            elif isinstance(node.value, (ast.Name, ast.Attribute)):
+                src = _flatten(node.value)
+                resolved = self._resolve_dotted(src) if src else None
+                if resolved and resolved in self.idx.functions:
+                    self._local_fns[-1][name] = resolved
+        self.generic_visit(node)
+
+    # calls ------------------------------------------------------------------
+
+    def visit_Await(self, node: ast.Await) -> None:
+        if isinstance(node.value, ast.Call):
+            self._awaited.add(id(node.value))
+        self.generic_visit(node)
+
+    def visit_Call(self, node: ast.Call) -> None:
+        executor_args = self._is_executor_call(node)
+        self._resolve_call(node)
+        self.visit(node.func)
+        if executor_args:
+            self._executor_depth += 1
+        for arg in list(node.args) + [kw.value for kw in node.keywords]:
+            self._maybe_ref(arg)
+            self.visit(arg)
+        if executor_args:
+            self._executor_depth -= 1
+
+    def _is_executor_call(self, node: ast.Call) -> bool:
+        callee = _flatten(node.func)
+        if callee is None:
+            # chains _flatten can't linearise, e.g.
+            # asyncio.get_running_loop().run_in_executor(...)
+            if isinstance(node.func, ast.Attribute):
+                return (node.func.attr in _EXECUTOR_ATTRS
+                        or node.func.attr.endswith("_submit"))
+            return False
+        attr = callee.rpartition(".")[2]
+        if attr in _EXECUTOR_ATTRS:
+            return True
+        return matches_any(callee, _EXECUTOR_SUFFIXES) is not None
+
+    def _maybe_ref(self, arg: ast.AST) -> None:
+        """A bare function reference passed as an argument may be called by
+        the callee: record a ref (or executor) edge.  functools.partial is
+        unwrapped by _resolve_call visiting the inner Call."""
+        if isinstance(arg, (ast.Name, ast.Attribute)):
+            dotted = _flatten(arg)
+            resolved = self._resolve_value(dotted) if dotted else None
+            if resolved and resolved in self.idx.functions:
+                kind = "executor" if self._executor_depth else "ref"
+                self._edge(resolved, kind, arg.lineno, precise=True)
+
+    def _resolve_call(self, node: ast.Call) -> None:
+        func = node.func
+        line = node.lineno
+        kind = "executor" if self._executor_depth else "call"
+        dotted = _flatten(func)
+        if dotted is not None and matches_any(dotted, _EXECUTOR_SUFFIXES):
+            # the sanctioned front doors themselves (e.g. the tbls submit
+            # facade): work behind them runs on the pipeline's pool, so the
+            # edge into the facade body is an executor hop, not a call
+            kind = "executor"
+
+        if dotted is not None:
+            # functools.partial(f, ...) -> ref edge to f
+            if dotted_endswith(dotted, "functools.partial") or dotted == "partial":
+                if node.args:
+                    inner = _flatten(node.args[0])
+                    resolved = self._resolve_value(inner) if inner else None
+                    if resolved and resolved in self.idx.functions:
+                        self._edge(resolved, "ref" if kind == "call" else kind,
+                                   line, precise=True)
+                return
+            resolved = self._resolve_value(dotted)
+            if resolved is not None:
+                if resolved in self.idx.functions:
+                    self._edge(resolved, kind, line, precise=True)
+                    return
+                if resolved in self.idx.classes:
+                    ctor = self.idx.classes[resolved].methods.get("__init__")
+                    self._edge(ctor.qualname if ctor else resolved, kind,
+                               line, precise=True, internal=ctor is not None)
+                    return
+        if isinstance(func, ast.Attribute):
+            self._resolve_method_call(func, line, kind,
+                                      awaited=id(node) in self._awaited)
+            return
+        if dotted is not None:
+            ext = self._external_name(dotted)
+            self._edge(ext, kind, line, precise=True, internal=False)
+
+    def _resolve_method_call(self, func: ast.Attribute, line: int,
+                             kind: str, awaited: bool = False) -> None:
+        attr = func.attr
+        recv = _flatten(func.value)
+        # self.method() -> own class (claimed protocols widen below)
+        if recv == "self" and self._fn_stack and self._fn_stack[-1].class_name:
+            cls_info = self._own_class()
+            if cls_info is not None:
+                m = self._method_on(cls_info, attr)
+                if m is not None:
+                    self._edge(m.qualname, kind, line, precise=True)
+                    return
+        # receiver with a locally-known class
+        if recv is not None and self._local_types:
+            tname = self._local_types[-1].get(recv.split(".")[0])
+            if tname and tname != "<pool-future>":
+                cls_info = self.idx.classes.get(tname)
+                if cls_info is not None:
+                    m = self._method_on(cls_info, attr)
+                    if m is not None:
+                        self._edge(m.qualname, kind, line, precise=True)
+                        return
+        # executor APIs never resolve into an implementation (sanctioned seam)
+        if attr in _EXECUTOR_ATTRS:
+            self._edge(self._external_name(recv or "") + "." + attr
+                       if recv else attr, kind, line,
+                       precise=False, internal=False)
+            return
+        # protocol claims: any indexed protocol with this method resolves to
+        # every class claiming it via `# lint: implements=`
+        hit = False
+        for proto, impls in self.idx.implementers.items():
+            pcls = self._protocol_named(proto)
+            if pcls is None or attr not in pcls.methods:
+                continue
+            for impl in impls:
+                m = self._method_on(impl, attr)
+                if m is not None and m.is_async == awaited:
+                    self._edge(m.qualname, kind, line, precise=False)
+                    hit = True
+        # name-based CHA fallback (awaited calls only match async methods
+        # and vice versa — the event loop would reject the other pairing)
+        if not hit and attr not in _CHA_STOPLIST:
+            cands = [m for m in self.idx.methods_by_name.get(attr, [])
+                     if m.is_async == awaited]
+            if 0 < len(cands) <= 8:
+                for m in cands:
+                    self._edge(m.qualname, kind, line, precise=False)
+                    hit = True
+        if not hit:
+            base = self._external_name(recv) if recv else "<unknown>"
+            self._edge(f"{base}.{attr}", kind, line, precise=False,
+                       internal=False)
+
+    # helpers ----------------------------------------------------------------
+
+    def _own_class(self) -> ClassInfo | None:
+        cname = self._fn_stack[-1].class_name
+        qual = self._fn_stack[-1].qualname.rsplit(".", 2)[0] + "." + cname
+        return self.idx.classes.get(qual) or self.mod.classes.get(cname)
+
+    def _method_on(self, cls_info: ClassInfo, attr: str) -> FunctionInfo | None:
+        if attr in cls_info.methods:
+            return cls_info.methods[attr]
+        for base in cls_info.bases:
+            resolved = self._resolve_dotted(base)
+            parent = self.idx.classes.get(resolved) if resolved else None
+            if parent is not None and parent is not cls_info:
+                m = self._method_on(parent, attr)
+                if m is not None:
+                    return m
+        return None
+
+    def _protocol_named(self, name: str) -> ClassInfo | None:
+        for cls_info in self.idx.classes.values():
+            if cls_info.name == name and cls_info.is_protocol:
+                return cls_info
+        return None
+
+    def _resolve_value(self, dotted: str) -> str | None:
+        """Resolve a dotted expression in the current local+module scope."""
+        head = dotted.split(".")[0]
+        rest = dotted.split(".")[1:]
+        if self._local_fns:
+            local = self._local_fns[-1].get(head)
+            if local is not None and not rest:
+                return local
+        if self._local_types:
+            t = self._local_types[-1].get(head)
+            if t and t != "<pool-future>" and rest:
+                cls_info = self.idx.classes.get(t)
+                if cls_info and rest[-1] in cls_info.methods:
+                    return cls_info.methods[rest[-1]].qualname
+        return self._resolve_dotted(dotted)
+
+    def _resolve_dotted(self, dotted: str | None) -> str | None:
+        if not dotted:
+            return None
+        return self.idx.resolve(f"{self.mod.name}.{dotted}") \
+            or self.idx.resolve(dotted)
+
+    def _external_name(self, dotted: str) -> str:
+        """Best-effort canonical dotted name for an external callee (expand
+        the leading import alias so `np.foo` reports as `numpy.foo`)."""
+        head, _, rest = dotted.partition(".")
+        target = self.mod.imports.get(head)
+        if target:
+            return f"{target}.{rest}" if rest else target
+        if head in _BUILTIN_NAMES and not rest:
+            return f"builtins.{head}"
+        return dotted
+
+    def _edge(self, callee: str, kind: str, line: int, *,
+              precise: bool, internal: bool | None = None) -> None:
+        if internal is None:
+            internal = callee in self.idx.functions
+        self.idx.edges.setdefault(self._caller, []).append(CallEdge(
+            caller=self._caller, callee=callee, kind=kind, line=line,
+            internal=internal, precise=precise))
+
+
+def _flatten(node: ast.AST | None) -> str | None:
+    """`a.b.c` -> "a.b.c" for Name/Attribute chains; None otherwise."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return None
+    return None

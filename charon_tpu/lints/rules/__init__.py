@@ -3,10 +3,12 @@
 from __future__ import annotations
 
 from .aio import UntrackedTaskRule
+from .asy import EventLoopBlockRule
 from .exc import BroadExceptRule, GuardSeamRule
 from .flt import FaultSiteRule
 from .iface import ProtocolImplRule
-from .obs import DutySpanRule
+from .obs import DutySpanRule, MetricDriftRule
+from .sec import SecretTaintRule
 from .tpu import (DeviceDtypeRule, MeshTopologyRule,
                   NativePairingRoutingRule, PipelineLockSyncRule,
                   PlaneStoreRoutingRule)
@@ -25,6 +27,9 @@ __all__ = [
     "ProtocolImplRule",
     "DutySpanRule",
     "StrictBodyRule",
+    "SecretTaintRule",
+    "EventLoopBlockRule",
+    "MetricDriftRule",
     "default_rules",
 ]
 
@@ -43,4 +48,7 @@ def default_rules() -> list:
         ProtocolImplRule(),
         DutySpanRule(),
         StrictBodyRule(),
+        SecretTaintRule(),
+        EventLoopBlockRule(),
+        MetricDriftRule(),
     ]

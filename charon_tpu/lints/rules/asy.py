@@ -1,0 +1,157 @@
+"""LINT-ASY-014 — no blocking calls reachable from the event-loop duty path.
+
+The interprocedural upgrade of LINT-TPU-007: any ``async def`` defined in
+``core/`` or ``p2p/`` (the duty/vapi/gossip path — everything that runs on
+the app's single event loop) is a *root*; the call graph is walked from
+every root over synchronous edges, and any reached function whose body
+contains a blocking sink is flagged:
+
+  * ``time.sleep`` (use ``asyncio.sleep``),
+  * ``jax.block_until_ready`` / ``.block_until_ready()`` (device fences
+    belong on the pipeline's finish pool),
+  * ``ct_*`` ctypes natives (the ~ms pairing/BLS rungs),
+  * ``concurrent.futures.Future.result()`` — only on futures minted by a
+    ``.submit(...)``-shaped call in the same function; asyncio futures
+    ``.result()``-read after ``await`` (qbft, consensus) are non-blocking,
+  * unbuffered/raw file IO (``os.fsync``, ``os.open/read/write``,
+    ``open(..., buffering=0)``).
+
+Executor hops sever the walk (``kind="executor"`` edges): work handed to
+``loop.run_in_executor``, a pool's ``.submit``, ``asyncio.to_thread``,
+``utils.aio.spawn``, or ``tbls.threshold_aggregate_verify_submit`` (the
+SigAggPipeline's finish-pool front door) runs off the loop and is
+sanctioned by design.
+
+Suppress a deliberate blocking call (e.g. chaos injection) with
+`# lint: disable=LINT-ASY-014` on the sink line plus a justification.
+"""
+
+from __future__ import annotations
+
+import ast
+from pathlib import Path
+from typing import Iterable, Iterator
+
+from ..engine import Finding
+from ..project import FunctionInfo, ProjectIndex, _flatten, dotted_endswith
+
+_RAW_IO = {"os.fsync", "os.open", "os.read", "os.write"}
+
+
+def _short(qual: str) -> str:
+    return ".".join(qual.rsplit(".", 2)[-2:])
+
+
+def blocking_sinks(fn: FunctionInfo) -> Iterator[tuple[int, str]]:
+    """(line, description) for every blocking call in `fn`'s own body
+    (nested defs are separate graph nodes and scanned on their own)."""
+    body = getattr(fn.node, "body", None)
+    if not isinstance(body, list):
+        body = [fn.node.body]  # lambda: body is a bare expression
+    pool_futures: set[str] = set()
+    for node in _walk_own(body):
+        if not isinstance(node, ast.Call):
+            continue
+        dotted = _flatten(node.func) or ""
+        expanded = _expand(fn, dotted)
+        attr = dotted.rpartition(".")[2]
+        if dotted_endswith(expanded, "time.sleep"):
+            yield node.lineno, "time.sleep() (use asyncio.sleep)"
+        elif attr == "block_until_ready":
+            yield node.lineno, "jax.block_until_ready() device fence"
+        elif attr.startswith("ct_"):
+            yield node.lineno, f"ctypes native {attr}()"
+        elif expanded in _RAW_IO:
+            yield node.lineno, f"raw file IO {expanded}()"
+        elif expanded == "builtins.open" or dotted == "open":
+            for kw in node.keywords:
+                if (kw.arg == "buffering"
+                        and isinstance(kw.value, ast.Constant)
+                        and kw.value.value == 0):
+                    yield node.lineno, "unbuffered open(buffering=0)"
+        elif attr == "result":
+            recv = _flatten(getattr(node.func, "value", None))
+            inner = getattr(node.func, "value", None)
+            chained = (isinstance(inner, ast.Call)
+                       and _is_submit(_flatten(inner.func) or ""))
+            if (recv in pool_futures) or chained:
+                yield node.lineno, "concurrent Future.result() (await " \
+                                   "asyncio.wrap_future instead)"
+        # track pool futures minted in this body
+        if isinstance(node, ast.Call) and _is_submit(dotted):
+            parent_assign = None  # handled below via statement scan
+    # second pass: assignments of submit-shaped calls -> .result() receivers
+    for node in _walk_own(body):
+        if (isinstance(node, ast.Assign) and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+                and isinstance(node.value, ast.Call)
+                and _is_submit(_flatten(node.value.func) or "")):
+            pool_futures.add(node.targets[0].id)
+    if pool_futures:
+        for node in _walk_own(body):
+            if (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "result"
+                    and _flatten(node.func.value) in pool_futures):
+                yield node.lineno, "concurrent Future.result() (await " \
+                                   "asyncio.wrap_future instead)"
+
+
+def _is_submit(dotted: str) -> bool:
+    attr = dotted.rpartition(".")[2]
+    return attr == "submit" or attr.endswith("_submit")
+
+
+def _expand(fn: FunctionInfo, dotted: str) -> str:
+    head, _, rest = dotted.partition(".")
+    target = fn.module.imports.get(head)
+    if target:
+        return f"{target}.{rest}" if rest else target
+    return dotted
+
+
+def _walk_own(body: list) -> Iterator[ast.AST]:
+    """ast.walk over statements, not descending into nested defs/lambdas."""
+    stack = list(body)
+    while stack:
+        node = stack.pop()
+        yield node
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.Lambda)):
+                continue
+            stack.append(child)
+
+
+class EventLoopBlockRule:
+    id = "LINT-ASY-014"
+    description = ("async defs on the core/p2p duty path must not "
+                   "transitively reach blocking calls without an executor "
+                   "hop")
+    project_scope = "tree"  # reachability crosses importer boundaries
+
+    def check_project(self, index: ProjectIndex,
+                      root: Path) -> Iterable[Finding]:
+        roots = sorted(
+            fn.qualname for fn in index.functions.values()
+            if fn.is_async and fn.module.src.in_dir("core", "p2p"))
+        paths = index.reachable(roots, kinds=("call", "ref"))
+        seen: set[tuple[str, int, str]] = set()
+        for qual in sorted(paths):
+            fn = index.functions.get(qual)
+            if fn is None:
+                continue
+            chain = paths[qual]
+            for line, desc in blocking_sinks(fn):
+                key = (fn.module.src.rel, line, desc)
+                if key in seen:
+                    continue
+                seen.add(key)
+                via = " -> ".join(_short(q) for q in chain)
+                yield Finding(
+                    fn.module.src.rel, line, self.id,
+                    f"blocking call on the event loop: {desc} in "
+                    f"{_short(qual)}, reachable from async "
+                    f"{_short(chain[0])} (path: {via}) — hop through an "
+                    "executor (run_in_executor / pipeline submit / "
+                    "asyncio.to_thread) or make the path synchronous")

@@ -1,4 +1,6 @@
-"""LINT-OBS-006 — core duty handlers must emit a flight-recorder span.
+"""LINT-OBS-006 / LINT-OBS-015 — observability consistency rules.
+
+LINT-OBS-006 — core duty handlers must emit a flight-recorder span.
 
 The duty flight recorder (docs/observability.md) assembles per-duty latency
 timelines from tracer spans, and `tracker.duty_timeline` / the
@@ -86,3 +88,126 @@ class DutySpanRule:
                         "(...) (or record tracer.event(...)), or claim the "
                         "wire()d protocol it implements with `# lint: "
                         "implements=`")
+
+
+# ---------------------------------------------------------------------------
+# LINT-OBS-015 — metric drift between health rules, the registry, and docs.
+#
+# Three observable surfaces name metrics by string: the registration sites
+# (`metrics.counter/gauge/histogram("name", ...)` against the default
+# registry), the health rules (`app/health.py` readers like
+# `counter_delta("name")`), and the operator docs
+# (`docs/observability.md` backticked names). A whole-program pass keeps
+# them consistent:
+#
+#   1. every metric a health rule reads must be registered somewhere,
+#   2. every metric the docs document must be registered somewhere,
+#   3. every metric a health rule reads must be documented (operators
+#      debugging a failing check need the doc entry).
+#
+# Doc tokens are recognised as metric names only when they carry both a
+# known subsystem prefix (ops_/core_/vapi_/...) and a unit-style suffix
+# (_total/_seconds/...), so health-rule *names* (`vapi_latency_high`) and
+# prose code spans don't false-positive.
+# ---------------------------------------------------------------------------
+
+import re
+
+from ..project import ProjectIndex, _flatten
+
+_READERS = ("histogram_quantile", "counter_delta", "gauge_sum",
+            "gauge_delta", "gauge_values")
+_REG_KINDS = ("counter", "gauge", "histogram")
+_DOC_PREFIXES = ("ops_", "core_", "vapi_", "dkg_", "p2p_", "app_",
+                 "tracer_", "log_", "eth2_")
+_DOC_SUFFIXES = ("_total", "_seconds", "_state", "_backlog", "_width",
+                 "_devices", "_requests", "_success", "_syncing", "_bytes",
+                 "_count")
+_BACKTICK = re.compile(r"`([^`\n]+)`")
+
+
+def _const_first_arg(node: ast.Call) -> str | None:
+    if node.args and isinstance(node.args[0], ast.Constant) \
+            and isinstance(node.args[0].value, str):
+        return node.args[0].value
+    return None
+
+
+def _doc_metric_names(text: str) -> dict[str, int]:
+    """metric name -> first line it appears on (1-based)."""
+    names: dict[str, int] = {}
+    for lineno, line in enumerate(text.splitlines(), start=1):
+        for match in _BACKTICK.finditer(line):
+            token = match.group(1).strip()
+            token = re.sub(r"\{[^}]*\}", "", token)  # strip label templates
+            if not re.fullmatch(r"[a-z][a-z0-9_]+", token):
+                continue
+            if token.startswith(_DOC_PREFIXES) and \
+                    token.endswith(_DOC_SUFFIXES):
+                names.setdefault(token, lineno)
+    return names
+
+
+class MetricDriftRule:
+    id = "LINT-OBS-015"
+    description = ("metric names must agree across health rules, the "
+                   "default registry, and docs/observability.md")
+    project_scope = "tree"  # global consistency across the whole tree
+    doc_rel = "docs/observability.md"
+
+    def check_project(self, index: ProjectIndex,
+                      root: Path) -> Iterable[Finding]:
+        registered: set[str] = set()
+        health_reads: list[tuple[str, int, str]] = []  # (name, line, rel)
+        for mod in index.modules.values():
+            in_health = mod.name.endswith("app.health")
+            for node in ast.walk(mod.src.tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                dotted = _flatten(node.func) or ""
+                attr = dotted.rpartition(".")[2]
+                name = _const_first_arg(node)
+                if name is None:
+                    continue
+                if attr in _REG_KINDS and self._is_registry_call(mod, dotted):
+                    registered.add(name)
+                if in_health and attr in _READERS:
+                    health_reads.append((name, node.lineno, mod.src.rel))
+
+        doc_path = root / self.doc_rel
+        doc_names: dict[str, int] = {}
+        if doc_path.exists():
+            doc_names = _doc_metric_names(
+                doc_path.read_text(encoding="utf-8"))
+
+        for name, line, rel in sorted(health_reads):
+            if name not in registered:
+                yield Finding(
+                    rel, line, self.id,
+                    f"health rule reads metric `{name}` but nothing "
+                    "registers it against utils/metrics.py's default "
+                    "registry — the check can never fire; register the "
+                    "metric or fix the name")
+            elif doc_names and name not in doc_names:
+                yield Finding(
+                    rel, line, self.id,
+                    f"health rule reads metric `{name}` but "
+                    f"{self.doc_rel} never documents it — operators "
+                    "debugging a failing check need the doc entry; add it "
+                    "to the metrics reference")
+        for name in sorted(doc_names):
+            if name not in registered:
+                yield Finding(
+                    self.doc_rel, doc_names[name], self.id,
+                    f"{self.doc_rel} documents metric `{name}` but "
+                    "nothing registers it against the default registry — "
+                    "stale doc entry or missing registration")
+
+    @staticmethod
+    def _is_registry_call(mod, dotted: str) -> bool:
+        head, _, _rest = dotted.partition(".")
+        expanded = mod.imports.get(head, head)
+        if "metrics" in expanded.split("."):
+            return True
+        receiver = dotted.rpartition(".")[0]
+        return "registry" in receiver.lower() or "metrics" in dotted.split(".")

@@ -1,0 +1,93 @@
+"""LINT-SEC-013 — secret key material must never reach an observable sink.
+
+Whole-program taint analysis (lints/dataflow.py over lints/project.py):
+values originating from the threshold-crypto key lifecycle —
+``tbls.generate_secret_key`` / ``threshold_split`` / ``recover_secret``,
+FROST round-1 polynomial coefficients (``._coeffs``) and share scalars
+(``Participant._eval`` / ``._rand_scalar``), ``eth2/keystore.py`` decrypt
+output and scrypt-derived AES keys, and node identity keys
+(``k1util.generate_private_key`` / ``.identity_key``) — are traced through
+assignments, containers, and function calls (interprocedurally, via
+per-function summaries) and flagged when they reach:
+
+  * log arguments (``_log.info(..., key=secret)``),
+  * exception messages / ``errors.new`` fields,
+  * metric label values,
+  * ``repr()`` / f-string / ``str.format`` / ``%`` formatting,
+  * file writes outside the sanctioned secret-write modules
+    (``dkg/checkpoint.py``, ``utils/secretio.py`` — 0600-before-content).
+
+Sanctioned sanitizers cut the trace: public derivations
+(``secret_to_public_key``, ``k1util.public_key``, ``sign`` — signatures
+are public outputs), encryption (``keystore.encrypt``, ``aes128ctr``),
+hashing (``sha256``), curve commitments (``g_mul``), the
+``Round1Broadcast`` constructor (commitments + PoK are broadcast by
+protocol design), and size/type probes (``len``/``type``/``bool``).
+
+Suppress a deliberate flow with `# lint: disable=LINT-SEC-013` on the sink
+line and a comment stating why the value is safe to expose.
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+from typing import Iterable
+
+from ..dataflow import TaintAnalysis, TaintConfig
+from ..engine import Finding
+from ..project import ProjectIndex
+
+DEFAULT_TAINT = TaintConfig(
+    call_sources=(
+        "generate_secret_key",      # tbls root-key generation (any backend)
+        "threshold_split",          # share scalars
+        "recover_secret",           # reconstructed root key
+        "keystore.decrypt",         # EIP-2335 decrypt output
+        "hashlib.scrypt",           # KDF-derived AES keys
+        "generate_private_key",     # k1util node identity keys
+        "_rand_scalar",             # FROST nonces / coefficients
+        "Participant._eval",        # FROST share evaluation
+        "_eval",
+    ),
+    attr_sources=(
+        "_coeffs",                  # FROST round-1 polynomial coefficients
+        "identity_key",             # node identity (charon-enr-private-key)
+        "share_secret",             # DKG result share scalars
+    ),
+    sanitizers=(
+        "secret_to_public_key", "public_key", "pubkey_to_bytes",
+        "sign",                     # signatures are public outputs
+        "encrypt", "aes128ctr", "_aes128ctr",
+        "sha256", "hmac_sha256",
+        "g_mul", "g1_mul", "g2_mul",
+        # share/PoK verification consumes secrets and emits public verdicts;
+        # its error surfaces describe public commitments, not the scalars
+        "verify_share", "verify_shares_batch", "verify_round1",
+        "Round1Broadcast",          # fields are public commitments / PoK
+        "lock_hash",                # the cluster lock's public commitment
+        "len", "type", "bool", "id", "isinstance",
+    ),
+    write_exempt_modules=("dkg.checkpoint", "utils.secretio"),
+)
+
+
+class SecretTaintRule:
+    id = "LINT-SEC-013"
+    description = ("secret key material must not reach logs, exceptions, "
+                   "metric labels, formatting, or unsanctioned file writes")
+    project_scope = "file"  # findings depend only on the file's import closure
+
+    def __init__(self, config: TaintConfig | None = None):
+        self._config = config or DEFAULT_TAINT
+
+    def check_project(self, index: ProjectIndex,
+                      root: Path) -> Iterable[Finding]:
+        analysis = TaintAnalysis(index, self._config)
+        for tf in analysis.run():
+            origins = ", ".join(tf.origins)
+            yield Finding(
+                tf.path, tf.line, self.id,
+                f"secret-tainted value (from {origins}) reaches "
+                f"{tf.kind} sink: {tf.detail} — secrets must stay out of "
+                "observable surfaces; derive a public value or use the "
+                "sanctioned secret-write path (utils/secretio.py)")

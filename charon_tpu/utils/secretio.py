@@ -1,0 +1,44 @@
+"""secretio — the sanctioned path for writing secret material to disk.
+
+Key files (node identity keys, DKG share scalars) must never be readable
+by other users, *even transiently*: the common ``path.write_text(secret)``
+then ``path.chmod(0o600)`` sequence creates the file with the process
+umask (typically 0644) and leaves a window where the secret is
+world-readable.  These helpers open the file 0600-from-birth
+(``os.open(..., mode=0o600)`` on a same-directory temp name) and publish
+it atomically with ``os.replace``, so a crash mid-write never leaves a
+partial or permissive key file.
+
+LINT-SEC-013 treats this module (and dkg/checkpoint.py) as the only
+legitimate file-write sinks for secret-tainted values — route new key
+persistence through here rather than suppressing the lint.
+"""
+
+from __future__ import annotations
+
+import os
+from pathlib import Path
+
+
+def write_secret_bytes(path: Path | str, data: bytes) -> None:
+    """Atomically write `data` to `path` with 0600 permissions from birth."""
+    path = Path(path)
+    tmp = path.with_name(f".{path.name}.tmp")
+    fd = os.open(tmp, os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o600)
+    try:
+        with os.fdopen(fd, "wb") as fh:
+            fh.write(data)
+            fh.flush()
+            os.fsync(fh.fileno())
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+
+
+def write_secret_text(path: Path | str, text: str) -> None:
+    """Atomically write `text` to `path` with 0600 permissions from birth."""
+    write_secret_bytes(path, text.encode())

@@ -147,8 +147,9 @@ def test_fork_aware_domains():
 
 def test_sigagg_uses_fused_aggregate_verify(monkeypatch):
     """When every item is eth2-verifiable, SigAgg routes through the FUSED
-    tbls.threshold_aggregate_verify_batch (the TPU backend's one-pass
-    sigagg hot path) instead of separate aggregate + verify calls."""
+    tbls.threshold_aggregate_verify_submit front door (the TPU backend's
+    one-pass sigagg hot path, resolved off the event loop on the pipeline's
+    finish pool) instead of separate aggregate + verify calls."""
 
     async def run():
         chain = spec.ChainSpec(genesis_time=0)
@@ -162,7 +163,7 @@ def test_sigagg_uses_fused_aggregate_verify(monkeypatch):
                 for i in range(2)]
 
         calls = {"fused": 0, "split": 0}
-        real = tbls.threshold_aggregate_verify_batch
+        real = tbls.threshold_aggregate_verify_submit
 
         def spy_fused(batches, pks, datas):
             calls["fused"] += 1
@@ -172,7 +173,7 @@ def test_sigagg_uses_fused_aggregate_verify(monkeypatch):
             calls["split"] += 1
             raise AssertionError("split aggregate path should not run")
 
-        monkeypatch.setattr(tbls, "threshold_aggregate_verify_batch",
+        monkeypatch.setattr(tbls, "threshold_aggregate_verify_submit",
                             spy_fused)
         monkeypatch.setattr(tbls, "threshold_aggregate_batch", spy_split)
         agg = sigagg.SigAgg(keys, chain)

@@ -731,14 +731,26 @@ def test_cli_baseline_update_roundtrip(tmp_path, capsys):
 
 
 def test_self_check_whole_tree_against_baseline():
-    """Lint all of charon_tpu/ against the checked-in baseline. This test
-    FAILS if any new finding — e.g. a fresh LINT-AIO-001 untracked task —
-    is introduced anywhere under the package."""
-    findings = Engine().lint_paths([PKG_DIR], root=REPO_ROOT)
-    baseline = load_baseline(DEFAULT_BASELINE)
-    new = new_findings(findings, baseline)
-    assert new == [], "new lint findings:\n" + "\n".join(
-        f.render() for f in new)
+    """Lint all of charon_tpu/ against the checked-in baseline THROUGH the
+    CI entry point: `python -m charon_tpu.lints --format=json` as a real
+    subprocess. This test FAILS if any new finding — e.g. a fresh
+    LINT-SEC-013 secret leak — is introduced anywhere under the package,
+    and pins the JSON report schema CI consumes."""
+    import subprocess
+    import sys as _sys
+
+    proc = subprocess.run(
+        [_sys.executable, "-m", "charon_tpu.lints", "--format=json"],
+        cwd=REPO_ROOT, capture_output=True, text=True, timeout=300)
+    report = json.loads(proc.stdout)
+    assert report["version"] == 2
+    assert report["rules_version"] == 9
+    new = [f for f in report["findings"] if f["new"]]
+    assert proc.returncode == 0 and new == [], \
+        "new lint findings:\n" + "\n".join(
+            f"{f['path']}:{f['line']}: {f['rule']}: {f['message']}"
+            for f in new)
+    assert report["new"] == 0
 
 
 def test_self_check_catches_injected_violation(tmp_path):

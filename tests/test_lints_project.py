@@ -1,0 +1,637 @@
+"""Tests for the whole-program lint engine (RULES_VERSION 9): project
+index / call-graph edge cases, the three interprocedural rules
+(LINT-SEC-013, LINT-ASY-014, LINT-OBS-015) with positive + negative
+fixtures, dependency-fingerprinted caching, the JSON / --changed CLI, and
+regression tests for the real bugs the tree-wide burn-down fixed."""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+import textwrap
+import threading
+from pathlib import Path
+
+from charon_tpu.lints import Engine, ProjectIndex, RULES_VERSION, SourceFile
+from charon_tpu.lints.__main__ import main as lint_main
+
+
+def write_tree(tmp_path: Path, files: dict[str, str]) -> None:
+    for rel, source in files.items():
+        path = tmp_path / rel
+        path.parent.mkdir(parents=True, exist_ok=True)
+        path.write_text(textwrap.dedent(source))
+
+
+def build_index(tmp_path: Path, files: dict[str, str]) -> ProjectIndex:
+    write_tree(tmp_path, files)
+    srcs = [SourceFile(tmp_path / rel, rel, (tmp_path / rel).read_text())
+            for rel in sorted(files) if rel.endswith(".py")]
+    return ProjectIndex.build(srcs)
+
+
+def lint_tree(tmp_path: Path, files: dict[str, str],
+              cache: Path | None = None) -> tuple[Engine, list]:
+    write_tree(tmp_path, files)
+    eng = Engine(cache_path=cache)
+    return eng, eng.lint_paths([tmp_path], root=tmp_path)
+
+
+def findings_for(findings, rule: str) -> list:
+    return [f for f in findings if f.rule == rule]
+
+
+def edges_from(idx: ProjectIndex, qual: str) -> list[tuple[str, str]]:
+    return [(e.callee, e.kind) for e in idx.out_edges(qual)]
+
+
+# ---------------------------------------------------------------------------
+# project index / call graph edge cases
+# ---------------------------------------------------------------------------
+
+
+def test_index_decorated_def_resolves_like_plain(tmp_path):
+    idx = build_index(tmp_path, {"m.py": """\
+        def deco(f):
+            return f
+
+        @deco
+        def target():
+            pass
+
+        def caller():
+            target()
+    """})
+    assert idx.functions["m.target"].decorators == ["deco"]
+    assert ("m.target", "call") in edges_from(idx, "m.caller")
+
+
+def test_index_awaited_calls_only_match_async_methods(tmp_path):
+    """CHA by method name respects await: an awaited call can only land on
+    an async def and a bare call only on a sync def — the event loop would
+    reject the other pairing (this killed a phantom edge from SigAgg's
+    awaited coalescer call to the sync pipeline method of the same name)."""
+    idx = build_index(tmp_path, {"m.py": """\
+        class SyncImpl:
+            def run_once(self):
+                pass
+
+        class AsyncImpl:
+            async def run_once(self):
+                pass
+
+        async def awaited_site(x):
+            await x.run_once()
+
+        def plain_site(x):
+            x.run_once()
+    """})
+    assert edges_from(idx, "m.awaited_site") == [
+        ("m.AsyncImpl.run_once", "call")]
+    assert edges_from(idx, "m.plain_site") == [
+        ("m.SyncImpl.run_once", "call")]
+
+
+def test_index_functools_partial_creates_ref_edge(tmp_path):
+    idx = build_index(tmp_path, {"m.py": """\
+        import functools
+
+        def work(n):
+            return n
+
+        def sched():
+            return functools.partial(work, 2)
+    """})
+    assert ("m.work", "ref") in edges_from(idx, "m.sched")
+
+
+def test_index_lambda_bodies_feed_the_enclosing_scope(tmp_path):
+    """Calls inside a lambda create edges from the enclosing function, and
+    a tree containing module-level lambdas lints end-to-end (the taint
+    walker once crashed iterating a Lambda's expression body)."""
+    files = {"m.py": """\
+        def helper():
+            return 1
+
+        def outer():
+            f = lambda: helper()
+            return f
+
+        pick = lambda xs: sorted(xs)[0]
+    """}
+    idx = build_index(tmp_path, files)
+    # the lambda is its own graph node, ref'd from the enclosing function
+    assert ("m.outer.<lambda:5>", "ref") in edges_from(idx, "m.outer")
+    assert ("m.helper", "call") in edges_from(idx, "m.outer.<lambda:5>")
+    _, findings = lint_tree(tmp_path, files)
+    assert findings == []
+
+
+def test_index_star_import_resolves(tmp_path):
+    idx = build_index(tmp_path, {
+        "pkg/__init__.py": "",
+        "pkg/h.py": "def star_helper():\n    pass\n",
+        "use.py": """\
+            from pkg.h import *
+
+            def go():
+                star_helper()
+        """,
+    })
+    assert ("pkg.h.star_helper", "call") in edges_from(idx, "use.go")
+
+
+def test_index_init_reexport_resolves(tmp_path):
+    idx = build_index(tmp_path, {
+        "pkg/__init__.py": "from .impl import thing\n",
+        "pkg/impl.py": "def thing():\n    pass\n",
+        "use.py": """\
+            from pkg import thing
+
+            def go():
+                thing()
+        """,
+    })
+    assert idx.resolve("pkg.thing") == "pkg.impl.thing"
+    assert ("pkg.impl.thing", "call") in edges_from(idx, "use.go")
+
+
+def test_index_implements_claim_resolves_protocol_call(tmp_path):
+    idx = build_index(tmp_path, {
+        "core/interfaces.py": """\
+            from typing import Protocol
+
+            class Worker(Protocol):
+                def work_once(self):
+                    ...
+        """,
+        "core/impl.py": """\
+            class RealWorker:  # lint: implements=Worker
+                def work_once(self):
+                    return 1
+        """,
+        "core/drv.py": """\
+            from .interfaces import Worker
+
+            def drive(w: Worker):
+                w.work_once()
+        """,
+        "core/cha.py": """\
+            def drive_untyped(x):
+                x.work_once()
+        """,
+    })
+    # the implements= claim registers the class against the protocol name
+    claimed = [c.qualname for c in idx.implementers["Worker"]]
+    assert claimed == ["core.impl.RealWorker"]
+    # an annotated receiver resolves precisely to the protocol method...
+    assert ("core.interfaces.Worker.work_once", "call") in edges_from(
+        idx, "core.drv.drive")
+    # ...and an untyped receiver CHA-resolves to the claiming implementer
+    assert ("core.impl.RealWorker.work_once", "call") in edges_from(
+        idx, "core.cha.drive_untyped")
+
+
+def test_index_reachability_is_cycle_safe(tmp_path):
+    idx = build_index(tmp_path, {"x.py": """\
+        def ping():
+            pong()
+
+        def pong():
+            ping()
+    """})
+    paths = idx.reachable(["x.ping"])
+    assert set(paths) == {"x.ping", "x.pong"}
+    assert paths["x.pong"] == ("x.ping", "x.pong")
+
+
+# ---------------------------------------------------------------------------
+# LINT-SEC-013 — secret taint (interprocedural)
+# ---------------------------------------------------------------------------
+
+_SEC_SOURCE_MOD = """\
+    def make_key():
+        return generate_secret_key()
+"""
+
+
+def test_sec_rule_flags_cross_module_secret_logging(tmp_path):
+    """Genuinely interprocedural: the secret originates in core/secrets.py
+    and leaks into a log sink in core/use.py — the per-function summary of
+    make_key carries the taint across the module boundary."""
+    _, findings = lint_tree(tmp_path, {
+        "core/secrets.py": _SEC_SOURCE_MOD,
+        "core/use.py": """\
+            from .secrets import make_key
+
+            def report():
+                k = make_key()
+                _log.info("created", key=k)
+        """,
+    })
+    sec = findings_for(findings, "LINT-SEC-013")
+    assert len(sec) == 1
+    assert sec[0].path == "core/use.py"
+    assert "generate_secret_key" in sec[0].message
+
+
+def test_sec_rule_sanitizer_cuts_cross_module_taint(tmp_path):
+    _, findings = lint_tree(tmp_path, {
+        "core/secrets.py": _SEC_SOURCE_MOD,
+        "core/use.py": """\
+            from .secrets import make_key
+
+            def report():
+                k = make_key()
+                pub = secret_to_public_key(k)
+                _log.info("created", key=pub)
+        """,
+    })
+    assert findings_for(findings, "LINT-SEC-013") == []
+
+
+def test_sec_rule_flags_unsanctioned_write_and_honours_suppression(tmp_path):
+    files = {
+        "core/keys.py": """\
+            def persist(path):
+                k = generate_secret_key()
+                path.write_text(k.hex())
+        """,
+    }
+    _, findings = lint_tree(tmp_path, files)
+    sec = findings_for(findings, "LINT-SEC-013")
+    assert [f.line for f in sec] == [3]
+    files["core/keys.py"] = files["core/keys.py"].replace(
+        "path.write_text(k.hex())",
+        "path.write_text(k.hex())  # lint: disable=LINT-SEC-013")
+    _, findings = lint_tree(tmp_path, files)
+    assert findings_for(findings, "LINT-SEC-013") == []
+
+
+def test_sec_rule_exempts_sanctioned_write_modules(tmp_path):
+    _, findings = lint_tree(tmp_path, {
+        "utils/secretio.py": """\
+            def write(path):
+                k = generate_secret_key()
+                path.write_text(k.hex())
+        """,
+    })
+    assert findings_for(findings, "LINT-SEC-013") == []
+
+
+# ---------------------------------------------------------------------------
+# LINT-ASY-014 — event-loop blocking (interprocedural)
+# ---------------------------------------------------------------------------
+
+
+def test_asy_rule_flags_blocking_call_reached_across_modules(tmp_path):
+    """Interprocedural: the async root lives in core/, the time.sleep two
+    call-graph hops away in ops/ — only the whole-program walk sees it."""
+    _, findings = lint_tree(tmp_path, {
+        "core/svc.py": """\
+            from ops.util import helper
+
+            async def handle():
+                helper()
+        """,
+        "ops/util.py": """\
+            import time
+
+            def helper():
+                inner()
+
+            def inner():
+                time.sleep(1)
+        """,
+    })
+    asy = findings_for(findings, "LINT-ASY-014")
+    assert len(asy) == 1
+    assert asy[0].path == "ops/util.py"
+    assert "time.sleep" in asy[0].message
+    assert "handle" in asy[0].message  # names the async root
+
+
+def test_asy_rule_executor_hop_severs_the_path(tmp_path):
+    _, findings = lint_tree(tmp_path, {
+        "core/svc.py": """\
+            import asyncio
+
+            from ops.util import helper
+
+            async def handle():
+                loop = asyncio.get_running_loop()
+                await loop.run_in_executor(None, helper)
+        """,
+        "ops/util.py": """\
+            import time
+
+            def helper():
+                time.sleep(1)
+        """,
+    })
+    assert findings_for(findings, "LINT-ASY-014") == []
+
+
+def test_asy_rule_ignores_async_defs_outside_duty_path(tmp_path):
+    _, findings = lint_tree(tmp_path, {
+        "cmd/tool.py": """\
+            import time
+
+            async def handle():
+                time.sleep(1)
+        """,
+    })
+    assert findings_for(findings, "LINT-ASY-014") == []
+
+
+# ---------------------------------------------------------------------------
+# LINT-OBS-015 — metric drift
+# ---------------------------------------------------------------------------
+
+_OBS_HEALTH = """\
+    def check(w):
+        return w.counter_delta("ops_widget_total") > 0
+"""
+_OBS_REGISTER = """\
+    from utils import metrics
+
+    _c = metrics.counter("ops_widget_total", "widgets")
+"""
+_OBS_DOC = "Metrics: `ops_widget_total` counts widgets.\n"
+
+
+def test_obs_rule_clean_when_read_registered_and_documented(tmp_path):
+    _, findings = lint_tree(tmp_path, {
+        "app/health.py": _OBS_HEALTH,
+        "ops/w.py": _OBS_REGISTER,
+        "docs/observability.md": _OBS_DOC,
+    })
+    assert findings_for(findings, "LINT-OBS-015") == []
+
+
+def test_obs_rule_flags_health_read_of_unregistered_metric(tmp_path):
+    _, findings = lint_tree(tmp_path, {
+        "app/health.py": _OBS_HEALTH,
+        "docs/observability.md": _OBS_DOC,
+    })
+    obs = findings_for(findings, "LINT-OBS-015")
+    # the unregistered name is flagged both at the read site and in the doc
+    assert [f.path for f in obs] == ["app/health.py", "docs/observability.md"]
+    assert "registers" in obs[0].message
+
+
+def test_obs_rule_flags_undocumented_health_read(tmp_path):
+    _, findings = lint_tree(tmp_path, {
+        "app/health.py": _OBS_HEALTH,
+        "ops/w.py": _OBS_REGISTER + (
+            '    _d = metrics.counter("ops_other_total", "documented one")\n'),
+        # the doc has a metrics reference, just not for the read name
+        "docs/observability.md": "Metrics: `ops_other_total`.\n",
+    })
+    obs = findings_for(findings, "LINT-OBS-015")
+    assert [f.path for f in obs] == ["app/health.py"]
+    assert "documents" in obs[0].message
+
+
+def test_obs_rule_flags_documented_but_unregistered_metric(tmp_path):
+    _, findings = lint_tree(tmp_path, {
+        "app/health.py": _OBS_HEALTH,
+        "ops/w.py": _OBS_REGISTER,
+        "docs/observability.md": _OBS_DOC + "Also `ops_ghost_total`.\n",
+    })
+    obs = findings_for(findings, "LINT-OBS-015")
+    assert [f.path for f in obs] == ["docs/observability.md"]
+    assert "ops_ghost_total" in obs[0].message
+
+
+# ---------------------------------------------------------------------------
+# dependency-fingerprinted caching
+# ---------------------------------------------------------------------------
+
+_CACHE_TREE = {
+    "core/b.py": "def make():\n    return 1\n",
+    "core/a.py": """\
+        from .b import make
+
+        def report():
+            _log.info("made", key=make())
+    """,
+}
+
+
+def test_cache_editing_imported_module_invalidates_dependents(tmp_path):
+    """core/a.py never changes, but when its import core/b.py starts
+    returning a secret, a.py's fingerprint changes and its cached clean
+    verdict is NOT reused — the new cross-module finding appears."""
+    cache = tmp_path / "cache.json"
+    tree = tmp_path / "tree"
+    eng1, findings1 = lint_tree(tree, dict(_CACHE_TREE), cache=cache)
+    assert findings_for(findings1, "LINT-SEC-013") == []
+    fp_a_before = eng1.fingerprints["core/a.py"]
+
+    (tree / "core/b.py").write_text(
+        "def make():\n    return generate_secret_key()\n")
+    eng2 = Engine(cache_path=cache)
+    findings2 = eng2.lint_paths([tree], root=tree)
+    assert eng2.fingerprints["core/a.py"] != fp_a_before
+    sec = findings_for(findings2, "LINT-SEC-013")
+    assert [f.path for f in sec] == ["core/a.py"]
+
+
+def test_cache_clean_rerun_parses_nothing(tmp_path):
+    cache = tmp_path / "cache.json"
+    tree = tmp_path / "tree"
+    eng1, findings1 = lint_tree(tree, dict(_CACHE_TREE), cache=cache)
+    assert eng1.stats["parsed"] > 0
+
+    eng2 = Engine(cache_path=cache)
+    findings2 = eng2.lint_paths([tree], root=tree)
+    assert eng2.stats["parsed"] == 0  # all four buckets hit
+    assert findings2 == findings1
+
+
+def test_cache_doc_edit_invalidates_tree_rules_only(tmp_path):
+    """The OBS tree key covers docs/observability.md: deleting the doc's
+    metric entry re-runs the tree rules and surfaces the drift, without
+    any Python file changing."""
+    cache = tmp_path / "cache.json"
+    tree = tmp_path / "tree"
+    files = {
+        "app/health.py": _OBS_HEALTH,
+        "ops/w.py": _OBS_REGISTER,
+        "docs/observability.md": _OBS_DOC,
+    }
+    _, findings1 = lint_tree(tree, files, cache=cache)
+    assert findings_for(findings1, "LINT-OBS-015") == []
+
+    (tree / "docs/observability.md").write_text(
+        "Metrics: `ops_other_total`.\n")
+    eng2 = Engine(cache_path=cache)
+    findings2 = eng2.lint_paths([tree], root=tree)
+    # the per-file and fingerprint buckets still hit (no .py changed); only
+    # the tree key moved, so the index rebuild re-parses the two .py files
+    assert eng2.stats["parsed"] == 2
+    obs = findings_for(findings2, "LINT-OBS-015")
+    assert len(obs) == 2  # read undocumented + doc name unregistered
+
+
+# ---------------------------------------------------------------------------
+# CLI: --format=json and --changed
+# ---------------------------------------------------------------------------
+
+
+def test_cli_format_json_schema(tmp_path, capsys):
+    write_tree(tmp_path, {"core/secrets.py": """\
+        def persist(path):
+            path.write_text(generate_secret_key().hex())
+    """})
+    rc = lint_main(["--format=json", "--no-baseline",
+                    "--root", str(tmp_path), str(tmp_path)])
+    report = json.loads(capsys.readouterr().out)
+    assert rc == 1
+    assert report["version"] == 2
+    assert report["rules_version"] == RULES_VERSION
+    assert report["counts_by_rule"] == {"LINT-SEC-013": 1}
+    assert report["findings"][0]["path"] == "core/secrets.py"
+    assert report["findings"][0]["new"] is True
+
+
+def test_cli_changed_filters_to_changed_plus_importers(tmp_path, capsys):
+    """--changed with a manifest naming only core/b.py: the report keeps
+    the finding in core/a.py (it imports b, so b's edit can change its
+    verdict) and drops the unrelated finding in core/c.py."""
+    tree = tmp_path / "tree"
+    write_tree(tree, {
+        "core/b.py": "def make():\n    return generate_secret_key()\n",
+        "core/a.py": """\
+            from .b import make
+
+            def report():
+                _log.info("made", key=make())
+        """,
+        "core/c.py": """\
+            import asyncio
+
+            async def go(coro):
+                asyncio.ensure_future(coro)
+        """,
+    })
+    manifest = tmp_path / "changed.txt"
+    manifest.write_text("core/b.py\n")
+    rc = lint_main(["--format=json", "--no-baseline", "--root", str(tree),
+                    "--changed", str(manifest), str(tree)])
+    report = json.loads(capsys.readouterr().out)
+    assert rc == 1
+    assert {f["path"] for f in report["findings"]} == {"core/a.py"}
+
+    # no filter: both findings report
+    rc = lint_main(["--format=json", "--no-baseline", "--root", str(tree),
+                    str(tree)])
+    report = json.loads(capsys.readouterr().out)
+    assert {f["path"] for f in report["findings"]} == {
+        "core/a.py", "core/c.py"}
+
+
+# ---------------------------------------------------------------------------
+# regressions for the bugs the tree-wide burn-down fixed
+# ---------------------------------------------------------------------------
+
+
+def test_secretio_writes_0600_from_birth(tmp_path):
+    """utils/secretio replaced four write_text-then-chmod races: the key
+    file must never exist with permissive bits, and the write is atomic."""
+    from charon_tpu.utils import secretio
+
+    path = tmp_path / "charon-enr-private-key"
+    secretio.write_secret_text(path, "deadbeef")
+    assert path.read_text() == "deadbeef"
+    assert oct(path.stat().st_mode & 0o777) == oct(0o600)
+    assert list(tmp_path.iterdir()) == [path]  # no tmp file left behind
+
+    secretio.write_secret_bytes(path, b"cafe")  # overwrite keeps the mode
+    assert path.read_bytes() == b"cafe"
+    assert oct(path.stat().st_mode & 0o777) == oct(0o600)
+
+
+def test_cluster_identity_keys_written_0600(tmp_path):
+    from charon_tpu.cluster import create_cluster
+
+    create_cluster("t", 1, 3, 2, str(tmp_path))
+    key_files = sorted(tmp_path.glob("node*/charon-enr-private-key"))
+    assert len(key_files) == 3
+    for kf in key_files:
+        assert oct(kf.stat().st_mode & 0o777) == oct(0o600)
+        bytes.fromhex(kf.read_text())  # content is the hex key
+
+
+def test_parsigex_verify_runs_off_event_loop():
+    """The per-partial pairing check used to run the native verify directly
+    on the event loop; it must now hop through an executor thread."""
+    from charon_tpu.core import parsigex, types
+    from charon_tpu.core.signeddata import _Eth2Signed
+
+    seen = {}
+
+    class FakeSigned(_Eth2Signed):
+        def __init__(self):
+            pass
+
+        def verify(self, chain, pubkey):
+            seen["thread"] = threading.current_thread()
+            return True
+
+    class FakeKeys:
+        def share_pubkey(self, pubkey, idx):
+            return b"pk"
+
+    verify = parsigex.new_eth2_verifier(chain=None, keys=FakeKeys())
+    duty = types.Duty(1, types.DutyType.ATTESTER)
+    psd = types.ParSignedData(FakeSigned(), 1)
+
+    async def run():
+        await verify(duty, b"pub", psd)
+        return threading.current_thread()
+
+    loop_thread = asyncio.run(run())
+    assert seen["thread"] is not loop_thread
+
+
+def test_vapi_verify_partial_is_async():
+    """Component._verify_partial hops the pairing check off the loop; every
+    submission handler awaits it."""
+    from charon_tpu.core import validatorapi
+
+    assert asyncio.iscoroutinefunction(validatorapi.Component._verify_partial)
+
+
+def test_monitoring_exports_beacon_syncing_gauge():
+    """readyz's BN sync poll must feed the app_beacon_node_syncing gauge the
+    health rule reads (it was read but never registered anywhere)."""
+    from charon_tpu.app.monitoring import MonitoringAPI
+    from charon_tpu.utils import metrics
+
+    class FakeBeacon:
+        def __init__(self, syncing):
+            self.syncing = syncing
+
+        async def node_syncing(self):
+            return self.syncing
+
+    def gauge_value() -> float:
+        text = metrics.default_registry.expose_text()
+        lines = [ln for ln in text.splitlines()
+                 if ln.startswith("app_beacon_node_syncing")
+                 and not ln.startswith("#")]
+        assert lines, "gauge not registered"
+        return float(lines[-1].split()[-1])
+
+    api = MonitoringAPI(beacon=FakeBeacon(True))
+    resp = asyncio.run(api._readyz(None))
+    assert resp.status == 503
+    assert gauge_value() == 1.0
+
+    api = MonitoringAPI(beacon=FakeBeacon(False))
+    resp = asyncio.run(api._readyz(None))
+    assert resp.status == 200
+    assert gauge_value() == 0.0
