@@ -8,7 +8,9 @@ Prints one line per stage to stderr and a JSON summary to stdout.
 
 from __future__ import annotations
 
+import argparse
 import json
+import os
 import random
 import secrets
 import sys
@@ -27,7 +29,51 @@ def tick(label, t0):
     return dt
 
 
+def _log_micro_stages(stages: dict, phases: dict, field_plane: str) -> None:
+    """Append the per-stage A/B row to MICROBENCH.jsonl keyed by git commit
+    and field plane, so `--field-plane=xla` vs `--field-plane=pallas` runs
+    of the SAME commit are directly comparable. Append-only, best-effort —
+    the profile run must never fail on ledger IO (bench.py idiom)."""
+    import pathlib
+    import subprocess
+
+    try:
+        commit = subprocess.run(
+            ["git", "rev-parse", "--short", "HEAD"],
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+            capture_output=True, text=True, timeout=10).stdout.strip()
+    except Exception:  # noqa: BLE001
+        commit = "unknown"
+    rec = {
+        "ts": round(time.time(), 1),
+        "commit": commit or "unknown",
+        "metric": "micro: per-stage fused slot, field-plane A/B",
+        "field_plane": field_plane,
+        "fused_slot_s": stages.get("fused.slot"),
+        "stages_s": {k: round(v, 4) for k, v in stages.items()},
+        "phases": phases,
+        "tag": "bench_stages",
+    }
+    try:
+        path = pathlib.Path(__file__).resolve().parent / "MICROBENCH.jsonl"
+        with open(path, "a") as fh:
+            fh.write(json.dumps(rec) + "\n")
+    except OSError:
+        pass
+
+
 def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument(
+        "--field-plane", choices=("xla", "pallas"), default=None,
+        help="route curve._mont_mul (Montgomery limb products) through the "
+             "XLA scan CIOS or the Pallas Mosaic body; sets "
+             "CHARON_TPU_FIELD_PLANE before any charon import so every "
+             "trace in the run picks the same plane")
+    args = ap.parse_args()
+    if args.field_plane is not None:
+        os.environ["CHARON_TPU_FIELD_PLANE"] = args.field_plane
+
     import jax
     import jax.numpy as jnp
 
@@ -77,6 +123,24 @@ def main() -> None:
     stages["fused.slot"] = tick("fused.slot (ONE dispatch + ONE transfer)",
                                 t0)
     assert ok_f
+
+    # ---- pipelined steady state: slot N's verify overlaps slot N+1's
+    # pack (and the in-flight execute) on the stage-3 executor seam, so
+    # steady per-slot time approaches max(phase), not the phase sum. The
+    # per-phase p50/p99 (including the "verify" phase, one sample per
+    # slot) lands in the "phases" JSON key below.
+    pipe = PA.SigAggPipeline()
+    pipe_slots = 6
+    results = []
+    t0 = time.time()
+    for _ in range(pipe_slots):
+        results += pipe.submit(batches, pubkeys, datas)
+    results += pipe.drain()
+    dt = time.time() - t0
+    stages["pipe.slot_steady"] = dt / pipe_slots
+    tick(f"pipe.slot_steady ({pipe_slots} slots, verify overlapped, "
+         f"{dt / pipe_slots:.3f}s/slot)", t0)
+    assert len(results) == pipe_slots and all(ok for _, ok in results)
 
     # ---- aggregate: end-to-end, then each internal dispatch ---------------
     t0 = time.time()
@@ -201,7 +265,11 @@ def main() -> None:
                                   "p99_s": stats["p99"],
                                   "count": stats["count"]}
 
+    field_plane = PP.field_plane()
+    _log_micro_stages(stages, phases, field_plane)
+
     print(json.dumps({
+        "field_plane": field_plane,
         "stages": {k: round(v, 3) for k, v in stages.items()},
         # hit/miss/decompress counters show whether ver.pk_plane_cached
         # above was a PlaneStore hit (steady state) or paid a decode

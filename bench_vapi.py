@@ -112,6 +112,26 @@ async def _run(cfg) -> dict:
         "slots_per_epoch": cfg.slots_per_epoch,
         "storm_validators": cfg.storm_validators, "seed": cfg.seed,
     }
+    # verify-path telemetry: which pairing rung served the run's parsigex
+    # storms (device lanes vs native ctypes fallback) and the on-device
+    # verify-phase latency — the ISSUE-13 default-on device verify should
+    # show device counts with zero native residual and a bounded p99.
+    from charon_tpu.ops import plane_agg as PA
+    from charon_tpu.utils import metrics
+
+    tail["pairing_paths"] = {"device": PA._pairing_c.value("device"),
+                             "native": PA._pairing_c.value("native")}
+    verify_hist = 'ops_device_dispatch_seconds{phase="verify"}'
+    vstats = metrics.snapshot_quantiles().get(verify_hist, {})
+    if vstats.get("count"):
+        tail["verify_phase"] = {"p50_s": round(vstats["p50"], 4),
+                                "p99_s": round(vstats["p99"], 4),
+                                "count": vstats["count"]}
+        print(f"# verify phase: p50={vstats['p50'] * 1e3:.1f}ms "
+              f"p99={vstats['p99'] * 1e3:.1f}ms n={vstats['count']:.0f}",
+              file=sys.stderr)
+    print(f"# pairing paths: device={tail['pairing_paths']['device']:.0f} "
+          f"native={tail['pairing_paths']['native']:.0f}", file=sys.stderr)
     shed = report.client_tallies.get("shed_503", 0)
     print(f"# {report.client_requests} client requests in "
           f"{report.elapsed_s:.1f}s ({report.achieved_rps:.1f} req/s), "

@@ -9,9 +9,9 @@ from .flt import FaultSiteRule
 from .iface import ProtocolImplRule
 from .obs import DutySpanRule, MetricDriftRule
 from .sec import SecretTaintRule
-from .tpu import (DeviceDtypeRule, MeshTopologyRule,
-                  NativePairingRoutingRule, PipelineLockSyncRule,
-                  PlaneStoreRoutingRule)
+from .tpu import (DeviceDtypeRule, FieldPlaneRoutingRule,
+                  MeshTopologyRule, NativePairingRoutingRule,
+                  PipelineLockSyncRule, PlaneStoreRoutingRule)
 from .vapi import StrictBodyRule
 
 __all__ = [
@@ -24,6 +24,7 @@ __all__ = [
     "PipelineLockSyncRule",
     "MeshTopologyRule",
     "NativePairingRoutingRule",
+    "FieldPlaneRoutingRule",
     "ProtocolImplRule",
     "DutySpanRule",
     "StrictBodyRule",
@@ -45,6 +46,7 @@ def default_rules() -> list:
         PipelineLockSyncRule(),
         MeshTopologyRule(),
         NativePairingRoutingRule(),
+        FieldPlaneRoutingRule(),
         ProtocolImplRule(),
         DutySpanRule(),
         StrictBodyRule(),

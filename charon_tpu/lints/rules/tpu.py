@@ -473,3 +473,47 @@ class NativePairingRoutingRule:
                 " silently bypasses the device verify path; route through "
                 "plane_agg._pairing_finish so the guard ladder and the "
                 "ops_pairing_total path split see the work")
+
+
+# the Pallas field entry points: any new Mosaic field kernel wrapper that
+# replaces an XLA-scan field op belongs in this tuple
+_FIELD_PLANE_CALLS = ("mont_mul_rows",)
+# the ONLY def allowed to call them: the curve._mont_mul routing seam, which
+# reads CHARON_TPU_FIELD_PLANE and keeps the XLA/Pallas planes bit-identical
+_FIELD_PLANE_SANCTIONED_DEFS = ("_mont_mul",)
+
+
+class FieldPlaneRoutingRule:
+    id = "LINT-TPU-016"
+    description = ("Pallas field entry points (pallas_plane.mont_mul_rows) "
+                   "in ops/ are only sanctioned inside the curve._mont_mul "
+                   "seam — a fresh call site forks the field plane past the "
+                   "CHARON_TPU_FIELD_PLANE switch and the bit-identity "
+                   "oracle")
+
+    def check(self, src: SourceFile) -> Iterable[Finding]:
+        # pallas_plane.py itself defines the entry points (and their own
+        # internal helpers); the seam contract binds its CONSUMERS
+        if not src.in_dir("ops") or src.rel.endswith("pallas_plane.py"):
+            return
+        for node in ast.walk(src.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if isinstance(node.func, ast.Attribute):
+                callee = node.func.attr
+            elif isinstance(node.func, ast.Name):
+                callee = node.func.id
+            else:
+                continue
+            if callee not in _FIELD_PLANE_CALLS:
+                continue
+            encl = PlaneStoreRoutingRule._enclosing_defs(src, node)
+            if any(n in _FIELD_PLANE_SANCTIONED_DEFS for n in encl):
+                continue
+            yield Finding(
+                src.rel, node.lineno, self.id,
+                f"`{callee}` outside the curve._mont_mul seam forks the "
+                "field plane: the call ignores CHARON_TPU_FIELD_PLANE, "
+                "escapes the XLA-vs-Pallas bit-identity oracle, and can't "
+                "be A/B'd by bench_stages --field-plane; route the product "
+                "through ops.curve._mont_mul (or _fq_mul_many)")

@@ -21,17 +21,35 @@ import numpy as np
 from . import field as F
 
 
+def _mont_mul(a, b):
+    """THE field-plane seam (LINT-TPU-016): one possibly-stacked Montgomery
+    product, routed by CHARON_TPU_FIELD_PLANE — "xla" (default) runs the
+    scan-based ops/field CIOS, "pallas" the in-kernel Mosaic CIOS body
+    (pallas_plane.mont_mul_rows). Bit-identical outputs either way; the
+    flag is read at trace time. Every batched product in the point
+    formulas and the pairing Miller step funnels through here via
+    _fq_mul_many — new Pallas field entry points belong behind this def,
+    not at fresh call sites."""
+    from . import pallas_plane as PP
+
+    if PP.field_plane() == "pallas":
+        shape = jnp.broadcast_shapes(a.shape, b.shape)
+        return PP.mont_mul_rows(jnp.broadcast_to(a, shape),
+                                jnp.broadcast_to(b, shape))
+    return F.fq_mont_mul(a, b)
+
+
 def _fq_mul_many(pairs):
     """Stack k independent Fq products into ONE Montgomery scan — fewer XLA
     loops (compile time) and wider per-step vectors (VPU utilization)."""
     if len(pairs) == 1:
-        return [F.fq_mont_mul(*pairs[0])]
+        return [_mont_mul(*pairs[0])]
     shapes = [jnp.broadcast_shapes(a.shape, b.shape) for a, b in pairs]
     shape = shapes[0]
     assert all(s == shape for s in shapes), "mul_many requires uniform shapes"
     A = jnp.stack([jnp.broadcast_to(a, shape) for a, _ in pairs])
     B = jnp.stack([jnp.broadcast_to(b, shape) for _, b in pairs])
-    R = F.fq_mont_mul(A, B)
+    R = _mont_mul(A, B)
     return [R[i] for i in range(len(pairs))]
 
 

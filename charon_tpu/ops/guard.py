@@ -281,6 +281,50 @@ def finish_slot(state, inputs, hash_fn=None):
     return out
 
 
+def finish_slot_emit(state, inputs, hash_fn=None):
+    """Split-seam variant of finish_slot for the pipeline's chained
+    stage-3 tasks: returns (aggregates, verify_thunk) so the caller can
+    defer the verify dispatch onto its own executor task, overlapping the
+    next slot's pack. The ladder semantics are identical — breaker-open
+    and dispatch-failed slots descend the ladder here (their verdict is
+    already final, returned as a trivial thunk), emit-half device
+    failures descend it too, and input errors raise unchanged. Verify
+    failures never need the ladder: _pairing_finish degrades itself
+    through guard.note_verify_fallback to the native rung, so the thunk
+    only raises for input-class errors."""
+    from . import plane_agg as PA
+
+    tag = state[0]
+    if tag == "native_slot":
+        _fallback_c.inc("breaker_open", "native")
+        _log.warn("slot routed native: breaker open")
+        out, ok = _native_rung(inputs, hash_fn)
+        return out, lambda: ok
+    if tag == "dispatch_failed":
+        exc = state[1]
+        reason = classify(exc)
+        BREAKER.record_failure()
+        _log.warn("slot dispatch failed on primary plane; descending "
+                  "ladder", err=exc, reason=reason)
+        out, ok = _run_ladder(inputs, hash_fn, _primary_width() // 2,
+                              reason, exc)
+        return out, lambda: ok
+    try:
+        out, verify = PA._fused_emit(state, hash_fn)
+    except Exception as exc:
+        reason = classify(exc)
+        if reason == "input":
+            raise
+        BREAKER.record_failure()
+        _log.warn("slot failed on primary plane; descending ladder",
+                  err=exc, reason=reason, width=_state_width(state))
+        out, ok = _run_ladder(inputs, hash_fn, _state_width(state) // 2,
+                              reason, exc)
+        return out, lambda: ok
+    BREAKER.record_success()
+    return out, verify
+
+
 def watchdog_recover(inputs, hash_fn=None):
     """A slot future blew its deadline: the fence is hung. Abandon the
     stuck future (its worker thread resolves late or leaks with the hung

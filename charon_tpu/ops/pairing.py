@@ -35,6 +35,7 @@ from ..crypto import curve as PC
 from ..crypto import fields as PF
 from . import field as F
 from . import tower as T
+from .pallas_plane import TILE as _TILE
 
 X_ABS = 0xD201000000010000
 _X_BITS = bin(X_ABS)[3:]  # MSB implied; 63 steps, 5 additions
@@ -300,6 +301,46 @@ def _compiled_pairing_check(batch: int):
     return kernel
 
 
+# Lane ceiling of one Miller-loop dispatch: one kernel tile. Pair sets
+# beyond it run as successive ≤TILE chunk dispatches whose per-chunk Fq12
+# products fold across chunks before the single final exponentiation —
+# pairing multiplicativity (Π over chunks of Π within chunk == Π over all
+# pairs) makes the chunked verdict bit-identical to a monolithic graph
+# while the compiled shape family stays bounded at TILE lanes.
+MAX_PAIR_TILE = _TILE
+
+
+@functools.lru_cache(maxsize=8)
+def _compiled_miller_fold(batch: int):
+    """One chunk of the chunked multi-pairing check: per-lane Miller loops,
+    masked to Fq12 one on padding lanes, tree-folded to a batch-1 Fq12
+    product. No final exponentiation — that runs once, downstream, on the
+    cross-chunk product (_compiled_chunk_finish)."""
+
+    @jax.jit
+    def kernel(p_x, p_y, q_x, q_y, mask):
+        f = miller_loop_pairs([(p_x, p_y)], [(q_x, q_y)])
+        f = _select_fq12(mask, f, T.fq12_one_like(q_x))
+        return _fq12_fold_product(f, batch)
+
+    return kernel
+
+
+@functools.lru_cache(maxsize=8)
+def _compiled_chunk_finish(k: int):
+    """Cross-chunk finish: fold k per-chunk Fq12 products (each a batch
+    lane of the six Fq2 coefficient arrays) and run the ONE final
+    exponentiation. Padding lanes are masked to one."""
+
+    @jax.jit
+    def kernel(c0, c1, c2, c3, c4, c5, mask):
+        f = ((c0, c1, c2), (c3, c4, c5))
+        f = _select_fq12(mask, f, T.fq12_one_like(c0))
+        return final_exp_is_one(_fq12_fold_product(f, k))
+
+    return kernel
+
+
 def _bucket_pairs(n: int) -> int:
     b = 2
     while b < n:
@@ -307,29 +348,91 @@ def _bucket_pairs(n: int) -> int:
     return b
 
 
+def _fq12_concat(fs):
+    """Concatenate per-chunk Fq12 products along the batch axis."""
+    return (tuple(jnp.concatenate([f[0][i] for f in fs]) for i in range(3)),
+            tuple(jnp.concatenate([f[1][i] for f in fs]) for i in range(3)))
+
+
+def _pad_lane0(a, Bp: int, n: int):
+    if Bp == n:
+        return a
+    return np.concatenate([a, np.repeat(a[:1], Bp - n, axis=0)])
+
+
+def miller_fold_chunk(p_x, p_y, q_x, q_y):
+    """Dispatch ONE ≤TILE chunk's Miller loops + in-graph fold; returns the
+    chunk's batch-1 Fq12 product as device arrays (no sync — successive
+    chunk dispatches queue behind each other asynchronously)."""
+    m = p_x.shape[0]
+    Bp = _bucket_pairs(m)
+    mask = np.zeros(Bp, dtype=bool)
+    mask[:m] = True
+    kern = _compiled_miller_fold(Bp)
+    return kern(jnp.asarray(_pad_lane0(np.asarray(p_x), Bp, m)),
+                jnp.asarray(_pad_lane0(np.asarray(p_y), Bp, m)),
+                jnp.asarray(_pad_lane0(np.asarray(q_x), Bp, m)),
+                jnp.asarray(_pad_lane0(np.asarray(q_y), Bp, m)),
+                jnp.asarray(mask))
+
+
+def fold_chunks_is_one(parts) -> bool:
+    """Fold a list of per-chunk Fq12 products (batch-1 each) through the
+    pairwise tree and run the single final exponentiation."""
+    k = len(parts)
+    if k == 1:
+        c0 = parts[0][0][0]
+        mask = np.ones(c0.shape[0], dtype=bool)
+        f = parts[0]
+        ok = _compiled_chunk_finish(c0.shape[0])(
+            *f[0], *f[1], jnp.asarray(mask))
+        return bool(np.asarray(ok).reshape(-1)[0])
+    Kp = _bucket_pairs(k)
+    f = _fq12_concat(parts)
+    mask = np.zeros(Kp, dtype=bool)
+    mask[:k] = True
+
+    def padf(c):
+        if Kp == k:
+            return c
+        return jnp.concatenate([c, jnp.repeat(c[:1], Kp - k, axis=0)])
+
+    cs = [padf(c) for c in (*f[0], *f[1])]
+    ok = _compiled_chunk_finish(Kp)(*cs, jnp.asarray(mask))
+    return bool(np.asarray(ok).reshape(-1)[0])
+
+
+def _pairing_check_chunked(p_x, p_y, q_x, q_y) -> bool:
+    """>TILE pair sets: successive TILE-lane Miller dispatches, each folded
+    to one Fq12 on device, then one cross-chunk finish dispatch. Every
+    compiled shape stays ≤ TILE lanes."""
+    n = p_x.shape[0]
+    arrs = tuple(np.asarray(a) for a in (p_x, p_y, q_x, q_y))
+    parts = [miller_fold_chunk(*(a[s:s + MAX_PAIR_TILE] for a in arrs))
+             for s in range(0, n, MAX_PAIR_TILE)]
+    return fold_chunks_is_one(parts)
+
+
 def pairing_check_planes(p_x, p_y, q_x, q_y) -> bool:
     """Π e(Pᵢ, Qᵢ) == 1 over Montgomery limb planes: p_* are (n, L) affine
     G1 coordinates, q_* are (n, 2, L) affine G2 twist coordinates, all
     non-infinity (degenerate pairs are the caller's host-side contract —
     see plane_agg._pairing_finish). Pads to the power-of-two bucket with
-    masked repeats of lane 0."""
+    masked repeats of lane 0; beyond MAX_PAIR_TILE pairs the check runs
+    chunked (see _pairing_check_chunked) with a bit-identical verdict."""
     n = p_x.shape[0]
     if n == 0:
         return True
+    if n > MAX_PAIR_TILE:
+        return _pairing_check_chunked(p_x, p_y, q_x, q_y)
     Bp = _bucket_pairs(n)
-
-    def pad(a):
-        if Bp == n:
-            return a
-        return np.concatenate([a, np.repeat(a[:1], Bp - n, axis=0)])
-
     mask = np.zeros(Bp, dtype=bool)
     mask[:n] = True
     kernel = _compiled_pairing_check(Bp)
-    ok = kernel(jnp.asarray(pad(np.asarray(p_x))),
-                jnp.asarray(pad(np.asarray(p_y))),
-                jnp.asarray(pad(np.asarray(q_x))),
-                jnp.asarray(pad(np.asarray(q_y))),
+    ok = kernel(jnp.asarray(_pad_lane0(np.asarray(p_x), Bp, n)),
+                jnp.asarray(_pad_lane0(np.asarray(p_y), Bp, n)),
+                jnp.asarray(_pad_lane0(np.asarray(q_x), Bp, n)),
+                jnp.asarray(_pad_lane0(np.asarray(q_y), Bp, n)),
                 jnp.asarray(mask))
     return bool(np.asarray(ok).reshape(-1)[0])
 
@@ -345,5 +448,26 @@ def warm_check_buckets(buckets=(2,)) -> int:
         fq2 = jax.ShapeDtypeStruct((b, 2, L), jnp.int32)
         m = jax.ShapeDtypeStruct((b,), jnp.bool_)
         _compiled_pairing_check(b).lower(fq, fq, fq2, fq2, m).compile()
+        n += 1
+    return n
+
+
+def warm_chunk_graphs(chunk_buckets=(MAX_PAIR_TILE,),
+                      finish_buckets=(2, 4)) -> int:
+    """AOT-compile the chunked-verify graph family: per-chunk Miller+fold
+    at each chunk bucket, plus the cross-chunk finish at each chunk-count
+    bucket. Returns the number of graphs lowered."""
+    L = F.LIMBS
+    n = 0
+    for b in chunk_buckets:
+        fq = jax.ShapeDtypeStruct((b, L), jnp.int32)
+        fq2 = jax.ShapeDtypeStruct((b, 2, L), jnp.int32)
+        m = jax.ShapeDtypeStruct((b,), jnp.bool_)
+        _compiled_miller_fold(b).lower(fq, fq, fq2, fq2, m).compile()
+        n += 1
+    for k in finish_buckets:
+        fq2 = jax.ShapeDtypeStruct((k, 2, L), jnp.int32)
+        m = jax.ShapeDtypeStruct((k,), jnp.bool_)
+        _compiled_chunk_finish(k).lower(*([fq2] * 6), m).compile()
         n += 1
     return n

@@ -906,3 +906,75 @@ def pt_add(p: PlanePoint, q: PlanePoint) -> PlanePoint:
 
 def fe_mul(a, b, E: int):
     return _mul_call(a, b, E)
+
+
+# ---------------------------------------------------------------------------
+# Field-plane selection seam: CHARON_TPU_FIELD_PLANE=xla|pallas routes the
+# stacked Montgomery products of curve._fq_mul_many — the inner loop of the
+# pairing Miller step and of every XLA-plane point formula — through either
+# the scan-based ops/field CIOS (xla, the default) or the in-kernel Mosaic
+# CIOS body below (pallas, the first production consumer of this module's
+# MXU path). Outputs are bit-identical (same CIOS math, canonical limbs;
+# the oracle test pins it); the flag is read at TRACE time, so flipping it
+# only affects graphs compiled afterwards — tests clear the jit caches.
+# ---------------------------------------------------------------------------
+
+_FIELD_PLANES = ("xla", "pallas")
+
+
+def field_plane() -> str:
+    """The selected field plane: "xla" (default) or "pallas". Raises on an
+    unknown CHARON_TPU_FIELD_PLANE value so a typo fails loudly instead of
+    silently benchmarking the wrong plane."""
+    env = _os.environ.get("CHARON_TPU_FIELD_PLANE", "").strip().lower()
+    if env in ("", "xla"):
+        return "xla"
+    if env not in _FIELD_PLANES:
+        raise ValueError(
+            f"CHARON_TPU_FIELD_PLANE must be one of {_FIELD_PLANES}, "
+            f"got {env!r}")
+    return env
+
+
+def mont_mul_rows(a, b):
+    """Montgomery products over ops/field ROWS through the Pallas kernel:
+    a, b are (..., LIMBS) int32 in Montgomery form, same shape; returns
+    a·b·R⁻¹ mod p with canonical limbs, bit-identical to F.fq_mont_mul.
+    Rows are transposed into one (1, LIMBS, 8, W) kernel plane, run
+    through the _kern_mul Mosaic body (the fully-unrolled CIOS), and
+    transposed back. On a CPU backend the body runs in pallas interpret
+    mode — the real kernel code, ~1000x slower than XLA (oracle tests use
+    tiny tiles; benches only select this plane on hardware)."""
+    assert a.shape == b.shape, "mont_mul_rows requires pre-broadcast rows"
+    shape = a.shape
+    n = 1
+    for d in shape[:-1]:
+        n *= int(d)
+    out = _mont_rows_call(jnp.reshape(a, (n, LIMBS)),
+                          jnp.reshape(b, (n, LIMBS)))
+    return jnp.reshape(out, shape)
+
+
+@jax.jit
+def _mont_rows_call(ra, rb):
+    n = ra.shape[0]
+    n8 = -(-n // SUB) * SUB
+    if n8 != n:
+        pad = [(0, n8 - n), (0, 0)]
+        ra = jnp.pad(ra, pad)
+        rb = jnp.pad(rb, pad)
+    W = n8 // SUB
+    A = jnp.transpose(ra, (1, 0)).reshape(1, LIMBS, SUB, W)
+    B = jnp.transpose(rb, (1, 0)).reshape(1, LIMBS, SUB, W)
+    tw = min(TW, W)
+    (A, B), W0 = _pad_lanes((A, B), tw)
+    Wp = A.shape[-1]
+    out = pl.pallas_call(
+        _kern_mul,
+        grid=(Wp // tw,),
+        in_specs=[_pspec()] + [_espec(1, SUB, tw)] * 2,
+        out_specs=_espec(1, SUB, tw),
+        out_shape=_eshape(1, SUB, Wp),
+        interpret=_interpret(),
+    )(jnp.asarray(_P_NP), A, B)[..., :W0]
+    return jnp.transpose(out.reshape(LIMBS, n8), (1, 0))[:n]

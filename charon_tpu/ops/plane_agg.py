@@ -82,6 +82,13 @@ _finish_backlog = metrics.gauge(
     "ops_sigagg_finish_backlog",
     "SigAggPipeline slots whose stage-3 host finish has not completed")
 
+# Slots whose emit half is done but whose verify dispatch (the deferred
+# back half of stage 3) has not completed — a persistently high value
+# means verification, not byte emission, is the stage-3 bound.
+_verify_backlog = metrics.gauge(
+    "ops_sigagg_verify_backlog",
+    "SigAggPipeline slots whose deferred verify phase has not completed")
+
 # Shard width of the most recent sigagg dispatch: 1 on the single-device
 # path, the mesh width on the sharded path. Health cross-checks this
 # against ops_mesh_devices — a mesh wider than the dispatched width means
@@ -148,22 +155,20 @@ _pairing_c = metrics.counter(
     "TPU Miller loop + final exp, native = ctypes ct_pairing_check (guard "
     "fallback rung / hosts without an accelerator)", ("path",))
 
-# Largest pair batch the device verify takes in one dispatch — same
-# TILE-derived bound as the h2c bucket family; a slot with more distinct
-# messages than a whole plane tile goes native.
-_MAX_DEVICE_PAIRS = PP.TILE
-
-
 def _verify_device_path() -> bool:
     """Whether _pairing_finish runs the slot verification on device.
     CHARON_TPU_DEVICE_VERIFY=0/1 forces it off/on (tests, triage);
-    otherwise it follows the plane: real chip yes, interpret mode no (the
+    otherwise it is ON — interpret mode included. There is no pair-count
+    ceiling anymore: >TILE pair sets run as chunked ≤TILE Miller
+    dispatches folded before one final exp (pairing.MAX_PAIR_TILE), and
+    the breaker + native rung stay underneath as the safety net. CPU CI
+    sets CHARON_TPU_DEVICE_VERIFY=0 in tests/conftest.py because the
     pairing graph costs minutes of XLA:CPU compile — the exact hazard
-    tests/test_device_pairing.py slow-gates)."""
+    tests/test_device_pairing.py slow-gates."""
     env = os.environ.get("CHARON_TPU_DEVICE_VERIFY")
     if env is not None:
         return env not in ("", "0", "false")
-    return not PP._interpret()
+    return True
 
 
 # ---------------------------------------------------------------------------
@@ -899,12 +904,25 @@ def _fused_dispatch_impl(layout, pks, msgs):
 def _fused_finish(state, hash_fn=None):
     """Complete one fused slot: device fence + readback (_fused_readback),
     then the pure-host back half (_fused_host_finish). This is the stable
-    blocking seam — the pipeline's stage-3 workers and the serial
+    blocking seam — the guard ladder's rungs and the serial
     threshold_aggregate_and_verify path both come through here, so the
     "ops/fused_finish" span and the bad_pk degradation contract live at
-    this level."""
+    this level. The pipeline's stage-3 workers instead ride _fused_emit
+    + the returned verify thunk, so slot N's verify dispatch overlaps
+    slot N+1's pack — same verdicts, same phases, split seam."""
+    out, verify = _fused_emit(state, hash_fn)
+    return out, verify()
+
+
+def _fused_emit(state, hash_fn=None):
+    """The emit half of a slot's completion: device fence + readback +
+    validity check + byte emission + RLC host folds. Returns
+    (aggregates, verify_thunk); calling the thunk runs the slot's pairing
+    verification (the separately-timed "verify" phase) and returns the
+    verdict. Deferring the thunk is what lets the pipeline overlap slot
+    N's verify with slot N+1's pack and the in-flight execute."""
     with tracer.start_span("ops/fused_finish") as span:
-        return _fused_host_finish(_fused_readback(state, span), hash_fn)
+        return _fused_host_emit(_fused_readback(state, span), hash_fn)
 
 
 def _fused_readback(state, span=None):
@@ -937,23 +955,32 @@ def _fused_readback(state, span=None):
 
 
 def _fused_host_finish(hstate, hash_fn=None):
-    """Stage 3 — validity check, bulk byte emission and RLC host folds
-    (the "finish" phase of ops_device_dispatch_seconds), then the slot's
-    pairing verification (the separately-timed "verify" phase: one
-    batched device dispatch, native ctypes rung behind the guard). The
-    heavy parts release the GIL, so the pipeline runs this on a worker
-    thread overlapping the next slot's pack and the in-flight device
-    execute."""
+    """Stage 3, blocking shape: emit half + immediate verify (see
+    _fused_host_emit). Kept for callers that want the whole finish on one
+    thread (guard ladder rungs, serial paths)."""
+    out, verify = _fused_host_emit(hstate, hash_fn)
+    return out, verify()
+
+
+def _fused_host_emit(hstate, hash_fn=None):
+    """Stage 3, emit half — validity check, bulk byte emission and RLC
+    host folds (the "finish" phase of ops_device_dispatch_seconds).
+    Returns (aggregates, verify_thunk): the thunk runs the slot's pairing
+    verification (the separately-timed "verify" phase: chunked batched
+    device dispatches, native ctypes rung behind the guard) when called.
+    The heavy parts of both halves release the GIL, so the pipeline runs
+    them as chained worker tasks overlapping the next slot's pack and the
+    in-flight device execute."""
     faults.check("sigagg.finish")
     if hstate[0].startswith("sharded"):
         from . import sharded_plane
 
-        return sharded_plane.sharded_host_finish(hstate, hash_fn)
+        return sharded_plane.sharded_host_emit(hstate, hash_fn)
     if hstate[0] == "bad_pk":
         _tag, layout = hstate
         sigs_all, scalars_all, V, Vp, T, Wv = layout
         RX, RY, RZ, V, Vp = _aggregate_plane(None, layout)
-        return _serialize_aggregates(RX, RY, RZ, V), False
+        return _serialize_aggregates(RX, RY, RZ, V), lambda: False
     _tag, V, group_msgs, host = hstate
     with _dispatch_hist.observe_time("finish"):
         ok, xs, sign, inf, sig_red, pk_reds = host
@@ -966,7 +993,7 @@ def _fused_host_finish(hstate, hash_fn=None):
                for g, m in enumerate(group_msgs)]
     # _pairing_finish times itself as the "verify" phase — keeping it out
     # of the "finish" window is what makes the two separately attributable
-    return out, _pairing_finish(S, pts, hash_fn)
+    return out, lambda: _pairing_finish(S, pts, hash_fn)
 
 
 # Pipeline knobs (overridable per instance). Depth 2 = classic double
@@ -979,18 +1006,32 @@ PIPELINE_DEPTH = int(os.environ.get("CHARON_TPU_PIPELINE_DEPTH", "2"))
 FINISH_WORKERS = int(os.environ.get("CHARON_TPU_FINISH_WORKERS", "2"))
 
 
-def _run_finish(ctx, state, inputs, hash_fn):
-    """Stage-3 worker body: complete one slot inside the submitter's copied
-    contextvars (tracer spans land in the submitting duty's trace). Routes
-    through guard.finish_slot so a device-class failure rides the fallback
-    ladder on this worker thread — off the pipeline lock — instead of
-    surfacing as an error at the pop."""
+def _run_emit(ctx, state, inputs, hash_fn):
+    """Stage-3 worker body, emit half: fence + readback + byte emission
+    inside the submitter's copied contextvars (tracer spans land in the
+    submitting duty's trace). Routes through guard.finish_slot_emit so a
+    device-class failure rides the fallback ladder on this worker thread
+    — off the pipeline lock — instead of surfacing as an error at the
+    pop. Returns (aggregates, verify_thunk)."""
     from . import guard
 
     try:
-        return ctx.run(guard.finish_slot, state, inputs, hash_fn)
+        return ctx.run(guard.finish_slot_emit, state, inputs, hash_fn)
     finally:
         _finish_backlog.inc(amount=-1.0)
+
+
+def _run_verify(ctx, out, verify):
+    """Stage-3 worker body, verify half: run the deferred pairing
+    verification thunk (its own chunked device dispatches, with the
+    native rung fallback inside _pairing_finish) and assemble the slot's
+    public (aggregates, ok) result. Scheduled as a separate executor task
+    the moment the emit half completes, so slot N's verify overlaps slot
+    N+1's pack and emit."""
+    try:
+        return out, ctx.run(verify)
+    finally:
+        _verify_backlog.inc(amount=-1.0)
 
 
 def _settle(fut: Future, value=None, exc: BaseException | None = None):
@@ -1017,12 +1058,16 @@ class SigAggPipeline:
 
     Stage 1 (host pack + async dispatch) runs on the submitting thread
     under the pipeline lock; stage 2 (device execute) runs on the device's
-    own queue; stage 3 (fence + readback + pure-host finish) is scheduled
-    onto a small worker executor the moment a slot is dispatched. The
-    finish stage's heavy parts (numpy byte assembly, ctypes ct_hash_to_g2
-    and ct_pairing_check) release the GIL, so slot N's finish genuinely
-    overlaps slot N+1's pack AND the in-flight device execute — throughput
-    approaches max(pack, execute, finish) instead of
+    own queue; stage 3 (fence + readback + emit, then verify) is scheduled
+    onto a small worker executor the moment a slot is dispatched, and is
+    itself split over the _fused_emit seam: the emit half (numpy byte
+    assembly, RLC host folds — GIL-releasing) settles the slot's
+    aggregates and returns a deferred verify thunk, which the pipeline
+    chains onto the executor as its own work unit
+    (guard.finish_slot_emit). Slot N's verify — batched device pairing
+    dispatches on the default-on device path — genuinely overlaps slot
+    N+1's emit AND pack AND the in-flight device execute: throughput
+    approaches max(pack, execute, emit, verify) instead of
     max(pack + finish, execute). The lock NEVER covers a device sync
     (machine-checked by LINT-TPU-007).
 
@@ -1073,14 +1118,54 @@ class SigAggPipeline:
             return len(self._pending)
 
     def _schedule_finish(self, state, inputs, hash_fn) -> Future:
-        # caller holds self._lock; scheduling only — no device sync here
+        # caller holds self._lock; scheduling only — no device sync here.
+        # Stage 3 runs as TWO chained executor tasks: the emit half
+        # (fence + readback + byte emission, guard-laddered) and, the
+        # moment it completes, the verify half (the slot's deferred
+        # pairing dispatch). The public future settles after verify, so
+        # FIFO / error-at-pop / watchdog semantics are unchanged — but
+        # slot N's verify now shares the executor with slot N+1's emit
+        # instead of serializing ahead of it.
         if self._pool is None:
             self._pool = ThreadPoolExecutor(
                 max_workers=self._workers,
                 thread_name_prefix="sigagg-finish")
         _finish_backlog.inc()
         ctx = contextvars.copy_context()
-        return self._pool.submit(_run_finish, ctx, state, inputs, hash_fn)
+        pool = self._pool
+        emit_fut = pool.submit(_run_emit, ctx, state, inputs, hash_fn)
+        out_fut: Future = Future()
+        out_fut.set_running_or_notify_cancel()
+
+        def _copy_verify(src: Future) -> None:
+            exc = src.exception()
+            _settle(out_fut, value=None if exc is not None else src.result(),
+                    exc=exc)
+
+        def _chain(src: Future) -> None:
+            exc = src.exception()
+            if exc is not None:
+                _settle(out_fut, exc=exc)
+                return
+            out, verify = src.result()
+            _verify_backlog.inc()
+            try:
+                vfut = pool.submit(_run_verify, ctx, out, verify)
+            except RuntimeError:
+                # executor already shutting down (close() raced the emit
+                # completion): run the verify inline on this worker so
+                # the in-flight future still resolves
+                try:
+                    res = _run_verify(ctx, out, verify)
+                except BaseException as vexc:  # noqa: BLE001 — boundary
+                    _settle(out_fut, exc=vexc)
+                else:
+                    _settle(out_fut, value=res)
+                return
+            vfut.add_done_callback(_copy_verify)
+
+        emit_fut.add_done_callback(_chain)
+        return out_fut
 
     def _pop_result(self, entry):
         """Consume one pending slot's result, watchdog-bounded: a future
@@ -2020,6 +2105,10 @@ def hash_to_g2_planes(msgs):
     if _verify_device_path():
         from . import h2c as h2c_mod
 
+        # hash_to_g2_device chunks internally at h2c.MAX_BATCH, so a miss
+        # set wider than one tile (the default-on, unbounded-pair regime)
+        # never feeds an oversized batch into the bucketed graph family
+        # (regression-pinned by test_device_verify).
         mx, my = h2c_mod.hash_to_g2_device([k for _, k in missing])
         for j, (i, key) in enumerate(missing):
             planes = (mx[j], my[j])
@@ -2118,8 +2207,7 @@ def _pairing_finish(S, group_points, hash_fn=None) -> bool:
             # all signatures were infinity: valid only if every pk side
             # vanished too
             return not live
-        if (hash_fn is None and len(live) + 1 <= _MAX_DEVICE_PAIRS
-                and _verify_device_path()):
+        if hash_fn is None and _verify_device_path():
             from . import guard
 
             if guard.BREAKER.state != guard.OPEN:
@@ -2136,18 +2224,41 @@ def _pairing_finish(S, group_points, hash_fn=None) -> bool:
         return _native_pairing_finish(S, live, hash_fn)
 
 
-def warm_verify_graphs() -> int:
-    """AOT-compile the device verify graphs (pairing-check buckets + the
-    batch-1 h2c bucket) into the persistent JAX compile cache so the
-    first production slot doesn't eat the trace. No-op (returns 0) when
-    the device verify path is off; callers treat failures as advisory."""
+def warm_verify_graphs(flush_at: int | None = None) -> int:
+    """AOT-compile the device verify graphs a production slot actually
+    hits into the persistent JAX compile cache so the first slot doesn't
+    eat the trace. Buckets are derived from the configured slot shape:
+    `flush_at` defaults to the coalescer's TILE × device-count window, so
+    the warm set covers the small-slot pairing bucket (2: one message
+    group + the signature pair), the largest monolithic bucket a
+    ≤flush_at slot compiles, the chunked family (TILE-lane Miller+fold
+    chunks plus the cross-chunk finish) when flush_at+1 pairs overflow
+    one tile, and the matching h2c miss-set buckets (1 and the capped
+    flush bucket). Returns the number of graphs lowered.
+
+    EXPLICITLY returns 0 without lowering anything when the device verify
+    path is off (CHARON_TPU_DEVICE_VERIFY=0) — callers treat both the 0
+    and any raise as advisory and skip the warm."""
     if not _verify_device_path():
         return 0
     from . import h2c as h2c_mod
+    from . import mesh as mesh_mod
     from . import pairing as pairing_mod
 
-    n = pairing_mod.warm_check_buckets((2,))
-    n += h2c_mod.warm_buckets((1,))
+    if flush_at is None:
+        flush_at = PP.TILE * max(1, mesh_mod.device_count())
+    tile = pairing_mod.MAX_PAIR_TILE
+    pairs = flush_at + 1  # every message distinct + the signature pair
+    buckets = {2, min(tile, pairing_mod._bucket_pairs(pairs))}
+    n = pairing_mod.warm_check_buckets(tuple(sorted(buckets)))
+    if pairs > tile:
+        n_chunks = -(-pairs // tile)
+        n += pairing_mod.warm_chunk_graphs(
+            chunk_buckets=(tile,),
+            finish_buckets=(pairing_mod._bucket_pairs(n_chunks),))
+    h2c_buckets = {1, min(h2c_mod.MAX_BATCH, pairing_mod._bucket_pairs(
+        flush_at))}
+    n += h2c_mod.warm_buckets(tuple(sorted(h2c_buckets)))
     return n
 
 

@@ -366,20 +366,28 @@ def sharded_readback(state, span=None):
 
 
 def sharded_host_finish(hstate, hash_fn=None):
-    """Stage 3 — validity check, per-chunk byte emission and RLC host
-    folds (the "finish" phase), then the slot's pairing verification
-    through PA._pairing_finish (the separately-timed "verify" phase,
-    itself sharded over the mesh via sharded_pairing_check when one is
-    up). The heavy parts release the GIL so the pipeline's stage-3
-    workers overlap them with the next slot's pack and the in-flight
-    execute. bad_pk degrades exactly like the single-device path:
-    aggregates computed, all_valid=False."""
+    """Stage 3, blocking shape: emit half + immediate verify (see
+    sharded_host_emit) — the guard ladder / serial callers' seam."""
+    out, verify = sharded_host_emit(hstate, hash_fn)
+    return out, verify()
+
+
+def sharded_host_emit(hstate, hash_fn=None):
+    """Stage 3, emit half — validity check, per-chunk byte emission and
+    RLC host folds (the "finish" phase). Returns (aggregates,
+    verify_thunk); the thunk runs the slot's pairing verification through
+    PA._pairing_finish (the separately-timed "verify" phase, itself
+    sharded over the mesh via sharded_pairing_check when one is up). The
+    heavy parts release the GIL so the pipeline's stage-3 workers overlap
+    both halves with the next slot's pack and the in-flight execute.
+    bad_pk degrades exactly like the single-device path: aggregates
+    computed, all_valid=False."""
     if hstate[0] == "sharded_empty":
-        return [], True
+        return [], lambda: True
     if hstate[0] == "sharded_bad_pk":
         layout = PA._layout_slots(hstate[1])
         RX, RY, RZ, V, Vp = PA._aggregate_plane(None, layout)
-        return PA._serialize_aggregates(RX, RY, RZ, V), False
+        return PA._serialize_aggregates(RX, RY, RZ, V), lambda: False
     _tag, V, D, Vd, group_keys, host_shards, host_reds = hstate
     with PA._dispatch_hist.observe_time("finish"):
         ok, pok, xs, sign, inf = host_shards
@@ -398,7 +406,7 @@ def sharded_host_finish(hstate, hash_fn=None):
                for g, m in enumerate(group_keys)]
     # _pairing_finish times itself as the "verify" phase — kept out of the
     # "finish" window so the two stay separately attributable
-    return out, PA._pairing_finish(S, pts, hash_fn)
+    return out, lambda: PA._pairing_finish(S, pts, hash_fn)
 
 
 def threshold_aggregate_and_verify_sharded(
@@ -463,13 +471,91 @@ def _build_verify_step(mesh, Bd: int):
     ))
 
 
+@functools.lru_cache(maxsize=8)
+def _build_miller_fold_step(mesh, Bd: int):
+    """Chunked-verify analogue of _build_verify_step: per-device Miller
+    loops + local fold, all_gather, in-graph cross-device fold — but NO
+    final exponentiation. Returns the chunk's replicated Fq12 product so
+    a >TILE-per-device pair set folds across chunks before the single
+    final exp (pairing.fold_chunks_is_one)."""
+    try:
+        from jax import shard_map
+    except ImportError:
+        from jax.experimental.shard_map import shard_map as _exp_shard_map
+
+        def shard_map(f, *, mesh, in_specs, out_specs, check_vma=True):
+            return _exp_shard_map(f, mesh=mesh, in_specs=in_specs,
+                                  out_specs=out_specs, check_rep=check_vma)
+    from jax.sharding import PartitionSpec as P
+
+    from . import pairing as pairing_mod
+    from . import tower as TW
+
+    D = mesh.devices.size
+
+    def _local_fold(p_x, p_y, q_x, q_y, mask):
+        f = pairing_mod.miller_loop_pairs([(p_x, p_y)], [(q_x, q_y)])
+        f = pairing_mod._select_fq12(mask, f, TW.fq12_one_like(q_x))
+        f = pairing_mod._fq12_fold_product(f, Bd)
+        g = jax.lax.all_gather(f, "data")
+        parts = [(tuple(c[d] for c in g[0]), tuple(c[d] for c in g[1]))
+                 for d in range(D)]
+        while len(parts) > 1:
+            nxt = [TW.fq12_mul(parts[k], parts[k + 1])
+                   for k in range(0, len(parts) - 1, 2)]
+            if len(parts) % 2:
+                nxt.append(parts[-1])
+            parts = nxt
+        return parts[0]
+
+    return jax.jit(shard_map(
+        _local_fold, mesh=mesh,
+        in_specs=(P("data"),) * 5,
+        out_specs=P(),
+        check_vma=False,
+    ))
+
+
+def _sharded_check_chunked(p_x, p_y, q_x, q_y, mesh) -> bool:
+    """Pair sets too wide for one sharded dispatch (per-device bucket
+    would exceed MAX_PAIR_TILE): successive D·TILE-pair sharded chunk
+    dispatches, folded cross-chunk through the single-final-exp finish
+    graph — the mesh analogue of pairing._pairing_check_chunked."""
+    from . import pairing as pairing_mod
+
+    n = p_x.shape[0]
+    D = mesh.devices.size
+    span = D * pairing_mod.MAX_PAIR_TILE
+    arrs = tuple(np.asarray(a) for a in (p_x, p_y, q_x, q_y))
+    parts = []
+    for s in range(0, n, span):
+        chunk = tuple(a[s:s + span] for a in arrs)
+        m = chunk[0].shape[0]
+        Bd = pairing_mod._bucket_pairs(-(-m // D))
+        total = D * Bd
+
+        def pad(a, total=total, m=m):
+            if total == m:
+                return jnp.asarray(a)
+            return jnp.asarray(
+                np.concatenate([a, np.repeat(a[:1], total - m, axis=0)]))
+
+        mask = np.zeros(total, dtype=bool)
+        mask[:m] = True
+        parts.append(_build_miller_fold_step(mesh, Bd)(
+            *(pad(a) for a in chunk), jnp.asarray(mask)))
+    return pairing_mod.fold_chunks_is_one(parts)
+
+
 def sharded_pairing_check(p_x, p_y, q_x, q_y, mesh) -> bool:
     """Π e(Pᵢ, Qᵢ) == 1 with the pair axis sharded over mesh axis "data"
     — the mesh-wide analogue of pairing.pairing_check_planes (same plane
     layout, same masked lane-0 padding, same verdict). Pads the pair axis
     to D · Bd so every device gets an equal power-of-two bucket; for a
     typical slot (a handful of messages) each device Miller-loops two
-    lanes and the collective moves one Fq12 per chip."""
+    lanes and the collective moves one Fq12 per chip. When the per-device
+    bucket would exceed MAX_PAIR_TILE the check runs chunked
+    (_sharded_check_chunked) with a bit-identical verdict."""
     from . import pairing as pairing_mod
 
     n = p_x.shape[0]
@@ -477,6 +563,8 @@ def sharded_pairing_check(p_x, p_y, q_x, q_y, mesh) -> bool:
         return True
     D = mesh.devices.size
     Bd = pairing_mod._bucket_pairs(-(-n // D))
+    if Bd > pairing_mod.MAX_PAIR_TILE:
+        return _sharded_check_chunked(p_x, p_y, q_x, q_y, mesh)
     total = D * Bd
 
     def pad(a):

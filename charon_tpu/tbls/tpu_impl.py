@@ -210,7 +210,10 @@ class TPUImpl(NativeImpl):
         pack+dispatch — a CONCURRENT call (the coalescer's executor
         threads on back-to-back flushes) packs its buffers while this
         slot's fused graph executes on device, instead of serializing
-        pack→dispatch→wait end to end."""
+        pack→dispatch→wait end to end. Rides submit_async so the slot's
+        finish runs as the pipeline's chained emit→verify stage-3 tasks:
+        this slot's verify dispatch overlaps the next caller's pack
+        instead of blocking it out on the calling thread."""
         n = len(batches)
         if not (n == len(public_keys) == len(datas)):
             raise ValueError("length mismatch")
@@ -223,9 +226,10 @@ class TPUImpl(NativeImpl):
             if not b:
                 raise ValueError("no partial signatures to aggregate")
         try:
-            raw, ok = _shared_pipeline().aggregate_verify(
+            raw, ok = _shared_pipeline().submit_async(
                 [{i: bytes(s) for i, s in b.items()} for b in batches],
-                [bytes(pk) for pk in public_keys], [bytes(d) for d in datas])
+                [bytes(pk) for pk in public_keys],
+                [bytes(d) for d in datas]).result()
         except _DEVICE_RUNTIME_ERRORS as exc:
             if not self.fallback_on_device_error:
                 raise

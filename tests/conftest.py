@@ -13,6 +13,12 @@ import os
 # cost ~1 min to build the first time; cached across test runs.
 os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/root/repo/.jax_cache")
 
+# Device verify is default-ON in production (plane_agg._verify_device_path);
+# on the CPU CI mesh the pairing/h2c verify graphs take minutes to compile,
+# so pin it off here. Tests that exercise the device path opt back in with
+# monkeypatch.setenv("CHARON_TPU_DEVICE_VERIFY", "1").
+os.environ.setdefault("CHARON_TPU_DEVICE_VERIFY", "0")
+
 xla_flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in xla_flags:
     os.environ["XLA_FLAGS"] = (

@@ -69,6 +69,43 @@ def test_verify_device_path_env_override(monkeypatch):
     assert PA._verify_device_path() is False
 
 
+def test_verify_device_path_defaults_on(monkeypatch):
+    """With the env knob unset the device verify path is ON — interpret
+    mode included; there is no pair-count ceiling anymore (chunking took
+    its place). CPU CI only stays native because tests/conftest.py pins
+    CHARON_TPU_DEVICE_VERIFY=0."""
+    monkeypatch.delenv("CHARON_TPU_DEVICE_VERIFY", raising=False)
+    assert PA._verify_device_path() is True
+    assert not hasattr(PA, "_MAX_DEVICE_PAIRS"), \
+        "the pair-count ceiling must be gone, not just unused"
+
+
+def test_hash_to_g2_device_chunks_oversized_batches(monkeypatch):
+    """hash_to_g2_device splits a >MAX_BATCH miss set into MAX_BATCH-sized
+    dispatches and reassembles rows in order — the miss-path contract the
+    unbounded default-on verify relies on (hash_to_g2_planes feeds it the
+    whole miss set of an arbitrarily wide slot)."""
+    from charon_tpu.ops import h2c
+
+    monkeypatch.setattr(h2c, "MAX_BATCH", 2)
+    seen = []
+
+    def fake_map(u0, u1, s0, s1):
+        assert u0.shape[0] <= 2, "chunk exceeded MAX_BATCH"
+        seen.append(u0.shape[0])
+        B = u0.shape[0]
+        return (np.full((B, 2, DF.LIMBS), len(seen), np.int32),
+                np.full((B, 2, DF.LIMBS), -len(seen), np.int32))
+
+    monkeypatch.setattr(h2c, "map_to_g2_device", fake_map)
+    msgs = [f"miss-{i}".encode() for i in range(5)]
+    hx, hy = h2c.hash_to_g2_device(msgs)
+    assert hx.shape == (5, 2, DF.LIMBS)
+    assert seen == [2, 2, 1]
+    assert (hx[:2] == 1).all() and (hx[2:4] == 2).all() and (hx[4:] == 3).all()
+    assert (hy[:2] == -1).all() and (hy[4:] == -3).all()
+
+
 def test_pairing_finish_device_rung_and_counter(clean_verify_state,
                                                 monkeypatch):
     msg = b"route-device"
@@ -355,6 +392,75 @@ def test_device_native_verdict_oracle(monkeypatch):
 
 
 @slow_pairing
+def test_chunked_slot_verifies_default_on(monkeypatch):
+    """A 4×TILE-pair slot (tile patched to 2 so the real kernels stay
+    CPU-tractable) verifies ON DEVICE with CHARON_TPU_DEVICE_VERIFY
+    *unset* — default-on, no pair ceiling. Every chunk graph compiles at
+    ≤ TILE lanes, the verdict is bit-identical to the native rung, a
+    tamper living in the LAST chunk (the signature pair) flips it, and
+    all pairs land on ops_pairing_total{path="device"} with zero native
+    residual."""
+    from charon_tpu.ops import mesh as mesh_mod
+    from charon_tpu.ops import pairing
+
+    guard.reset_for_testing()
+    monkeypatch.delenv("CHARON_TPU_DEVICE_VERIFY", raising=False)
+    assert PA._verify_device_path() is True
+    monkeypatch.setattr(mesh_mod, "sigagg_mesh", lambda: None)
+    tile = 2
+    monkeypatch.setattr(pairing, "MAX_PAIR_TILE", tile)
+    seen_chunks, seen_finish = [], []
+    orig_fold = pairing._compiled_miller_fold
+    orig_fin = pairing._compiled_chunk_finish
+    monkeypatch.setattr(pairing, "_compiled_miller_fold",
+                        lambda b: seen_chunks.append(b) or orig_fold(b))
+    monkeypatch.setattr(pairing, "_compiled_chunk_finish",
+                        lambda k: seen_finish.append(k) or orig_fin(k))
+
+    msgs = [f"chunked-{i}".encode() for i in range(4 * tile - 1)]
+    S = jac_infinity(Fq2Ops)
+    pts = []
+    for i, m in enumerate(msgs):
+        k, pk = _keypair(60 + i)
+        S = PC.jac_add(Fq2Ops, S,
+                       PC.jac_mul(Fq2Ops, hash_to_g2(m, DST_ETH), k))
+        pts.append((m, pk))
+
+    dev0 = PA._pairing_c.value("device")
+    nat0 = PA._pairing_c.value("native")
+    assert PA._pairing_finish(S, pts) is True
+    assert seen_chunks and max(seen_chunks) <= tile, \
+        "chunk graphs must stay ≤ TILE lanes"
+    assert seen_finish == [4], "8 pairs / tile 2 -> one 4-chunk finish"
+    assert PA._pairing_c.value("device") == dev0 + len(msgs) + 1
+    assert PA._pairing_c.value("native") == nat0, "zero native residual"
+
+    # a tamper whose effect lives in the LAST chunk is caught, and the
+    # native rung agrees bit-for-bit on both slots
+    bad = PC.jac_mul(Fq2Ops, S, 3)
+    assert PA._pairing_finish(bad, pts) is False
+    monkeypatch.setenv("CHARON_TPU_DEVICE_VERIFY", "0")
+    assert PA._pairing_finish(S, pts) is True
+    assert PA._pairing_finish(bad, pts) is False
+
+
+@slow_pairing
 def test_warm_verify_graphs_counts(monkeypatch):
+    from charon_tpu.ops import pairing
+
     monkeypatch.setenv("CHARON_TPU_DEVICE_VERIFY", "1")
-    assert PA.warm_verify_graphs() == 2  # one pairing bucket + one h2c
+    # Tile and h2c batch patched to 2 (warm reads both module globals at
+    # call time) so the graphs lowered are CPU-tractable — bucket
+    # DERIVATION is what's under test; real-TILE shapes compile the same
+    # graph structure at wider lanes.
+    from charon_tpu.ops import h2c
+
+    monkeypatch.setattr(pairing, "MAX_PAIR_TILE", 2)
+    monkeypatch.setattr(h2c, "MAX_BATCH", 2)
+    # ≤ one tile (flush_at=1 → 2 pairs): the small pairing bucket (2,
+    # the monolithic slot bucket collapses into it) + h2c buckets {1, 2}
+    assert PA.warm_verify_graphs(flush_at=1) == 3
+    # > one tile (flush_at=4×tile → 9 pairs): capped check bucket (2)
+    # + the tile-lane Miller+fold chunk graph + the cross-chunk finish at
+    # the chunk-count bucket (ceil(9/2)=5 → 8) + h2c buckets {1, 2}
+    assert PA.warm_verify_graphs(flush_at=4 * pairing.MAX_PAIR_TILE) == 5

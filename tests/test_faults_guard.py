@@ -329,10 +329,19 @@ class TestBreaker:
 
 
 def _stub_stages(monkeypatch, finish):
+    """`finish` keeps the blocking (aggregates, ok) shape; the pipeline
+    rides the emit/verify split, so mirror it onto _fused_emit with the
+    verdict deferred into the verify thunk."""
     monkeypatch.setattr(plane_agg, "_layout_slots", lambda b: b)
     monkeypatch.setattr(plane_agg, "_fused_dispatch",
                         lambda layout, p, m: ("pending", layout))
     monkeypatch.setattr(plane_agg, "_fused_finish", finish)
+
+    def emit(state, hash_fn=None):
+        out, ok = finish(state, hash_fn)
+        return out, lambda: ok
+
+    monkeypatch.setattr(plane_agg, "_fused_emit", emit)
 
 
 class TestWatchdog:

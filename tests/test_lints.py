@@ -744,7 +744,7 @@ def test_self_check_whole_tree_against_baseline():
         cwd=REPO_ROOT, capture_output=True, text=True, timeout=300)
     report = json.loads(proc.stdout)
     assert report["version"] == 2
-    assert report["rules_version"] == 9
+    assert report["rules_version"] == 10
     new = [f for f in report["findings"] if f["new"]]
     assert proc.returncode == 0 and new == [], \
         "new lint findings:\n" + "\n".join(
@@ -918,5 +918,51 @@ def test_pairing_rule_ignores_other_natives_and_dirs(tmp_path):
     findings = lint_source(tmp_path, "crypto/x.py", """\
         def host_check(lib, g1, g2, negs):
             return lib.ct_pairing_check(g1, g2, negs, len(negs), 0) == 1
+    """)
+    assert findings == []
+
+# ---------------------------------------------------------------------------
+# LINT-TPU-016 — Pallas field entry points stay behind the curve._mont_mul seam
+# ---------------------------------------------------------------------------
+
+
+def test_field_plane_rule_flags_stray_pallas_calls(tmp_path):
+    findings = lint_source(tmp_path, "ops/x.py", """\
+        from . import pallas_plane as PP
+
+        def line_eval(a, b):
+            return PP.mont_mul_rows(a, b)
+
+        def bare(a, b, mont_mul_rows):
+            return mont_mul_rows(a, b)
+    """)
+    assert rules_of(findings) == ["LINT-TPU-016"] * 2
+    assert "curve._mont_mul seam" in findings[0].message
+    assert "CHARON_TPU_FIELD_PLANE" in findings[0].message
+
+
+def test_field_plane_rule_sanctions_the_mont_mul_seam(tmp_path):
+    findings = lint_source(tmp_path, "ops/x.py", """\
+        from . import pallas_plane as PP
+
+        def _mont_mul(a, b):
+            if PP.field_plane() == "pallas":
+                return PP.mont_mul_rows(a, b)
+            return F.fq_mont_mul(a, b)
+    """)
+    assert findings == []
+
+
+def test_field_plane_rule_ignores_pallas_plane_and_other_dirs(tmp_path):
+    # the defining module may reference its own entry points freely
+    findings = lint_source(tmp_path, "ops/pallas_plane.py", """\
+        def selftest(a, b):
+            return mont_mul_rows(a, b)
+    """)
+    assert findings == []
+    # and the rule only scopes to ops/
+    findings = lint_source(tmp_path, "bench/x.py", """\
+        def probe(PP, a, b):
+            return PP.mont_mul_rows(a, b)
     """)
     assert findings == []

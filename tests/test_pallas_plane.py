@@ -342,3 +342,76 @@ class TestMosaicBodiesInterpret:
         wa = PP._add_call(X, Y, Z, X2, Y2, Z2, 2)
         for g, w in zip(ga, wa):
             assert np.array_equal(np.asarray(g), np.asarray(w))
+
+
+class TestFieldPlaneSeam:
+    """CHARON_TPU_FIELD_PLANE routes curve._mont_mul (the LINT-TPU-016 seam)
+    between the XLA scan CIOS and the in-kernel Mosaic CIOS body. The Pallas
+    rows path must be bit-identical to F.fq_mont_mul — same limbs, same
+    Montgomery form — so flipping the plane never changes a signature."""
+
+    def test_field_plane_flag_parsing(self, monkeypatch):
+        monkeypatch.delenv("CHARON_TPU_FIELD_PLANE", raising=False)
+        assert PP.field_plane() == "xla"
+        monkeypatch.setenv("CHARON_TPU_FIELD_PLANE", "xla")
+        assert PP.field_plane() == "xla"
+        monkeypatch.setenv("CHARON_TPU_FIELD_PLANE", " Pallas ")
+        assert PP.field_plane() == "pallas"
+        monkeypatch.setenv("CHARON_TPU_FIELD_PLANE", "mxu")
+        with pytest.raises(ValueError, match="CHARON_TPU_FIELD_PLANE"):
+            PP.field_plane()
+
+    @pytest.mark.nightly
+    def test_mont_mul_rows_bit_identical(self):
+        # 5 rows: forces the SUB-pad branch (n8=8, W=1) plus boundary values.
+        rng = random.Random(61)
+        ints_a = [0, 1, F.P_INT - 1] + [rng.randrange(F.P_INT)
+                                        for _ in range(2)]
+        ints_b = [F.P_INT - 1, 0, 1] + [rng.randrange(F.P_INT)
+                                        for _ in range(2)]
+        ja = jnp.asarray(np.stack([F.fq_from_int(x) for x in ints_a]))
+        jb = jnp.asarray(np.stack([F.fq_from_int(x) for x in ints_b]))
+        got = np.asarray(PP.mont_mul_rows(ja, jb))
+        want = np.asarray(F.fq_mont_mul(ja, jb))
+        assert np.array_equal(got, want)
+        # higher-rank rows flatten/reshape through the same kernel plane
+        ja3 = jnp.reshape(jnp.concatenate([ja, jb]), (2, 5, F.LIMBS))
+        jb3 = jnp.reshape(jnp.concatenate([jb, ja]), (2, 5, F.LIMBS))
+        assert np.array_equal(np.asarray(PP.mont_mul_rows(ja3, jb3)),
+                              np.asarray(F.fq_mont_mul(ja3, jb3)))
+
+    @pytest.mark.nightly
+    def test_curve_seam_routes_and_matches(self, monkeypatch):
+        from charon_tpu.ops import curve as DC
+
+        rng = random.Random(62)
+        n = 5
+        fa = jnp.asarray(np.stack(
+            [F.fq_from_int(rng.randrange(F.P_INT)) for _ in range(n)]))
+        fb = jnp.asarray(np.stack(
+            [F.fq_from_int(rng.randrange(F.P_INT)) for _ in range(n)]))
+        f2a = jnp.asarray(np.stack(
+            [F.fq2_from_ints(rng.randrange(F.P_INT), rng.randrange(F.P_INT))
+             for _ in range(n)]))
+        f2b = jnp.asarray(np.stack(
+            [F.fq2_from_ints(rng.randrange(F.P_INT), rng.randrange(F.P_INT))
+             for _ in range(n)]))
+
+        monkeypatch.delenv("CHARON_TPU_FIELD_PLANE", raising=False)
+        want1 = DC._fq_mul_many([(fa, fb), (fb, fa)])
+        want2 = DC._fq2_mul_many([(f2a, f2b)])
+
+        calls = []
+        real_rows = PP.mont_mul_rows
+        monkeypatch.setattr(
+            PP, "mont_mul_rows",
+            lambda a, b: calls.append(a.shape) or real_rows(a, b))
+        monkeypatch.setenv("CHARON_TPU_FIELD_PLANE", "pallas")
+        got1 = DC._fq_mul_many([(fa, fb), (fb, fa)])
+        got2 = DC._fq2_mul_many([(f2a, f2b)])
+
+        # every stacked product actually took the Pallas plane…
+        assert len(calls) == 2
+        # …and the limbs are bit-identical to the XLA scan
+        for g, w in zip(got1 + got2, want1 + want2):
+            assert np.array_equal(np.asarray(g), np.asarray(w))

@@ -216,8 +216,8 @@ def test_sigagg_pipeline_keeps_depth_slots_in_flight(monkeypatch):
     """submit() packs+dispatches immediately, schedules the stage-3 finish
     asynchronously, and only RETURNS results once more than `depth` slots
     are in flight (oldest first); drain() finishes the rest FIFO.
-    Dispatch/finish are stubbed — the pipelining contract is pure
-    bookkeeping over the _fused_dispatch/_fused_finish split."""
+    Dispatch/emit are stubbed — the pipelining contract is pure
+    bookkeeping over the _fused_dispatch/_fused_emit split."""
     dispatched, finished = [], []
     monkeypatch.setattr(plane_agg, "_layout_slots", lambda batches: batches)
     monkeypatch.setattr(
@@ -225,8 +225,9 @@ def test_sigagg_pipeline_keeps_depth_slots_in_flight(monkeypatch):
         lambda layout, pks, msgs: dispatched.append(layout) or
         ("pending", layout))
     monkeypatch.setattr(
-        plane_agg, "_fused_finish",
-        lambda state, hash_fn=None: finished.append(state[1]) or state[1])
+        plane_agg, "_fused_emit",
+        lambda state, hash_fn=None: (finished.append(state[1]) or state[1],
+                                     lambda: True))
 
     pipe = plane_agg.SigAggPipeline(depth=2)
     try:
@@ -234,8 +235,9 @@ def test_sigagg_pipeline_keeps_depth_slots_in_flight(monkeypatch):
         assert pipe.submit("slot1", [], []) == []
         assert dispatched == ["slot0", "slot1"], \
             "both slots must dispatch before any submit returns a result"
-        assert pipe.submit("slot2", [], []) == ["slot0"]  # oldest completes
-        assert pipe.drain() == ["slot1", "slot2"]
+        # oldest completes first
+        assert pipe.submit("slot2", [], []) == [("slot0", True)]
+        assert pipe.drain() == [("slot1", True), ("slot2", True)]
         # the async finish stage completes every slot exactly once (worker
         # interleaving makes completion order nondeterministic; RESULT
         # order above is the FIFO guarantee)
@@ -256,8 +258,9 @@ def test_sigagg_pipeline_finish_runs_without_consumer(monkeypatch):
     monkeypatch.setattr(plane_agg, "_fused_dispatch",
                         lambda layout, pks, msgs: ("pending", layout))
     monkeypatch.setattr(
-        plane_agg, "_fused_finish",
-        lambda state, hash_fn=None: finished.append(state[1]) or state[1])
+        plane_agg, "_fused_emit",
+        lambda state, hash_fn=None: (finished.append(state[1]) or state[1],
+                                     lambda: True))
 
     pipe = plane_agg.SigAggPipeline(depth=4, finish_workers=1)
     try:
@@ -268,7 +271,7 @@ def test_sigagg_pipeline_finish_runs_without_consumer(monkeypatch):
             time.sleep(0.005)
         assert finished == ["slot0", "slot1"], \
             "stage-3 finish must run without a consumer popping the slot"
-        assert pipe.drain() == ["slot0", "slot1"]
+        assert pipe.drain() == [("slot0", True), ("slot1", True)]
     finally:
         pipe.close()
 

@@ -51,11 +51,25 @@ needs_native = pytest.mark.skipif(
 
 def _stub_stages(monkeypatch, finish):
     """Replace the device halves with bookkeeping stubs; the pipeline
-    contract under test is pure scheduling over the split."""
+    contract under test is pure scheduling over the emit+verify split.
+    `finish` keeps the blocking _fused_finish signature and runs in the
+    EMIT phase (where the gates/exceptions of the real byte-emission half
+    live); a 2-tuple return is split into (aggregates, deferred verdict),
+    anything else gets a trivially-true verify thunk — so the pipeline
+    assembles (out, ok) exactly like the production seam."""
     monkeypatch.setattr(plane_agg, "_layout_slots", lambda batches: batches)
     monkeypatch.setattr(plane_agg, "_fused_dispatch",
                         lambda layout, pks, msgs: ("pending", layout))
     monkeypatch.setattr(plane_agg, "_fused_finish", finish)
+
+    def emit(state, hash_fn=None):
+        res = finish(state, hash_fn)
+        if isinstance(res, tuple) and len(res) == 2:
+            out, ok = res
+            return out, lambda: ok
+        return res, lambda: True
+
+    monkeypatch.setattr(plane_agg, "_fused_emit", emit)
 
 
 def test_submit_results_fifo_despite_out_of_order_finish(monkeypatch):
@@ -74,9 +88,9 @@ def test_submit_results_fifo_despite_out_of_order_finish(monkeypatch):
     pipe = plane_agg.SigAggPipeline(depth=1, finish_workers=2)
     try:
         assert pipe.submit("slot0", [], []) == []
-        assert pipe.submit("slot1", [], []) == ["slot0"]
-        assert pipe.submit("slot2", [], []) == ["slot1"]
-        assert pipe.drain() == ["slot2"]
+        assert pipe.submit("slot1", [], []) == [("slot0", True)]
+        assert pipe.submit("slot2", [], []) == [("slot1", True)]
+        assert pipe.drain() == [("slot2", True)]
         assert sorted(completed) == ["slot0", "slot1", "slot2"]
     finally:
         pipe.close()
@@ -101,6 +115,9 @@ def test_slow_finish_does_not_block_next_submit(monkeypatch):
         return state[1]
 
     monkeypatch.setattr(plane_agg, "_fused_finish", gated)
+    monkeypatch.setattr(plane_agg, "_fused_emit",
+                        lambda state, hash_fn=None:
+                        (gated(state, hash_fn), lambda: True))
     pipe = plane_agg.SigAggPipeline(depth=2, finish_workers=2)
     try:
         assert pipe.submit("slot0", [], []) == []
@@ -109,7 +126,7 @@ def test_slow_finish_does_not_block_next_submit(monkeypatch):
         assert dispatched == ["slot0", "slot1"], \
             "slot1 must dispatch while slot0's finish is still blocked"
         release.set()
-        assert pipe.drain() == ["slot0", "slot1"]
+        assert pipe.drain() == [("slot0", True), ("slot1", True)]
     finally:
         release.set()
         pipe.close()
@@ -131,7 +148,8 @@ def test_invalid_signature_reraises_at_pop_and_drain(monkeypatch):
         assert pipe.submit("bad0", [], []) == []
         with pytest.raises(ValueError, match="bad0"):
             pipe.submit("ok1", [], [])  # the pop of bad0 re-raises
-        assert pipe.drain() == ["ok1"], "ok slot survives a bad neighbor"
+        assert pipe.drain() == [("ok1", True)], \
+            "ok slot survives a bad neighbor"
         assert pipe.submit("bad2", [], []) == []
         with pytest.raises(ValueError, match="bad2"):
             pipe.drain()
@@ -214,10 +232,54 @@ def test_finish_backlog_gauge_tracks_in_flight(monkeypatch):
             assert pipe.submit(f"slot{i}", [], []) == []
         assert plane_agg._finish_backlog.value() == base + 3
         release.set()
-        assert pipe.drain() == ["slot0", "slot1", "slot2"]
+        assert pipe.drain() == [("slot0", True), ("slot1", True),
+                                ("slot2", True)]
         assert plane_agg._finish_backlog.value() == base
     finally:
         release.set()
+        pipe.close()
+
+
+def test_verify_overlaps_next_slot_emit(monkeypatch):
+    """The emit/verify split's payoff: slot0's deferred verify (provably
+    still running, gated on an Event) must not block slot1's emit half —
+    with two workers the NEXT slot's emit completes while the previous
+    slot's verify dispatch is in flight, and ops_sigagg_verify_backlog
+    tracks the deferred phase until it drains."""
+    v_started, v_release = threading.Event(), threading.Event()
+    emitted = []
+
+    def emit(state, hash_fn=None):
+        emitted.append(state[1])
+        if state[1] == "slot0":
+            def verify():
+                v_started.set()
+                assert v_release.wait(10), "verify gate never released"
+                return True
+            return state[1], verify
+        return state[1], lambda: True
+
+    monkeypatch.setattr(plane_agg, "_layout_slots", lambda batches: batches)
+    monkeypatch.setattr(plane_agg, "_fused_dispatch",
+                        lambda layout, pks, msgs: ("pending", layout))
+    monkeypatch.setattr(plane_agg, "_fused_emit", emit)
+    vbase = plane_agg._verify_backlog.value()
+    pipe = plane_agg.SigAggPipeline(depth=2, finish_workers=2)
+    try:
+        assert pipe.submit("slot0", [], []) == []
+        assert v_started.wait(5), "slot0 verify never scheduled"
+        assert pipe.submit("slot1", [], []) == []  # no pop at depth=2
+        deadline = time.monotonic() + 5
+        while "slot1" not in emitted and time.monotonic() < deadline:
+            time.sleep(0.005)
+        assert emitted == ["slot0", "slot1"], \
+            "slot1's emit must complete while slot0's verify is blocked"
+        assert plane_agg._verify_backlog.value() >= vbase + 1
+        v_release.set()
+        assert pipe.drain() == [("slot0", True), ("slot1", True)]
+        assert plane_agg._verify_backlog.value() == vbase
+    finally:
+        v_release.set()
         pipe.close()
 
 
