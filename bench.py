@@ -196,16 +196,24 @@ def _gen_cluster(native):
 def _warm_and_median3(tpu, batches, pubkeys, datas):
     """Warm once, then median-of-3 timed fused dispatches — THE fixed-shape
     probe definition (change it here and both 'bench' and 'micro' records
-    move together)."""
-    tpu.threshold_aggregate_verify_batch(batches, pubkeys, datas)  # warm
+    move together). The timed runs execute inside sentinel.steady_state():
+    any compile there counts as a steady recompile (the `compiles` JSON
+    tail must report steady == 0 on a warm cache) and an implicit
+    host->device transfer raises."""
+    from charon_tpu.ops import sentinel
+
+    sentinel.install()
+    with sentinel.region("warm"):
+        tpu.threshold_aggregate_verify_batch(batches, pubkeys, datas)  # warm
     times = []
     aggs = None
-    for _ in range(3):  # median of 3: the remote-tunnel jitter is ±20%
-        t0 = time.time()
-        aggs, ok = tpu.threshold_aggregate_verify_batch(
-            batches, pubkeys, datas)
-        times.append(time.time() - t0)
-        assert ok, "device verification failed on valid aggregates"
+    with sentinel.steady_state(), sentinel.region("slot"):
+        for _ in range(3):  # median of 3: the remote-tunnel jitter is ±20%
+            t0 = time.time()
+            aggs, ok = tpu.threshold_aggregate_verify_batch(
+                batches, pubkeys, datas)
+            times.append(time.time() - t0)
+            assert ok, "device verification failed on valid aggregates"
     return sorted(times)[1], times, aggs
 
 
@@ -280,7 +288,10 @@ def _measure(cpu_only: bool) -> None:
     pk_bytes = [bytes(pk) for pk in pubkeys]
     K = 6
     base = STORE.stats()  # counters before the timed slots (cache is warm)
-    pipe = plane_agg.SigAggPipeline()
+    # steady_after=1: the warm pass above compiled every graph this shape
+    # touches, so the pipeline declares steady after its first dispatched
+    # slot — a compile in slots 2..K is a counted steady recompile.
+    pipe = plane_agg.SigAggPipeline(steady_after=1)
     t0 = time.time()
     done = []
     for _ in range(K):
@@ -332,7 +343,16 @@ def _measure(cpu_only: bool) -> None:
         "shard_phases": _phase_quantiles("ops_sigagg_shard_seconds"),
         # verify-path split: device lanes vs the native ctypes rung
         "pairing_paths": _pairing_paths(),
+        # compile sentinel: steady must be 0 on a warm cache (a steady
+        # recompile would eat minutes of the 12s slot on a real TPU)
+        "compiles": _compiles_tail(),
     }))
+
+
+def _compiles_tail() -> dict:
+    from charon_tpu.ops import sentinel
+
+    return sentinel.compiles_summary()
 
 
 def _micro() -> None:
@@ -363,6 +383,7 @@ def _micro() -> None:
         "n_devices": mesh_mod.device_count(),
         "shard_phases": _phase_quantiles("ops_sigagg_shard_seconds"),
         "pairing_paths": _pairing_paths(),
+        "compiles": _compiles_tail(),
     }))
 
 

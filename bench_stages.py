@@ -86,7 +86,9 @@ def main() -> None:
     from charon_tpu.tbls.native_impl import NativeImpl
     from charon_tpu.ops import pallas_plane as PP
     from charon_tpu.ops import plane_agg as PA
+    from charon_tpu.ops import sentinel
 
+    sentinel.install()
     try:
         warmed = PA.warm_verify_graphs()
         if warmed:
@@ -111,17 +113,19 @@ def main() -> None:
     tick("setup", t0)
 
     # warm every compile at the production shapes
-    aggs = PA.threshold_aggregate_batch(batches)
-    assert PA.rlc_verify_batch(pubkeys, [msg] * N, aggs)
+    with sentinel.region("warm"):
+        aggs = PA.threshold_aggregate_batch(batches)
+        assert PA.rlc_verify_batch(pubkeys, [msg] * N, aggs)
 
-    # ---- the production single-dispatch fused slot ------------------------
-    datas = [msg] * N
-    PA.threshold_aggregate_and_verify(batches, pubkeys, datas)  # warm
-    t0 = time.time()
-    _aggs_f, ok_f = PA.threshold_aggregate_and_verify(batches, pubkeys,
-                                                      datas)
-    stages["fused.slot"] = tick("fused.slot (ONE dispatch + ONE transfer)",
-                                t0)
+        # ---- the production single-dispatch fused slot --------------------
+        datas = [msg] * N
+        PA.threshold_aggregate_and_verify(batches, pubkeys, datas)  # warm
+    with sentinel.steady_state(), sentinel.region("slot"):
+        t0 = time.time()
+        _aggs_f, ok_f = PA.threshold_aggregate_and_verify(batches, pubkeys,
+                                                          datas)
+        stages["fused.slot"] = tick(
+            "fused.slot (ONE dispatch + ONE transfer)", t0)
     assert ok_f
 
     # ---- pipelined steady state: slot N's verify overlaps slot N+1's
@@ -129,7 +133,11 @@ def main() -> None:
     # steady per-slot time approaches max(phase), not the phase sum. The
     # per-phase p50/p99 (including the "verify" phase, one sample per
     # slot) lands in the "phases" JSON key below.
-    pipe = PA.SigAggPipeline()
+    # steady_after=1: everything this shape compiles is already warm, so a
+    # compile in slots 2..N is a counted steady recompile. close() disarms
+    # the window BEFORE the deliberately different-shaped micro-stage
+    # dispatches below — those are probes, not the steady state.
+    pipe = PA.SigAggPipeline(steady_after=1)
     pipe_slots = 6
     results = []
     t0 = time.time()
@@ -141,6 +149,7 @@ def main() -> None:
     tick(f"pipe.slot_steady ({pipe_slots} slots, verify overlapped, "
          f"{dt / pipe_slots:.3f}s/slot)", t0)
     assert len(results) == pipe_slots and all(ok for _, ok in results)
+    pipe.close()
 
     # ---- aggregate: end-to-end, then each internal dispatch ---------------
     t0 = time.time()
@@ -281,6 +290,9 @@ def main() -> None:
         # an intentional native probe and counts toward neither)
         "pairing_paths": {"device": PA._pairing_c.value("device"),
                           "native": PA._pairing_c.value("native")},
+        # compile sentinel: compiles inside the steady windows (the timed
+        # fused slot + pipelined slots 2..N) must be 0 on a warm cache
+        "compiles": sentinel.compiles_summary(),
         "trace_file": trace_path,
         "throughput": round(N / (stages["agg.total"] + stages["ver.total"]),
                             1)}))

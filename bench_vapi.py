@@ -88,8 +88,15 @@ def _config(args: argparse.Namespace):
 
 
 async def _run(cfg) -> dict:
+    from charon_tpu.ops import sentinel
     from charon_tpu.testutil.loadgen import ServingHarness
 
+    # Compile telemetry for the whole run; the `compiles` JSON-tail key
+    # reports warmup vs steady counts. The duty mix legitimately varies
+    # slot shapes (selection storms, epoch boundaries), so the steady
+    # window is NOT armed here by default — set CHARON_TPU_STEADY_AFTER
+    # to make the shared sigagg pipeline arm itself after N slots.
+    sentinel.install()
     harness = ServingHarness(cfg)
     print(f"# bench_vapi: {cfg.num_vcs} VCs x {cfg.num_validators} "
           f"validators, {cfg.slots} slots @ {cfg.seconds_per_slot}s, "
@@ -121,6 +128,7 @@ async def _run(cfg) -> dict:
 
     tail["pairing_paths"] = {"device": PA._pairing_c.value("device"),
                              "native": PA._pairing_c.value("native")}
+    tail["compiles"] = sentinel.compiles_summary()
     verify_hist = 'ops_device_dispatch_seconds{phase="verify"}'
     vstats = metrics.snapshot_quantiles().get(verify_hist, {})
     if vstats.get("count"):

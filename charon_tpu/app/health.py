@@ -155,6 +155,13 @@ def default_checks(quorum_peers: int,
               "docs/robustness.md)",
               lambda w: (w.counter_delta("ops_sigagg_fallback_total") > 0
                          or w.gauge_sum("ops_plane_breaker_state") > 0)),
+        Check("sigagg_steady_state_recompile",
+              "a JIT compile happened inside an armed steady-state window "
+              "(ops_steady_recompile_total moved — after warmup a slot "
+              "must never retrace; a recompile costs minutes on TPU and "
+              "blows the slot deadline; see docs/perf.md compile "
+              "discipline)",
+              lambda w: w.counter_delta("ops_steady_recompile_total") > 0),
         Check("sigagg_slot_stuck",
               "a sigagg slot blew its watchdog deadline (a device fence "
               "hung past CHARON_TPU_SLOT_DEADLINE_S and the slot was "

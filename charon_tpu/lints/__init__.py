@@ -10,16 +10,22 @@ the codebase states in prose —
   LINT-AIO-001   spawned-task results must be retained (utils/aio.py)
   LINT-EXC-002   no silent broad excepts in core/, dkg/, p2p/
   LINT-TPU-003   big ints encode via fq_from_int/limbs_from_int before
-                 device arrays; no host syncs in @jax.jit bodies
+                 device arrays
   LINT-IFACE-004 core/ components implement their claimed protocol
 
 Since RULES_VERSION 9 the engine is whole-program: a project index +
 call graph (`project.py`) and a forward taint framework (`dataflow.py`)
-back three interprocedural rules —
+back the interprocedural rules —
 
   LINT-SEC-013   secret key material must not reach observable sinks
   LINT-ASY-014   no blocking calls reachable from the core/p2p duty path
   LINT-OBS-015   health-read metric names registered and documented
+  LINT-TPU-017   no host control flow/materialization on traced values
+                 in any jit region or helper reachable from one
+  LINT-TPU-018   jit cache keys stay stable (memoized construction,
+                 hashable/immutable static specs)
+  LINT-TPU-019   hot-path region calls take device arrays, not host
+                 values (the static twin of the runtime transfer guard)
 
 Run `python -m charon_tpu.lints [paths]`; see docs/lints.md.
 """

@@ -8,6 +8,9 @@ alias. `--changed BASE` narrows the *report* to files changed since a git
 base (or listed in a manifest file) plus everything that imports them —
 the whole-program index is still built over the full tree, so
 interprocedural findings stay sound; only the reporting is filtered.
+`--rule LINT-XXX-NNN` (repeatable) narrows the report the same way by
+rule id — handy when burning down one rule's findings; unknown ids are a
+usage error so typos don't read as a clean run.
 """
 
 from __future__ import annotations
@@ -36,6 +39,10 @@ def build_parser() -> argparse.ArgumentParser:
                         "CI-consumable")
     p.add_argument("--json", action="store_true", dest="as_json",
                    help="alias for --format=json (back-compat)")
+    p.add_argument("--rule", action="append", default=None, dest="rules",
+                   metavar="LINT-XXX-NNN",
+                   help="report only findings from this rule id (repeatable); "
+                        "the whole-program analysis still runs every rule")
     p.add_argument("--changed", default=None, metavar="BASE",
                    help="report only findings in files changed since git "
                         "rev BASE (or listed, one per line, in a manifest "
@@ -69,6 +76,11 @@ def changed_rels(base: str, root: Path) -> set[str] | None:
         out = subprocess.run(
             ["git", "diff", "--name-only", base, "--"],
             cwd=root, capture_output=True, text=True, timeout=60)
+    except FileNotFoundError:
+        print("error: --changed: git is not available on PATH; pass a "
+              "manifest file of changed paths instead of a rev",
+              file=sys.stderr)
+        return None
     except (OSError, subprocess.SubprocessError) as exc:
         print(f"error: --changed: {exc}", file=sys.stderr)
         return None
@@ -114,7 +126,20 @@ def main(argv: list[str] | None = None) -> int:
             return 2
 
     eng = engine.Engine(cache_path=args.cache)
+
+    if args.rules:
+        known = {r.id for r in eng.rules}
+        unknown = [r for r in args.rules if r not in known]
+        if unknown:
+            print(f"error: --rule: unknown rule id(s): "
+                  f"{', '.join(unknown)} (known: "
+                  f"{', '.join(sorted(known))})", file=sys.stderr)
+            return 2
+
     findings = eng.lint_paths(paths, root=args.root)
+
+    if args.rules:
+        findings = [f for f in findings if f.rule in set(args.rules)]
 
     if args.changed is not None:
         root = Path(args.root) if args.root else Path.cwd()

@@ -7,6 +7,7 @@ from .asy import EventLoopBlockRule
 from .exc import BroadExceptRule, GuardSeamRule
 from .flt import FaultSiteRule
 from .iface import ProtocolImplRule
+from .jit import JitCacheKeyRule, TraceHazardRule, TransferRule
 from .obs import DutySpanRule, MetricDriftRule
 from .sec import SecretTaintRule
 from .tpu import (DeviceDtypeRule, FieldPlaneRoutingRule,
@@ -31,6 +32,9 @@ __all__ = [
     "SecretTaintRule",
     "EventLoopBlockRule",
     "MetricDriftRule",
+    "TraceHazardRule",
+    "JitCacheKeyRule",
+    "TransferRule",
     "default_rules",
 ]
 
@@ -53,4 +57,7 @@ def default_rules() -> list:
         SecretTaintRule(),
         EventLoopBlockRule(),
         MetricDriftRule(),
+        TraceHazardRule(),
+        JitCacheKeyRule(),
+        TransferRule(),
     ]

@@ -1,0 +1,712 @@
+"""Trace-discipline rules: the steady state must never recompile.
+
+Three whole-program rules built on the project call graph (lints/project.py)
+around one shared notion of a **jit region** — code that XLA traces and
+compiles.  Regions are discovered from four construction idioms:
+
+  * ``@jax.jit`` / ``@partial(jax.jit, ...)`` decorated defs (including the
+    nested defs inside the ``lru_cache``'d ``_compiled_*`` factories),
+  * ``jax.jit(fn)`` / ``jax.jit(shard_map(fn, ...))`` call sites,
+  * ``shard_map(fn, ...)`` bodies,
+  * ``pl.pallas_call(kernel, ...)`` Mosaic kernel bodies.
+
+LINT-TPU-017 (TraceHazardRule) — Python control flow or host
+materialization on traced values inside a region *or any helper reachable
+from one* over precise internal call edges.  Supersedes the per-file jit
+half of LINT-TPU-003, which could not see through a helper call.
+
+LINT-TPU-018 (JitCacheKeyRule) — recompile hazards at construction sites:
+``jax.jit`` applied inside a non-memoized function (a fresh compiled
+callable — and a fresh XLA cache entry — per call), mutable
+``static_argnums``/``static_argnames`` specs, and unhashable values passed
+at static positions of a region call.
+
+LINT-TPU-019 (TransferRule) — host values (numpy arrays, list literals,
+bare Python scalars) flowing into a region call on the slot hot path
+(ops/{plane_agg,sharded_plane,pairing,h2c}.py) outside the sanctioned
+pack/warm boundaries.  Every such argument is an implicit host→device
+transfer on every dispatch; the runtime twin is
+``ops.sentinel.steady_state()``'s transfer guard (docs/perf.md).
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from typing import Iterable
+
+from ..engine import Finding
+from ..project import _flatten
+from .tpu import _aliases, _is_jit_decorator
+
+# Host-side encoders that run on Python ints at trace time by design
+# (LINT-TPU-003's sanctioned path); their numpy use is constant folding,
+# not a traced-value materialization.
+_TRACE_TIME_HOSTS = (
+    "limbs_from_int", "int_from_limbs", "to_mont_int", "from_mont_int",
+    "fq_from_int", "fq_to_int", "fq2_from_ints", "fq2_to_ints",
+)
+
+# Array reductions whose result is traced: using one in Python control
+# flow concretizes it.
+_REDUCERS = ("any", "all", "sum", "max", "min", "item")
+
+# Attribute accesses on a traced value that are static at trace time.
+_STATIC_ATTRS = ("shape", "dtype", "ndim", "size")
+
+# Slot hot-path modules for LINT-TPU-019 (module basename under ops/).
+_HOT_MODULES = ("plane_agg", "sharded_plane", "pairing", "h2c")
+
+# Enclosing defs where host values may flow into region calls: warmup
+# pre-compiles graphs before the steady window arms, so its dispatches are
+# off the steady path by construction.
+_SANCTIONED_BOUNDARIES = ("warm_verify_graphs", "warm_buckets",
+                          "warm_pairing_graphs")
+
+
+@dataclass(frozen=True)
+class Region:
+    """One compiled region: the traced function plus its static params."""
+
+    qual: str
+    kind: str                   # "jit" | "shard_map" | "pallas"
+    line: int
+    static_params: frozenset = frozenset()
+
+
+@dataclass
+class _Site:
+    """One jit/shard_map/pallas construction call with its def context."""
+
+    mod: object                 # ModuleInfo
+    node: ast.Call
+    kind: str
+    def_stack: tuple            # enclosing (Async)FunctionDef nodes
+    target: str | None          # resolved region qualname, if any
+
+
+def _is_memo_decorator(dec: ast.expr) -> bool:
+    target = dec.func if isinstance(dec, ast.Call) else dec
+    dotted = _flatten(target)
+    return bool(dotted) and dotted.rpartition(".")[2] in ("lru_cache", "cache")
+
+
+def _jit_keywords(call_or_dec: ast.expr) -> list[ast.keyword]:
+    """static_argnums/static_argnames keywords of a jit decorator/call."""
+    if not isinstance(call_or_dec, ast.Call):
+        return []
+    return [kw for kw in call_or_dec.keywords
+            if kw.arg in ("static_argnums", "static_argnames")]
+
+
+def _static_spec(dec_list: Iterable[ast.expr], params: list[str],
+                 jax_al: set[str]) -> frozenset:
+    """Param names declared static by jit decorator keywords."""
+    names: set[str] = set()
+    for dec in dec_list:
+        if not (isinstance(dec, ast.Call) and _is_jit_decorator(dec, jax_al)):
+            continue
+        for kw in _jit_keywords(dec):
+            for v in _const_leaves(kw.value):
+                if kw.arg == "static_argnums" and isinstance(v, int) \
+                        and 0 <= v < len(params):
+                    names.add(params[v])
+                elif kw.arg == "static_argnames" and isinstance(v, str):
+                    names.add(v)
+    return frozenset(names)
+
+
+def _const_leaves(node: ast.expr) -> list:
+    if isinstance(node, ast.Constant):
+        return [node.value]
+    if isinstance(node, ast.Tuple):
+        out = []
+        for elt in node.elts:
+            out.extend(_const_leaves(elt))
+        return out
+    return []
+
+
+def _same_frame(node: ast.AST) -> Iterable[ast.AST]:
+    """Descendants of `node` without entering nested defs/lambdas."""
+    for child in ast.iter_child_nodes(node):
+        if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.Lambda)):
+            continue
+        yield child
+        yield from _same_frame(child)
+
+
+def _frame_body(fn_node: ast.AST) -> list[ast.stmt]:
+    if isinstance(fn_node, ast.Lambda):
+        return [ast.Expr(value=fn_node.body)]
+    return list(getattr(fn_node, "body", []))
+
+
+class _ModuleScan(ast.NodeVisitor):
+    """Collect jit/shard_map/pallas construction sites with def context."""
+
+    def __init__(self, mod, jax_al: set[str]):
+        self.mod = mod
+        self.jax_al = jax_al
+        self.sites: list[_Site] = []
+        self._stack: list[ast.AST] = []
+
+    def _is_jit_ref(self, e: ast.expr) -> bool:
+        if isinstance(e, ast.Attribute):
+            return e.attr == "jit" and isinstance(e.value, ast.Name) \
+                and e.value.id in self.jax_al
+        return (isinstance(e, ast.Name) and e.id == "jit"
+                and self.mod.imports.get("jit", "").endswith("jax.jit"))
+
+    def _classify(self, node: ast.Call) -> str | None:
+        if self._is_jit_ref(node.func):
+            return "jit"
+        dotted = _flatten(node.func)
+        if dotted:
+            tail = dotted.rpartition(".")[2]
+            if tail == "shard_map":
+                return "shard_map"
+            if tail == "pallas_call":
+                return "pallas"
+        return None
+
+    def visit_FunctionDef(self, node):
+        self._stack.append(node)
+        self.generic_visit(node)
+        self._stack.pop()
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+    visit_Lambda = visit_FunctionDef
+
+    def visit_Call(self, node: ast.Call) -> None:
+        kind = self._classify(node)
+        if kind is not None:
+            self.sites.append(_Site(
+                mod=self.mod, node=node, kind=kind,
+                def_stack=tuple(n for n in self._stack
+                                if isinstance(n, (ast.FunctionDef,
+                                                  ast.AsyncFunctionDef))),
+                target=None))
+        self.generic_visit(node)
+
+
+def _fn_by_node(index) -> dict[int, object]:
+    return {id(fn.node): fn for fn in index.functions.values()}
+
+
+def _enclosing_qual(index, mod, def_stack: tuple) -> str | None:
+    if not def_stack:
+        return None
+    by_node = getattr(index, "_jit_fn_by_node", None)
+    if by_node is None:
+        by_node = _fn_by_node(index)
+        index._jit_fn_by_node = by_node
+    fn = by_node.get(id(def_stack[-1]))
+    return fn.qualname if fn is not None else None
+
+
+def _resolve_target(index, mod, arg: ast.expr,
+                    encl_qual: str | None) -> str | None:
+    """Resolve the function argument of a jit/shard_map/pallas call."""
+    if isinstance(arg, ast.Lambda):
+        q = f"{encl_qual or mod.name}.<lambda:{arg.lineno}>"
+        return q if q in index.functions else None
+    if isinstance(arg, ast.Call) and arg.args:
+        # jax.jit(shard_map(f, ...)) and friends: unwrap one level
+        return _resolve_target(index, mod, arg.args[0], encl_qual)
+    dotted = _flatten(arg)
+    if not dotted:
+        return None
+    if encl_qual:
+        q = f"{encl_qual}.{dotted}"
+        if q in index.functions:
+            return q
+    got = index.resolve(f"{mod.name}.{dotted}") or index.resolve(dotted)
+    return got if got in index.functions else None
+
+
+def discover_regions(index) -> tuple[dict[str, Region], list[_Site],
+                                     set[str]]:
+    """All compiled regions in the tree, the raw construction sites, and
+    the set of factory functions that *contain* a region (their return
+    values are jit handles)."""
+    cached = getattr(index, "_jit_regions_cache", None)
+    if cached is not None:
+        return cached
+    regions: dict[str, Region] = {}
+    sites: list[_Site] = []
+    factories: set[str] = set()
+    aliases: dict[str, tuple] = {}
+    for mod in index.modules.values():
+        aliases[mod.name] = _aliases(mod.src.tree)
+    # decorator-declared regions
+    for fn in index.functions.values():
+        node = fn.node
+        decs = getattr(node, "decorator_list", [])
+        jax_al = aliases[fn.module.name][2]
+        if any(_is_jit_decorator(d, jax_al) for d in decs):
+            regions.setdefault(fn.qualname, Region(
+                qual=fn.qualname, kind="jit", line=node.lineno,
+                static_params=_static_spec(decs, fn.params, jax_al)))
+    # construction call sites
+    for mod in index.modules.values():
+        scan = _ModuleScan(mod, aliases[mod.name][2])
+        scan.visit(mod.src.tree)
+        for site in scan.sites:
+            encl = _enclosing_qual(index, mod, site.def_stack)
+            target = (_resolve_target(index, mod, site.node.args[0], encl)
+                      if site.node.args else None)
+            site.target = target
+            sites.append(site)
+            if target is not None and target not in regions:
+                fn = index.functions[target]
+                static = frozenset()
+                if site.kind == "jit":
+                    for kw in _jit_keywords(site.node):
+                        static = _static_spec([site.node], fn.params,
+                                              aliases[mod.name][2])
+                regions[target] = Region(qual=target, kind=site.kind,
+                                         line=site.node.lineno,
+                                         static_params=static)
+    # factories: functions enclosing a region def or construction site
+    for qual in regions:
+        head, _, _tail = qual.rpartition(".")
+        if head in index.functions:
+            factories.add(head)
+    for site in sites:
+        encl = _enclosing_qual(index, site.mod, site.def_stack)
+        if encl is not None:
+            factories.add(encl)
+    index._jit_regions_cache = (regions, sites, factories)
+    return regions, sites, factories
+
+
+def _reach_precise(index, roots: Iterable[str]) -> dict[str, tuple]:
+    """Reachability over precise internal call/ref edges only — CHA
+    name-match edges would drag unrelated same-named methods into the
+    traced set.  `ref` edges keep lax.scan/cond body functions (nested
+    defs handed to combinators) inside the traced region."""
+    paths: dict[str, tuple] = {}
+    queue: list[str] = []
+    for r in roots:
+        if r not in paths:
+            paths[r] = (r,)
+            queue.append(r)
+    while queue:
+        cur = queue.pop(0)
+        for e in index.out_edges(cur):
+            if e.kind not in ("call", "ref") or not e.internal \
+                    or not e.precise:
+                continue
+            if e.callee not in paths:
+                paths[e.callee] = paths[cur] + (e.callee,)
+                queue.append(e.callee)
+    return paths
+
+
+def _mentions(node: ast.AST, names: set[str], src) -> bool:
+    """True if `node` references a name in `names` other than through a
+    static attribute (.shape/.dtype/.ndim/.size) or len()/isinstance()."""
+    for sub in ast.walk(node):
+        if not (isinstance(sub, ast.Name) and sub.id in names):
+            continue
+        parent = src.parent(sub)
+        if isinstance(parent, ast.Attribute) and parent.attr in _STATIC_ATTRS:
+            continue
+        if isinstance(parent, ast.Call) and parent.func is not sub \
+                and isinstance(parent.func, ast.Name) \
+                and parent.func.id in ("len", "isinstance"):
+            continue
+        return True
+    return False
+
+
+class TraceHazardRule:
+    """LINT-TPU-017: host control flow / materialization in a jit region."""
+
+    id = "LINT-TPU-017"
+    description = ("no Python control flow or host materialization on "
+                   "traced values inside a jit region or any helper "
+                   "reachable from one")
+    project_scope = "tree"
+
+    def check_project(self, index, root) -> Iterable[Finding]:
+        regions, _sites, _factories = discover_regions(index)
+        reach = _reach_precise(index, regions)
+        aliases: dict[str, tuple] = {}
+        for qual, path in reach.items():
+            fn = index.functions.get(qual)
+            if fn is None or fn.name in _TRACE_TIME_HOSTS:
+                continue
+            mod = fn.module
+            if mod.name not in aliases:
+                aliases[mod.name] = _aliases(mod.src.tree)
+            yield from self._check_fn(fn, qual in regions, path,
+                                      aliases[mod.name])
+
+    def _check_fn(self, fn, is_root: bool, path: tuple,
+                  aliases: tuple) -> Iterable[Finding]:
+        np_al, jnp_al, jax_al = aliases
+        src = fn.module.src
+        params = set(fn.params)
+        # scalar-annotated params are static Python values by contract
+        # (digit-table builders etc.): numpy on them is trace-time
+        # constant folding, not a traced-value materialization
+        scalar_ann = {p for p, a in fn.annotations.items()
+                      if a in ("int", "float", "bool", "str")}
+        if is_root:
+            # static cache-key params are Python values, not tracers
+            decs = getattr(fn.node, "decorator_list", [])
+            traced = params - scalar_ann \
+                - set(_static_spec(decs, fn.params, jax_al))
+            mat_extra: set[str] = set()
+        else:
+            # a helper's params are traced only transitively; count them
+            # for materialization sinks, not for control-flow tests
+            traced = set()
+            mat_extra = params - scalar_ann
+        via = ("" if len(path) <= 1 else
+               " (reachable from jit region `" + path[0].rpartition(".")[2]
+               + "` via " + " -> ".join(p.rpartition(".")[2]
+                                        for p in path[1:]) + ")")
+        label = fn.qualname.rpartition(".")[2] if not is_root else fn.name
+        seen_lines: set[tuple[int, str]] = set()
+
+        def emit(line: int, msg: str):
+            if (line, msg) not in seen_lines:
+                seen_lines.add((line, msg))
+                yield Finding(src.rel, line, self.id, msg)
+
+        for stmt in _frame_body(fn.node):
+            for sub in [stmt, *_same_frame(stmt)]:
+                if isinstance(sub, ast.Call):
+                    yield from self._check_call(
+                        sub, emit, label, via, traced, mat_extra,
+                        np_al, jax_al, src)
+                elif isinstance(sub, (ast.If, ast.While, ast.Assert)):
+                    test = sub.test
+                    if self._test_traced(test, traced, mat_extra, jnp_al,
+                                         jax_al, src):
+                        word = type(sub).__name__.lower()
+                        yield from emit(
+                            sub.lineno,
+                            f"Python `{word}` on a traced value in jit "
+                            f"region helper `{label}`{via}: concretizes at "
+                            "trace time and keys the compile on data — use "
+                            "jnp.where/lax.cond or hoist to the host "
+                            "boundary")
+            # order matters: a name becomes traced after its assignment
+            for sub in [stmt, *_same_frame(stmt)]:
+                if isinstance(sub, ast.Assign) and len(sub.targets) == 1 \
+                        and isinstance(sub.targets[0], ast.Name) \
+                        and self._is_device_expr(sub.value, traced, jnp_al,
+                                                 jax_al):
+                    traced.add(sub.targets[0].id)
+
+    def _check_call(self, sub: ast.Call, emit, label: str, via: str,
+                    traced: set[str], mat_extra: set[str],
+                    np_al: set[str], jax_al: set[str], src):
+        func = sub.func
+        if isinstance(func, ast.Attribute):
+            recv = _flatten(func.value)
+            recv_head = recv.split(".")[0] if recv else None
+            if func.attr == "block_until_ready":
+                yield from emit(
+                    sub.lineno,
+                    f"`.block_until_ready()` inside jit region "
+                    f"`{label}`{via} forces a host sync in the traced "
+                    "region; sync outside the compiled function")
+            elif func.attr == "item" and not sub.args \
+                    and recv_head in (traced | mat_extra):
+                yield from emit(
+                    sub.lineno,
+                    f"`.item()` on a traced value in jit region "
+                    f"`{label}`{via}: device→host sync at trace time — "
+                    "return the array and materialize at the host boundary")
+            elif func.attr == "device_get" and recv_head in jax_al:
+                yield from emit(
+                    sub.lineno,
+                    f"`jax.device_get()` inside jit region `{label}`{via} "
+                    "is a device→host transfer in the traced region")
+            elif func.attr in ("asarray", "array") and recv_head in np_al:
+                if any(_mentions(a, traced | mat_extra, src)
+                       for a in sub.args):
+                    yield from emit(
+                        sub.lineno,
+                        f"`numpy.{func.attr}()` inside jit region "
+                        f"`{label}`{via} is a device→host transfer at "
+                        "trace time; use jax.numpy or move it out of the "
+                        "compiled region")
+        elif isinstance(func, ast.Name) and func.id in ("int", "float",
+                                                        "bool") \
+                and sub.args and _mentions(sub.args[0], traced, src):
+            yield from emit(
+                sub.lineno,
+                f"`{func.id}()` on a traced value in jit region "
+                f"`{label}`{via}: concretizes the tracer — keep it as a "
+                "device array or compute it before the compiled region")
+
+    def _is_device_expr(self, node: ast.expr, traced: set[str],
+                        jnp_al: set[str], jax_al: set[str]) -> bool:
+        if isinstance(node, ast.Call):
+            dotted = _flatten(node.func)
+            head = dotted.split(".")[0] if dotted else None
+            if head in jnp_al or head == "lax":
+                return True
+            if head in jax_al and dotted and ".lax." in f".{dotted}.":
+                return True
+        return False
+
+    def _test_traced(self, test: ast.expr, traced: set[str],
+                     mat_extra: set[str], jnp_al: set[str],
+                     jax_al: set[str], src) -> bool:
+        for sub in ast.walk(test):
+            if isinstance(sub, ast.Call):
+                if self._is_device_expr(sub, traced, jnp_al, jax_al):
+                    return True
+                if isinstance(sub.func, ast.Attribute) \
+                        and sub.func.attr in _REDUCERS:
+                    recv = _flatten(sub.func.value)
+                    if recv and recv.split(".")[0] in (traced | mat_extra):
+                        return True
+            elif isinstance(sub, ast.Name) and sub.id in traced:
+                parent = src.parent(sub)
+                if isinstance(parent, ast.Attribute) \
+                        and parent.attr in _STATIC_ATTRS:
+                    continue
+                if isinstance(parent, ast.Call) and parent.func is not sub \
+                        and isinstance(parent.func, ast.Name) \
+                        and parent.func.id in ("len", "isinstance"):
+                    continue
+                # `x is None` branches on object identity, not the tracer
+                if isinstance(parent, ast.Compare) and all(
+                        isinstance(op, (ast.Is, ast.IsNot))
+                        for op in parent.ops):
+                    continue
+                return True
+        return False
+
+
+class JitCacheKeyRule:
+    """LINT-TPU-018: recompile hazards at jit construction sites."""
+
+    id = "LINT-TPU-018"
+    description = ("jit construction must be memoized and its static spec "
+                   "hashable: no jax.jit inside a non-memoized function, "
+                   "no mutable static_argnums/static_argnames, no "
+                   "unhashable values at static call positions")
+    project_scope = "tree"
+
+    def check_project(self, index, root) -> Iterable[Finding]:
+        regions, sites, _factories = discover_regions(index)
+        # (a) construction inside a non-memoized function
+        for site in sites:
+            if site.kind != "jit" or not site.def_stack:
+                continue
+            if any(_is_memo_decorator(d)
+                   for node in site.def_stack
+                   for d in node.decorator_list):
+                continue
+            outer = site.def_stack[0].name
+            yield Finding(
+                site.mod.src.rel, site.node.lineno, self.id,
+                f"jax.jit(...) constructed inside `{outer}` on every call: "
+                "each call mints a fresh compiled callable and a fresh "
+                "cache entry — hoist to module scope or memoize the "
+                "factory with functools.lru_cache")
+        # nested @jax.jit defs in non-memoized factories
+        for fn in index.functions.values():
+            decs = getattr(fn.node, "decorator_list", [])
+            jax_al = _aliases(fn.module.src.tree)[2]
+            if not any(_is_jit_decorator(d, jax_al) for d in decs):
+                continue
+            # (b) mutable static spec on the decorator (module-level and
+            # nested defs alike)
+            yield from self._check_spec(decs, fn.module.src.rel)
+            head = fn.qualname.rpartition(".")[0]
+            outer = index.functions.get(head)
+            if outer is None:
+                continue
+            outer_decs = getattr(outer.node, "decorator_list", [])
+            if not any(_is_memo_decorator(d) for d in outer_decs):
+                yield Finding(
+                    fn.module.src.rel, fn.node.lineno, self.id,
+                    f"@jax.jit def `{fn.name}` nested in non-memoized "
+                    f"factory `{outer.name}`: every factory call traces "
+                    "and compiles anew — decorate the factory with "
+                    "functools.lru_cache")
+        for site in sites:
+            if site.kind == "jit":
+                yield from self._check_spec([site.node], site.mod.src.rel)
+        # (c) unhashable values at static positions of region calls
+        yield from self._check_call_sites(index, regions)
+
+    def _check_spec(self, dec_list, rel: str) -> Iterable[Finding]:
+        for dec in dec_list:
+            for kw in _jit_keywords(dec):
+                if isinstance(kw.value, (ast.List, ast.Set, ast.Dict,
+                                         ast.ListComp, ast.SetComp)):
+                    yield Finding(
+                        rel, kw.value.lineno, self.id,
+                        f"mutable `{kw.arg}` spec: jit hashes the spec "
+                        "into its cache key — use a tuple")
+
+    def _check_call_sites(self, index, regions) -> Iterable[Finding]:
+        unhashable = (ast.List, ast.Dict, ast.Set, ast.ListComp,
+                      ast.SetComp, ast.DictComp, ast.GeneratorExp)
+        for mod in index.modules.values():
+            for node in ast.walk(mod.src.tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                dotted = _flatten(node.func)
+                if not dotted:
+                    continue
+                got = index.resolve(f"{mod.name}.{dotted}") \
+                    or index.resolve(dotted)
+                region = regions.get(got) if got else None
+                if region is None or not region.static_params:
+                    continue
+                fn = index.functions[region.qual]
+                for i, arg in enumerate(node.args):
+                    if isinstance(arg, ast.Starred):
+                        break  # positions unknowable past a *splat
+                    if i < len(fn.params) \
+                            and fn.params[i] in region.static_params \
+                            and isinstance(arg, unhashable):
+                        yield Finding(
+                            mod.src.rel, arg.lineno, self.id,
+                            f"unhashable value for static argument "
+                            f"`{fn.params[i]}` of jit region `{fn.name}`: "
+                            "jit cannot key its cache on it — pass a "
+                            "hashable (tuple/int/str) value")
+                for kw in node.keywords:
+                    if kw.arg in region.static_params \
+                            and isinstance(kw.value, unhashable):
+                        yield Finding(
+                            mod.src.rel, kw.value.lineno, self.id,
+                            f"unhashable value for static argument "
+                            f"`{kw.arg}` of jit region `{fn.name}`: jit "
+                            "cannot key its cache on it — pass a hashable "
+                            "(tuple/int/str) value")
+
+
+class TransferRule:
+    """LINT-TPU-019: implicit host→device transfers into hot-path regions."""
+
+    id = "LINT-TPU-019"
+    description = ("no numpy arrays, list literals, or bare Python "
+                   "scalars into jit region calls on the slot hot path — "
+                   "every one is an implicit host→device transfer per "
+                   "dispatch; pack once via jnp.asarray at the boundary")
+    project_scope = "tree"
+
+    def check_project(self, index, root) -> Iterable[Finding]:
+        regions, _sites, factories = discover_regions(index)
+        for mod in index.modules.values():
+            base = mod.name.rpartition(".")[2]
+            if base not in _HOT_MODULES or not mod.src.in_dir("ops"):
+                continue
+            np_al, jnp_al, _jax_al = _aliases(mod.src.tree)
+            for fn in mod.functions.values():
+                if fn.module is not mod or fn.name in _SANCTIONED_BOUNDARIES:
+                    continue
+                yield from self._check_frame(index, mod, fn, regions,
+                                             factories, np_al, jnp_al)
+
+    def _check_frame(self, index, mod, fn, regions, factories,
+                     np_al: set[str], jnp_al: set[str]) -> Iterable[Finding]:
+        src = mod.src
+        host_names: set[str] = set()    # np-derived / list-valued locals
+        handles: set[str] = set()       # locals bound to factory results
+        for stmt in _frame_body(fn.node):
+            for sub in [stmt, *_same_frame(stmt)]:
+                if not isinstance(sub, ast.Call):
+                    continue
+                target = self._region_for(index, mod, sub, regions,
+                                          handles, fn)
+                if target is None:
+                    continue
+                region, callee_fn = target
+                static = region.static_params if region else frozenset()
+                params = callee_fn.params if callee_fn else []
+                for i, arg in enumerate(sub.args):
+                    if isinstance(arg, ast.Starred):
+                        break  # positions past a *splat can't be mapped
+                        # onto the static spec — skip rather than misflag
+                    if i < len(params) and params[i] in static:
+                        continue
+                    yield from self._check_arg(arg, src, fn, np_al,
+                                               host_names)
+                for kw in sub.keywords:
+                    if kw.arg in static:
+                        continue
+                    yield from self._check_arg(kw.value, src, fn, np_al,
+                                               host_names)
+            # track host-valued locals and jit handles, in order
+            for sub in [stmt, *_same_frame(stmt)]:
+                if not (isinstance(sub, ast.Assign) and len(sub.targets) == 1
+                        and isinstance(sub.targets[0], ast.Name)):
+                    continue
+                name = sub.targets[0].id
+                if isinstance(sub.value, (ast.List, ast.ListComp)):
+                    host_names.add(name)
+                elif isinstance(sub.value, ast.Call):
+                    dotted = _flatten(sub.value.func)
+                    head = dotted.split(".")[0] if dotted else None
+                    if head in np_al:
+                        host_names.add(name)
+                    elif dotted:
+                        got = index.resolve(f"{mod.name}.{dotted}") \
+                            or index.resolve(dotted)
+                        if got in factories:
+                            handles.add(name)
+
+    def _region_for(self, index, mod, call: ast.Call, regions,
+                    handles: set[str], fn):
+        dotted = _flatten(call.func)
+        if dotted is None:
+            return None
+        head = dotted.split(".")[0]
+        if head in handles and "." not in dotted:
+            return (None, None)  # factory handle: statics unknown
+        got = index.resolve(f"{mod.name}.{dotted}") or index.resolve(dotted)
+        if got is None:
+            q = f"{fn.qualname}.{dotted}"
+            got = q if q in index.functions else None
+        if got in regions:
+            return (regions[got], index.functions.get(got))
+        return None
+
+    def _check_arg(self, arg: ast.expr, src, fn, np_al: set[str],
+                   host_names: set[str]) -> Iterable[Finding]:
+        label = fn.name
+        if isinstance(arg, ast.Call):
+            dotted = _flatten(arg.func)
+            head = dotted.split(".")[0] if dotted else None
+            if head in np_al:
+                yield Finding(
+                    src.rel, arg.lineno, self.id,
+                    f"numpy value passed into a jit region call in "
+                    f"`{label}`: implicit host→device transfer on every "
+                    "dispatch — wrap in jnp.asarray at the pack boundary")
+        elif isinstance(arg, ast.Name) and arg.id in host_names:
+            yield Finding(
+                src.rel, arg.lineno, self.id,
+                f"host value `{arg.id}` passed into a jit region call in "
+                f"`{label}`: implicit host→device transfer on every "
+                "dispatch — wrap in jnp.asarray at the pack boundary")
+        elif isinstance(arg, (ast.List, ast.ListComp)):
+            yield Finding(
+                src.rel, arg.lineno, self.id,
+                f"list literal passed into a jit region call in "
+                f"`{label}`: implicit host→device transfer on every "
+                "dispatch — pack a device array once at the boundary")
+        elif isinstance(arg, ast.Constant) \
+                and isinstance(arg.value, (int, float)) \
+                and not isinstance(arg.value, bool):
+            yield Finding(
+                src.rel, arg.lineno, self.id,
+                f"bare Python scalar passed into a jit region call in "
+                f"`{label}`: re-transferred (and weak-type re-traced) on "
+                "every dispatch — make it a static arg or a packed array")

@@ -1,22 +1,18 @@
 """LINT-TPU-003 / LINT-TPU-005 — device-plane invariants under ops/ and tbls/.
 
-LINT-TPU-003 (DeviceDtypeRule) — two dtype/sync invariants:
-
-1. **Big ints must be encoded before reaching the device.** The crypto
-   planes are int32 limb arrays; field elements are 381-bit Python ints.
-   Passing one (or a module constant like `P_INT`) straight into
-   `jnp.asarray`/`jnp.array` silently truncates or raises at trace time —
-   only `fq_from_int`/`limbs_from_int`/`fq2_from_ints` make that safe. The
-   rule flags int literals and module-level int constants ≥ 2**31 entering
-   a jax.numpy array constructor outside one of the safe encoders. Module
-   constants are const-evaluated (including `<<`/`*`/`%`/`**` of other
-   constants), so derived values like `R_MONT = 1 << 384` are caught too.
-
-2. **No host syncs inside `@jax.jit` bodies.** A `.block_until_ready()` or
-   `np.asarray(...)`/`np.array(...)` inside a jitted function forces a
-   device→host transfer at trace/replay time, serializing the dispatch
-   pipeline the plane exists to keep full. (Recognized decorator shapes:
-   `@jax.jit`, `@jit`, `@partial(jax.jit, ...)`, `@jax.jit(...)`.)
+LINT-TPU-003 (DeviceDtypeRule) — big ints must be encoded before
+reaching the device. The crypto planes are int32 limb arrays; field
+elements are 381-bit Python ints. Passing one (or a module constant like
+`P_INT`) straight into `jnp.asarray`/`jnp.array` silently truncates or
+raises at trace time — only `fq_from_int`/`limbs_from_int`/
+`fq2_from_ints` make that safe. The rule flags int literals and
+module-level int constants ≥ 2**31 entering a jax.numpy array
+constructor outside one of the safe encoders. Module constants are
+const-evaluated (including `<<`/`*`/`%`/`**` of other constants), so
+derived values like `R_MONT = 1 << 384` are caught too. (The old second
+invariant — host syncs inside `@jax.jit` bodies — moved to the
+interprocedural LINT-TPU-017 TraceHazardRule in rules/jit.py, which
+also sees through helper calls out of the decorated body.)
 
 LINT-TPU-005 (PlaneStoreRoutingRule) — pubkey bytes route through the
 PlaneStore. Compressed public-key sets are static per cluster; decoding
@@ -181,16 +177,14 @@ def _is_jit_decorator(dec: ast.expr, jax_al: set[str]) -> bool:
 class DeviceDtypeRule:
     id = "LINT-TPU-003"
     description = ("big Python ints must pass through fq_from_int/"
-                   "limbs_from_int before jnp arrays; no host syncs inside "
-                   "@jax.jit bodies")
+                   "limbs_from_int before jnp arrays")
 
     def check(self, src: SourceFile) -> Iterable[Finding]:
         if not src.in_dir(*_SCOPE):
             return
-        np_al, jnp_al, jax_al = _aliases(src.tree)
+        _np_al, jnp_al, _jax_al = _aliases(src.tree)
         env = _module_consts(src.tree)
         yield from self._check_big_ints(src, jnp_al, env)
-        yield from self._check_jit_host_sync(src, np_al, jax_al)
 
     # -- invariant 1: big ints entering device arrays -----------------------
 
@@ -229,35 +223,9 @@ class DeviceDtypeRule:
         for child in ast.iter_child_nodes(node):
             yield from self._big_int_refs(child, env)
 
-    # -- invariant 2: host syncs inside jit bodies --------------------------
-
-    def _check_jit_host_sync(self, src: SourceFile, np_al: set[str],
-                             jax_al: set[str]) -> Iterable[Finding]:
-        for node in ast.walk(src.tree):
-            if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
-                continue
-            if not any(_is_jit_decorator(d, jax_al)
-                       for d in node.decorator_list):
-                continue
-            for sub in ast.walk(node):
-                if not isinstance(sub, ast.Call):
-                    continue
-                if isinstance(sub.func, ast.Attribute) \
-                        and sub.func.attr == "block_until_ready":
-                    yield Finding(
-                        src.rel, sub.lineno, self.id,
-                        f"`.block_until_ready()` inside @jax.jit body "
-                        f"`{node.name}` forces a host sync in the traced "
-                        "region; sync outside the jitted function")
-                elif (isinstance(sub.func, ast.Attribute)
-                      and sub.func.attr in ("asarray", "array")
-                      and isinstance(sub.func.value, ast.Name)
-                      and sub.func.value.id in np_al):
-                    yield Finding(
-                        src.rel, sub.lineno, self.id,
-                        f"`numpy.{sub.func.attr}()` inside @jax.jit body "
-                        f"`{node.name}` is a device→host transfer at trace "
-                        "time; use jax.numpy or move it out of the jit")
+    # The old invariant 2 (host syncs inside @jax.jit bodies) moved to the
+    # interprocedural LINT-TPU-017 TraceHazardRule (rules/jit.py), which
+    # also sees through helper calls out of the decorated body.
 
 
 _PLANE_BUILDERS = ("g1_plane_from_compressed", "_parse_compressed")

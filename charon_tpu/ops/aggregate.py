@@ -22,6 +22,7 @@ import numpy as np
 
 from ..crypto import fields as PF
 from ..crypto.serialize import g2_from_bytes, g2_to_bytes
+from . import buckets
 from . import curve as C
 from . import field as F
 
@@ -40,10 +41,7 @@ def _compiled_aggregate(batch: int, width: int):
 
 def _bucket(n: int) -> int:
     """Pad batch sizes to power-of-two buckets to bound recompiles."""
-    b = 8
-    while b < n:
-        b *= 2
-    return b
+    return buckets.pow2_bucket(n, floor=8)
 
 
 def threshold_aggregate_batch(batches: list[dict[int, bytes]]) -> list[bytes]:

@@ -1,0 +1,77 @@
+"""Shared power-of-two bucket/pad geometry for the compiled-graph family.
+
+Every device entry point pads its batch to a power-of-two bucket before
+dispatch so the set of compiled graph shapes stays bounded: at most
+log2(ceiling) + 1 variants per kernel can ever exist, which is exactly
+the family warm_verify_graphs AOT-compiles and the compile sentinel
+(ops/sentinel.py) asserts never grows after warmup. Three call sites
+used to carry private copies of the same loop (ops/aggregate.py,
+ops/pairing.py, ops/h2c.py) with different floors; they now share this
+module so the bucket arithmetic the static analyzer (LINT-TPU-018)
+reasons about has one definition.
+
+The floors differ on purpose and are part of each kernel's contract:
+
+  * aggregate / pairing verify batches floor at 8 — below that the
+    per-dispatch overhead dominates and the smallest useful plane is
+    padded up anyway;
+  * pairing pair-groups floor at 2 — a slot always carries at least one
+    message group plus the signature pair;
+  * h2c batches floor at 1 — a single message hash is a real steady-state
+    dispatch (one distinct message per slot is the common case).
+
+NOT here: ops/plane_agg._bucket, which delegates to pallas_plane.pad_batch
+— its buckets are sub-tile plane geometry (MIN_TILE steps under one TILE),
+a different family keyed to VREG shape, not a plain power of two.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def pow2_bucket(n: int, floor: int = 1) -> int:
+    """Smallest power-of-two multiple of `floor` that is >= max(n, floor).
+
+    `floor` must itself be a power of two (asserted); the return value is
+    then a plain power of two, so successive growing batches reuse at most
+    log2(ceiling / floor) + 1 compiled graphs.
+    """
+    if floor < 1 or floor & (floor - 1):
+        raise ValueError(f"floor must be a power of two, got {floor}")
+    b = floor
+    while b < n:
+        b *= 2
+    return b
+
+
+def pad_lane0(a: np.ndarray, bucket: int, n: int | None = None) -> np.ndarray:
+    """Pad `a` along axis 0 to `bucket` rows by repeating lane 0 — the
+    padding rows are real group elements (never garbage limbs), so padded
+    lanes trace the same code path and are masked out of the verdict.
+    `n` defaults to a.shape[0]; a no-op when already at the bucket."""
+    if n is None:
+        n = a.shape[0]
+    if bucket == n:
+        return a
+    if bucket < n:
+        raise ValueError(f"bucket {bucket} below batch {n}")
+    return np.concatenate([a, np.repeat(a[:1], bucket - n, axis=0)])
+
+
+def live_mask(n: int, bucket: int) -> np.ndarray:
+    """Bool mask over a padded batch axis: True for the n live lanes,
+    False for the lane-0 repeats pad_lane0 appended."""
+    mask = np.zeros(bucket, dtype=bool)
+    mask[:n] = True
+    return mask
+
+
+def chunk_spans(n: int, size: int) -> list[tuple[int, int]]:
+    """[start, stop) spans covering range(n) in `size`-wide chunks — the
+    dispatch schedule for batches beyond one kernel tile. Every span but
+    the last is exactly `size` wide, so chunked dispatches reuse the one
+    full-tile graph plus at most one tail bucket."""
+    if size < 1:
+        raise ValueError(f"chunk size must be positive, got {size}")
+    return [(s, min(s + size, n)) for s in range(0, n, size)]

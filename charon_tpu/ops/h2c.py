@@ -34,6 +34,7 @@ import numpy as np
 from ..crypto import fields as PF
 from ..crypto import hash_to_curve as HH
 from ..crypto.curve import H_EFF_G2
+from . import buckets as BK
 from . import field as F
 from . import pallas_plane as PP
 from . import tower as T
@@ -254,10 +255,7 @@ def _compiled_h2c(batch: int):
 
 
 def _bucket(n: int) -> int:
-    b = 1
-    while b < n:
-        b *= 2
-    return b
+    return BK.pow2_bucket(n, floor=1)
 
 
 def hash_to_field_planes(msgs, dst: bytes = DST_ETH):
@@ -284,14 +282,11 @@ def map_to_g2_device(u0, u1, s0, s1):
     if B > MAX_BATCH:
         raise ValueError(f"h2c batch {B} exceeds MAX_BATCH={MAX_BATCH}")
 
-    def pad(a):
-        if Bp == B:
-            return a
-        return np.concatenate([a, np.repeat(a[:1], Bp - B, axis=0)])
-
     kernel = _compiled_h2c(Bp)
-    hx, hy = kernel(jnp.asarray(pad(u0)), jnp.asarray(pad(u1)),
-                    jnp.asarray(pad(s0)), jnp.asarray(pad(s1)))
+    hx, hy = kernel(jnp.asarray(BK.pad_lane0(u0, Bp, B)),
+                    jnp.asarray(BK.pad_lane0(u1, Bp, B)),
+                    jnp.asarray(BK.pad_lane0(s0, Bp, B)),
+                    jnp.asarray(BK.pad_lane0(s1, Bp, B)))
     return hx, hy
 
 
@@ -307,8 +302,8 @@ def hash_to_g2_device(msgs, dst: bytes = DST_ETH):
         L = F.LIMBS
         return (np.zeros((0, 2, L), np.int32), np.zeros((0, 2, L), np.int32))
     outs = []
-    for s in range(0, B, MAX_BATCH):
-        chunk = msgs[s:s + MAX_BATCH]
+    for lo, hi in BK.chunk_spans(B, MAX_BATCH):
+        chunk = msgs[lo:hi]
         u0, u1, s0, s1 = hash_to_field_planes(chunk, dst)
         hx, hy = map_to_g2_device(u0, u1, s0, s1)
         outs.append((np.asarray(hx)[:len(chunk)],

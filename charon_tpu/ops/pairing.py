@@ -33,6 +33,7 @@ import numpy as np
 
 from ..crypto import curve as PC
 from ..crypto import fields as PF
+from . import buckets
 from . import field as F
 from . import tower as T
 from .pallas_plane import TILE as _TILE
@@ -226,10 +227,7 @@ def _compiled_verify(batch: int):
 
 
 def _bucket(n: int) -> int:
-    b = 8
-    while b < n:
-        b *= 2
-    return b
+    return buckets.pow2_bucket(n, floor=8)
 
 
 def verify_batch_device(pubkeys_affine, h2c_affine, sigs_affine) -> np.ndarray:
@@ -342,10 +340,7 @@ def _compiled_chunk_finish(k: int):
 
 
 def _bucket_pairs(n: int) -> int:
-    b = 2
-    while b < n:
-        b *= 2
-    return b
+    return buckets.pow2_bucket(n, floor=2)
 
 
 def _fq12_concat(fs):
@@ -355,9 +350,7 @@ def _fq12_concat(fs):
 
 
 def _pad_lane0(a, Bp: int, n: int):
-    if Bp == n:
-        return a
-    return np.concatenate([a, np.repeat(a[:1], Bp - n, axis=0)])
+    return buckets.pad_lane0(a, Bp, n)
 
 
 def miller_fold_chunk(p_x, p_y, q_x, q_y):
@@ -366,8 +359,7 @@ def miller_fold_chunk(p_x, p_y, q_x, q_y):
     chunk dispatches queue behind each other asynchronously)."""
     m = p_x.shape[0]
     Bp = _bucket_pairs(m)
-    mask = np.zeros(Bp, dtype=bool)
-    mask[:m] = True
+    mask = buckets.live_mask(m, Bp)
     kern = _compiled_miller_fold(Bp)
     return kern(jnp.asarray(_pad_lane0(np.asarray(p_x), Bp, m)),
                 jnp.asarray(_pad_lane0(np.asarray(p_y), Bp, m)),
@@ -389,8 +381,7 @@ def fold_chunks_is_one(parts) -> bool:
         return bool(np.asarray(ok).reshape(-1)[0])
     Kp = _bucket_pairs(k)
     f = _fq12_concat(parts)
-    mask = np.zeros(Kp, dtype=bool)
-    mask[:k] = True
+    mask = buckets.live_mask(k, Kp)
 
     def padf(c):
         if Kp == k:
@@ -408,8 +399,8 @@ def _pairing_check_chunked(p_x, p_y, q_x, q_y) -> bool:
     compiled shape stays ≤ TILE lanes."""
     n = p_x.shape[0]
     arrs = tuple(np.asarray(a) for a in (p_x, p_y, q_x, q_y))
-    parts = [miller_fold_chunk(*(a[s:s + MAX_PAIR_TILE] for a in arrs))
-             for s in range(0, n, MAX_PAIR_TILE)]
+    parts = [miller_fold_chunk(*(a[lo:hi] for a in arrs))
+             for lo, hi in buckets.chunk_spans(n, MAX_PAIR_TILE)]
     return fold_chunks_is_one(parts)
 
 
@@ -426,8 +417,7 @@ def pairing_check_planes(p_x, p_y, q_x, q_y) -> bool:
     if n > MAX_PAIR_TILE:
         return _pairing_check_chunked(p_x, p_y, q_x, q_y)
     Bp = _bucket_pairs(n)
-    mask = np.zeros(Bp, dtype=bool)
-    mask[:n] = True
+    mask = buckets.live_mask(n, Bp)
     kernel = _compiled_pairing_check(Bp)
     ok = kernel(jnp.asarray(_pad_lane0(np.asarray(p_x), Bp, n)),
                 jnp.asarray(_pad_lane0(np.asarray(p_y), Bp, n)),

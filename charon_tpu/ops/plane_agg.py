@@ -55,6 +55,7 @@ from ..crypto.rlc import RLC_BITS, sample_randomizers
 from ..crypto.serialize import g1_to_bytes, g2_to_bytes
 from . import field as F
 from . import pallas_plane as PP
+from . import sentinel
 
 _MONT_ONE = F.fq_from_int(1)
 
@@ -851,12 +852,13 @@ def _dispatch_slot(batches, pks, msgs):
     if not guard.allow_device_dispatch():
         return ("native_slot",)
     try:
-        m = _sigagg_mesh()
-        if m is not None:
-            from . import sharded_plane
+        with sentinel.region("slot"):
+            m = _sigagg_mesh()
+            if m is not None:
+                from . import sharded_plane
 
-            return sharded_plane.sharded_dispatch(batches, pks, msgs, m)
-        return _fused_dispatch(_layout_slots(batches), pks, msgs)
+                return sharded_plane.sharded_dispatch(batches, pks, msgs, m)
+            return _fused_dispatch(_layout_slots(batches), pks, msgs)
     except Exception as exc:
         if guard.classify(exc) == "input":
             raise
@@ -1016,7 +1018,8 @@ def _run_emit(ctx, state, inputs, hash_fn):
     from . import guard
 
     try:
-        return ctx.run(guard.finish_slot_emit, state, inputs, hash_fn)
+        with sentinel.region("slot"):
+            return ctx.run(guard.finish_slot_emit, state, inputs, hash_fn)
     finally:
         _finish_backlog.inc(amount=-1.0)
 
@@ -1029,7 +1032,8 @@ def _run_verify(ctx, out, verify):
     the moment the emit half completes, so slot N's verify overlaps slot
     N+1's pack and emit."""
     try:
-        return out, ctx.run(verify)
+        with sentinel.region("slot"):
+            return out, ctx.run(verify)
     finally:
         _verify_backlog.inc(amount=-1.0)
 
@@ -1093,7 +1097,8 @@ class SigAggPipeline:
 
     def __init__(self, depth: int | None = None,
                  finish_workers: int | None = None,
-                 slot_deadline: float | None = None):
+                 slot_deadline: float | None = None,
+                 steady_after: int | None = None):
         from . import guard
 
         self._depth = max(1, PIPELINE_DEPTH if depth is None else depth)
@@ -1109,6 +1114,41 @@ class SigAggPipeline:
         # order — the inputs snapshot is what the watchdog re-packs
         self._pending: deque = deque()
         self._pool: ThreadPoolExecutor | None = None
+        # Steady-state sentinel arming (opt-in, CHARON_TPU_STEADY_AFTER or
+        # the constructor arg): after `steady_after` dispatched slots the
+        # pipeline declares itself warm and arms sentinel.steady_state —
+        # from then on, ANY compile anywhere in the process counts as
+        # ops_steady_recompile_total, strikes the plane breaker, and trips
+        # the sigagg_steady_state_recompile health rule. Disabled by
+        # default: callers that legitimately vary slot shapes (tests,
+        # ad-hoc batches) must not be punished for recompiling.
+        self._steady_after = (sentinel.steady_after_default()
+                              if steady_after is None
+                              else (steady_after if steady_after > 0
+                                    else None))
+        self._slots_dispatched = 0
+        self._steady_cm = None
+
+    def _note_dispatch(self) -> None:
+        # caller holds self._lock. Arms the global steady window once the
+        # warmup slot quota is met; the transfer guard is NOT armed here
+        # (it is thread-local and the device work runs on workers — the
+        # steady tests arm it per-thread via sentinel.transfer_guarded).
+        if self._steady_after is None:
+            return
+        self._slots_dispatched += 1
+        if (self._steady_cm is None
+                and self._slots_dispatched >= self._steady_after):
+            cm = sentinel.steady_state(transfer=None)
+            cm.__enter__()
+            self._steady_cm = cm
+
+    @property
+    def steady_armed(self) -> bool:
+        """True once the pipeline has declared itself warm and armed the
+        compile sentinel's steady window."""
+        with self._lock:
+            return self._steady_cm is not None
 
     @property
     def backlog(self) -> int:
@@ -1194,6 +1234,7 @@ class SigAggPipeline:
             inputs = (batches, pks, msgs)
             with self._lock:
                 state = _dispatch_slot(batches, pks, msgs)
+                self._note_dispatch()
                 self._pending.append(
                     (self._schedule_finish(state, inputs, hash_fn),
                      inputs, hash_fn))
@@ -1217,6 +1258,7 @@ class SigAggPipeline:
             inputs = (batches, pks, msgs)
             with self._lock:
                 state = _dispatch_slot(batches, pks, msgs)
+                self._note_dispatch()
                 fut = self._schedule_finish(state, inputs, hash_fn)
                 self._pending.append((fut, inputs, hash_fn))
                 over = (self._pending.popleft()
@@ -1296,6 +1338,7 @@ class SigAggPipeline:
 
             with self._lock:
                 state = _dispatch_slot(batches, pks, msgs)
+                self._note_dispatch()
             return guard.finish_slot(state, (batches, pks, msgs), hash_fn)
 
     def close(self) -> None:
@@ -1304,6 +1347,10 @@ class SigAggPipeline:
         the executor if used again."""
         with self._lock:
             pool, self._pool = self._pool, None
+            cm, self._steady_cm = self._steady_cm, None
+            self._slots_dispatched = 0
+        if cm is not None:
+            cm.__exit__(None, None, None)
         if pool is not None:
             pool.shutdown(wait=True)
 
@@ -2245,21 +2292,23 @@ def warm_verify_graphs(flush_at: int | None = None) -> int:
     from . import mesh as mesh_mod
     from . import pairing as pairing_mod
 
+    sentinel.install()
     if flush_at is None:
         flush_at = PP.TILE * max(1, mesh_mod.device_count())
-    tile = pairing_mod.MAX_PAIR_TILE
-    pairs = flush_at + 1  # every message distinct + the signature pair
-    buckets = {2, min(tile, pairing_mod._bucket_pairs(pairs))}
-    n = pairing_mod.warm_check_buckets(tuple(sorted(buckets)))
-    if pairs > tile:
-        n_chunks = -(-pairs // tile)
-        n += pairing_mod.warm_chunk_graphs(
-            chunk_buckets=(tile,),
-            finish_buckets=(pairing_mod._bucket_pairs(n_chunks),))
-    h2c_buckets = {1, min(h2c_mod.MAX_BATCH, pairing_mod._bucket_pairs(
-        flush_at))}
-    n += h2c_mod.warm_buckets(tuple(sorted(h2c_buckets)))
-    return n
+    with sentinel.region("warm"):
+        tile = pairing_mod.MAX_PAIR_TILE
+        pairs = flush_at + 1  # every message distinct + the signature pair
+        buckets = {2, min(tile, pairing_mod._bucket_pairs(pairs))}
+        n = pairing_mod.warm_check_buckets(tuple(sorted(buckets)))
+        if pairs > tile:
+            n_chunks = -(-pairs // tile)
+            n += pairing_mod.warm_chunk_graphs(
+                chunk_buckets=(tile,),
+                finish_buckets=(pairing_mod._bucket_pairs(n_chunks),))
+        h2c_buckets = {1, min(h2c_mod.MAX_BATCH, pairing_mod._bucket_pairs(
+            flush_at))}
+        n += h2c_mod.warm_buckets(tuple(sorted(h2c_buckets)))
+        return n
 
 
 def _rlc_check(sig_plane: PP.PlanePoint, pk_plane: PP.PlanePoint,

@@ -1,0 +1,42 @@
+#!/usr/bin/env sh
+# Pre-push lint gate: lint the tree, report only findings in files changed
+# since BASE (default origin/main) plus their transitive importers, and
+# exit non-zero on anything new vs the checked-in baseline.
+#
+#   scripts/lint-changed.sh              # diff against origin/main
+#   scripts/lint-changed.sh HEAD~3       # diff against an arbitrary rev
+#   scripts/lint-changed.sh manifest.txt # or a file listing changed paths
+#
+# Wire it as a pre-push hook with:
+#   ln -s ../../scripts/lint-changed.sh .git/hooks/pre-push
+#
+# The whole-program analysis always runs over the full tree (so
+# interprocedural rules stay sound); --changed only filters the report.
+set -eu
+
+BASE="${1:-origin/main}"
+REPO_ROOT="$(cd "$(dirname "$0")/.." && pwd)"
+cd "$REPO_ROOT"
+
+OUT="$(python -m charon_tpu.lints --format=json --changed "$BASE")" || {
+    rc=$?
+    # exit 2 = usage error (bad rev, git missing): surface and propagate.
+    # exit 1 = new findings: print them below.
+    [ "$rc" -eq 1 ] || exit "$rc"
+}
+
+NEW="$(printf '%s' "$OUT" | python -c '
+import json, sys
+report = json.load(sys.stdin)
+for f in report["findings"]:
+    if f["new"]:
+        print("%s:%s: %s: %s" % (f["path"], f["line"], f["rule"], f["message"]))
+')"
+
+if [ -n "$NEW" ]; then
+    echo "$NEW" >&2
+    count="$(printf '%s\n' "$NEW" | wc -l | tr -d ' ')"
+    echo "lint-changed: $count new finding(s) vs baseline — push blocked" >&2
+    exit 1
+fi
+echo "lint-changed: clean vs baseline (base: $BASE)"

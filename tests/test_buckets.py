@@ -1,0 +1,91 @@
+"""Property sweeps for ops/buckets.py — the shared pow2 bucket/pad
+geometry every device entry point routes through. Exhaustive over the
+realistic batch range plus a seeded random sweep (no hypothesis in the
+image; the ranges are small enough to enumerate)."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from charon_tpu.ops import buckets
+
+
+def _is_pow2(x: int) -> bool:
+    return x >= 1 and (x & (x - 1)) == 0
+
+
+def test_pow2_bucket_properties_exhaustive():
+    for floor in (1, 2, 8, 64):
+        for n in range(0, 600):
+            b = buckets.pow2_bucket(n, floor)
+            assert b >= max(n, floor)
+            assert _is_pow2(b)
+            assert b % floor == 0
+            # minimality: the next bucket down would not fit
+            assert b == floor or b // 2 < n
+
+
+def test_pow2_bucket_family_is_bounded():
+    """The whole point: growing batches under a ceiling visit at most
+    log2(ceiling/floor) + 1 distinct buckets — the graph family the
+    sentinel warms and then freezes."""
+    floor, ceiling = 8, 4096
+    family = {buckets.pow2_bucket(n, floor) for n in range(1, ceiling + 1)}
+    assert len(family) == int(np.log2(ceiling // floor)) + 1
+
+
+def test_pow2_bucket_rejects_non_pow2_floor():
+    for floor in (0, 3, 6, 12, -2):
+        with pytest.raises(ValueError):
+            buckets.pow2_bucket(5, floor)
+
+
+def test_pad_lane0_properties():
+    rng = np.random.default_rng(1234)
+    for n in (1, 2, 3, 7, 8, 13):
+        a = rng.integers(0, 2**31 - 1, size=(n, 6, 2), dtype=np.int64)
+        bucket = buckets.pow2_bucket(n, 2)
+        out = buckets.pad_lane0(a, bucket)
+        assert out.shape == (bucket,) + a.shape[1:]
+        np.testing.assert_array_equal(out[:n], a)
+        # every pad row is exactly lane 0 — real group elements, never
+        # garbage limbs
+        for k in range(n, bucket):
+            np.testing.assert_array_equal(out[k], a[0])
+    # no-op at the bucket returns the input unchanged (same object)
+    a = rng.integers(0, 100, size=(8, 3))
+    assert buckets.pad_lane0(a, 8) is a
+    with pytest.raises(ValueError):
+        buckets.pad_lane0(a, 4)
+
+
+def test_live_mask_properties():
+    for n in range(0, 65):
+        bucket = buckets.pow2_bucket(n, 1)
+        mask = buckets.live_mask(n, bucket)
+        assert mask.shape == (bucket,)
+        assert mask.dtype == np.bool_
+        assert int(mask.sum()) == n
+        assert mask[:n].all() and not mask[n:].any()
+
+
+def test_chunk_spans_cover_exactly_once():
+    for size in (1, 2, 7, 16):
+        for n in range(0, 100):
+            spans = buckets.chunk_spans(n, size)
+            covered = [i for s, e in spans for i in range(s, e)]
+            assert covered == list(range(n))  # full cover, in order, once
+            # every span but the last is exactly `size` wide — chunked
+            # dispatches reuse one full-tile graph plus one tail bucket
+            for s, e in spans[:-1]:
+                assert e - s == size
+            if spans:
+                s, e = spans[-1]
+                assert 0 < e - s <= size
+
+
+def test_chunk_spans_rejects_bad_size():
+    for size in (0, -1):
+        with pytest.raises(ValueError):
+            buckets.chunk_spans(10, size)

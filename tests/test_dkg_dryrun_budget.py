@@ -55,5 +55,7 @@ def test_dkg_chaos_dryrun_budget_and_evidence():
         "device loss mid-MSM left no ladder evidence"
     assert m["msm"]["native"] > 0 and m["msm"]["device"] == 0
     assert m["batch"]["count"] == 2 and m["batch"]["total_s"] > 0
+    assert m["compiles"]["steady"] == 0, \
+        "the steady-state ceremonies recompiled"
     print(f"dkg chaos dryrun completed in {elapsed:.0f}s "
           f"(budget {BUDGET_S}s)")

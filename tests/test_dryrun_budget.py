@@ -10,6 +10,7 @@ a fresh (throwaway) compilation cache — under a hard timeout well inside
 the driver's. A kernel edit that regresses compile time fails HERE, in CI,
 instead of silently killing the next round's artifact."""
 
+import json
 import os
 import subprocess
 import sys
@@ -63,4 +64,11 @@ def test_dryrun_multichip_cold_budget():
         f"dryrun failed rc={res.returncode} after {elapsed:.0f}s:\n"
         + res.stdout[-2000:] + res.stderr[-2000:])
     assert "dryrun_multichip OK" in res.stdout, res.stdout[-2000:]
+    tail = next(line for line in res.stdout.splitlines()
+                if line.startswith("dryrun_multichip metrics: "))
+    m = json.loads(tail.split("metrics: ", 1)[1])
+    # the sentinel's steady window (one extra warm slot after the two
+    # warmup slots drained) must have observed ZERO compiles — even on
+    # this deliberately cold cache
+    assert m["compiles"]["steady"] == 0, m["compiles"]
     print(f"cold dryrun completed in {elapsed:.0f}s (budget {BUDGET_S}s)")

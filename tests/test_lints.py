@@ -257,7 +257,7 @@ def test_tpu_rule_flags_host_sync_inside_jit(tmp_path):
         def bad2(x, k):
             return jnp.sum(np.asarray(x))
     """)
-    assert rules_of(findings) == ["LINT-TPU-003", "LINT-TPU-003"]
+    assert rules_of(findings) == ["LINT-TPU-017", "LINT-TPU-017"]
     assert "block_until_ready" in findings[0].message
     assert "numpy.asarray" in findings[1].message
 
@@ -712,6 +712,47 @@ def test_cli_json_output_and_exit_codes(tmp_path, capsys):
     assert lint_main([str(tmp_path / "missing.py")]) == 2
 
 
+def test_cli_rule_filter(tmp_path, capsys):
+    bad = tmp_path / "core" / "x.py"
+    bad.parent.mkdir(parents=True)
+    bad.write_text("import asyncio\n\n"
+                   "async def go(c):\n    asyncio.ensure_future(c)\n"
+                   "\n\ndef eat():\n    try:\n        w()\n"
+                   "    except Exception:\n        pass\n")
+    rc = lint_main(["--json", "--no-baseline", "--root", str(tmp_path),
+                    "--rule", "LINT-EXC-002", str(bad)])
+    report = json.loads(capsys.readouterr().out)
+    assert rc == 1
+    assert report["counts_by_rule"] == {"LINT-EXC-002": 1}
+
+    # a typo'd rule id is a usage error, not a silently-clean run
+    assert lint_main(["--no-baseline", "--root", str(tmp_path),
+                      "--rule", "LINT-NOPE-999", str(bad)]) == 2
+    assert "unknown rule id" in capsys.readouterr().err
+
+
+def test_cli_changed_without_git_fails_clearly(tmp_path, capsys,
+                                               monkeypatch):
+    """--changed with a git rev but no git on PATH exits 2 with a message
+    pointing at the manifest-file alternative, not a raw traceback."""
+    import subprocess as _subprocess
+
+    src = tmp_path / "core" / "x.py"
+    src.parent.mkdir(parents=True)
+    src.write_text("x = 1\n")
+
+    def no_git(*a, **k):
+        raise FileNotFoundError(2, "No such file or directory", "git")
+
+    monkeypatch.setattr(_subprocess, "run", no_git)
+    rc = lint_main(["--no-baseline", "--root", str(tmp_path),
+                    "--changed", "HEAD~1", str(src)])
+    err = capsys.readouterr().err
+    assert rc == 2
+    assert "git is not available" in err
+    assert "manifest" in err
+
+
 def test_cli_baseline_update_roundtrip(tmp_path, capsys):
     bad = tmp_path / "p2p" / "x.py"
     bad.parent.mkdir(parents=True)
@@ -723,6 +764,245 @@ def test_cli_baseline_update_roundtrip(tmp_path, capsys):
     capsys.readouterr()
     assert lint_main(["--baseline", str(baseline), "--root", str(tmp_path),
                       str(bad)]) == 0
+
+
+# ---------------------------------------------------------------------------
+# LINT-TPU-017 — trace hazards in jit regions and reachable helpers
+# ---------------------------------------------------------------------------
+
+
+def tpu17_of(findings):
+    return [f for f in findings if f.rule == "LINT-TPU-017"]
+
+
+def test_trace_hazard_sees_through_helper_call(tmp_path):
+    findings = lint_source(tmp_path, "ops/x.py", """\
+        import jax
+
+        def drain(y):
+            return y.item()
+
+        @jax.jit
+        def region(x):
+            return drain(x + 1)
+    """)
+    hits = tpu17_of(findings)
+    assert len(hits) == 1
+    assert "`.item()`" in hits[0].message
+    assert "reachable from jit region `region` via drain" in hits[0].message
+
+
+def test_trace_hazard_flags_control_flow_on_traced(tmp_path):
+    findings = lint_source(tmp_path, "ops/x.py", """\
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def region(x):
+            if jnp.any(x > 0):
+                return x
+            return -x
+    """)
+    hits = tpu17_of(findings)
+    assert len(hits) == 1
+    assert "Python `if` on a traced value" in hits[0].message
+
+
+def test_trace_hazard_flags_int_concretization(tmp_path):
+    findings = lint_source(tmp_path, "ops/x.py", """\
+        import jax
+
+        @jax.jit
+        def region(x):
+            n = int(x)
+            return x * n
+    """)
+    hits = tpu17_of(findings)
+    assert len(hits) == 1
+    assert "`int()` on a traced value" in hits[0].message
+
+
+def test_trace_hazard_exempts_static_and_scalar_annotated(tmp_path):
+    findings = lint_source(tmp_path, "ops/x.py", """\
+        import functools
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        def table(k: int):
+            return np.asarray([k, k + 1])
+
+        @functools.partial(jax.jit, static_argnums=(1,))
+        def region(x, k):
+            return x + jnp.asarray(table(k)) + jnp.sum(jnp.asarray(k))
+    """)
+    assert tpu17_of(findings) == []
+
+
+def test_trace_hazard_exempts_is_none_and_shape_reads(tmp_path):
+    findings = lint_source(tmp_path, "ops/x.py", """\
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def region(x, bias=None):
+            if bias is None:
+                return x
+            if x.shape[0] > 4:
+                return x + bias
+            return x - bias
+    """)
+    assert tpu17_of(findings) == []
+
+
+# ---------------------------------------------------------------------------
+# LINT-TPU-018 — jit cache-key stability
+# ---------------------------------------------------------------------------
+
+
+def tpu18_of(findings):
+    return [f for f in findings if f.rule == "LINT-TPU-018"]
+
+
+def test_cache_key_flags_unmemoized_construction(tmp_path):
+    findings = lint_source(tmp_path, "ops/x.py", """\
+        import jax
+
+        def f(x):
+            return x
+
+        def make():
+            return jax.jit(f)
+    """)
+    hits = tpu18_of(findings)
+    assert len(hits) == 1
+    assert "constructed inside `make`" in hits[0].message
+
+
+def test_cache_key_allows_memoized_factory(tmp_path):
+    findings = lint_source(tmp_path, "ops/x.py", """\
+        import functools
+        import jax
+
+        def f(x):
+            return x
+
+        @functools.lru_cache(maxsize=None)
+        def make():
+            return jax.jit(f)
+    """)
+    assert tpu18_of(findings) == []
+
+
+def test_cache_key_flags_mutable_static_spec(tmp_path):
+    findings = lint_source(tmp_path, "ops/x.py", """\
+        import functools
+        import jax
+
+        @functools.partial(jax.jit, static_argnums=[1])
+        def k(x, n):
+            return x + n
+    """)
+    hits = tpu18_of(findings)
+    assert len(hits) == 1
+    assert "mutable `static_argnums` spec" in hits[0].message
+
+
+def test_cache_key_flags_unhashable_static_call_arg(tmp_path):
+    findings = lint_source(tmp_path, "ops/x.py", """\
+        import functools
+        import jax
+
+        @functools.partial(jax.jit, static_argnames=("spec",))
+        def k(x, spec):
+            return x
+
+        def call(x):
+            return k(x, spec=[1, 2])
+    """)
+    hits = tpu18_of(findings)
+    assert len(hits) == 1
+    assert "unhashable value for static argument `spec`" in hits[0].message
+
+
+# ---------------------------------------------------------------------------
+# LINT-TPU-019 — host values into hot-path region calls
+# ---------------------------------------------------------------------------
+
+
+def tpu19_of(findings):
+    return [f for f in findings if f.rule == "LINT-TPU-019"]
+
+
+def test_transfer_rule_flags_host_values_into_region(tmp_path):
+    findings = lint_source(tmp_path, "ops/plane_agg.py", """\
+        import jax
+        import numpy as np
+
+        @jax.jit
+        def _kernel(x):
+            return x * 2
+
+        def dispatch(vals):
+            arr = np.asarray(vals)
+            return _kernel(arr)
+
+        def dispatch_scalar(x):
+            return _kernel(3)
+    """)
+    hits = tpu19_of(findings)
+    assert len(hits) == 2
+    assert "host value `arr`" in hits[0].message
+    assert "bare Python scalar" in hits[1].message
+
+
+def test_transfer_rule_exempts_static_args_and_warm_boundary(tmp_path):
+    findings = lint_source(tmp_path, "ops/plane_agg.py", """\
+        import functools
+        import jax
+        import numpy as np
+
+        @functools.partial(jax.jit, static_argnums=(1,))
+        def _k2(x, n):
+            return x + n
+
+        def dispatch(x):
+            return _k2(x, 7)
+
+        def warm_verify_graphs(shapes):
+            buf = np.zeros(4)
+            return _k2(buf, 4)
+    """)
+    assert tpu19_of(findings) == []
+
+
+def test_transfer_rule_skips_positions_past_a_splat(tmp_path):
+    findings = lint_source(tmp_path, "ops/plane_agg.py", """\
+        import functools
+        import jax
+
+        @functools.partial(jax.jit, static_argnums=(2,))
+        def _k3(x, y, n):
+            return x + y + n
+
+        def dispatch(parts):
+            return _k3(*parts, 2)
+    """)
+    assert tpu19_of(findings) == []
+
+
+def test_transfer_rule_ignores_modules_off_the_hot_path(tmp_path):
+    findings = lint_source(tmp_path, "ops/other.py", """\
+        import jax
+
+        @jax.jit
+        def _kernel(x):
+            return x * 2
+
+        def dispatch(x):
+            return _kernel(3)
+    """)
+    assert tpu19_of(findings) == []
 
 
 # ---------------------------------------------------------------------------
@@ -744,7 +1024,7 @@ def test_self_check_whole_tree_against_baseline():
         cwd=REPO_ROOT, capture_output=True, text=True, timeout=300)
     report = json.loads(proc.stdout)
     assert report["version"] == 2
-    assert report["rules_version"] == 10
+    assert report["rules_version"] == 11
     new = [f for f in report["findings"] if f["new"]]
     assert proc.returncode == 0 and new == [], \
         "new lint findings:\n" + "\n".join(

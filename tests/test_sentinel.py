@@ -1,0 +1,140 @@
+"""Runtime compile/transfer sentinel: the steady state never recompiles.
+
+The invariant the whole slot budget rests on (docs/perf.md "compile
+discipline"): after warmup, a slot triggers ZERO new XLA compiles and
+ZERO implicit host<->device transfers. These tests drive the REAL
+compile-event listener (jax.monitoring on this build) through the real
+`SigAggPipeline` submit path with a genuine jitted kernel per slot —
+only the crypto stages are stubbed — and prove both directions:
+
+  * three pipelined same-shape slots inside `sentinel.steady_state()`
+    observe zero compiles and trip no transfer guard,
+  * a shape drift inside the window is counted, strikes the plane
+    breaker, and fails the `sigagg_steady_state_recompile` health rule,
+  * an implicit numpy→device transfer inside the window raises.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from charon_tpu.app.health import Checker, default_checks
+from charon_tpu.ops import guard, plane_agg, sentinel
+
+
+def _reset():
+    mode = sentinel.install()
+    sentinel.reset_for_testing()
+    guard.reset_for_testing()
+    return mode
+
+
+def _stub_stages_with_kernel(monkeypatch, kern, inputs):
+    """test_sigagg_pipeline's stage-stub shape, except stage 2 dispatches
+    a real jitted kernel on a precomputed device input per slot — the
+    compile/transfer behaviour under test is real, the crypto is not."""
+    calls = {"n": 0}
+
+    def dispatch(layout, pks, msgs):
+        i = calls["n"]
+        calls["n"] += 1
+        out = kern(inputs[i % len(inputs)])
+        out.block_until_ready()
+        return ("device", layout, out)
+
+    def finish(state, hash_fn=None):
+        return state[1]
+
+    monkeypatch.setattr(plane_agg, "_layout_slots", lambda batches: batches)
+    monkeypatch.setattr(plane_agg, "_fused_dispatch", dispatch)
+    monkeypatch.setattr(plane_agg, "_fused_finish", finish)
+
+    def emit(state, hash_fn=None):
+        return finish(state, hash_fn), (lambda: True)
+
+    monkeypatch.setattr(plane_agg, "_fused_emit", emit)
+
+
+def test_three_pipelined_slots_zero_steady_recompiles(monkeypatch):
+    mode = _reset()
+    assert mode in ("monitoring", "logger")
+
+    kern = jax.jit(lambda x: (x * 2 + 1).sum())
+    # ALL device inputs precomputed outside the window: jnp.asarray /
+    # jnp.zeros themselves compile tiny fill programs, and the transfer
+    # guard would (correctly) reject a lazy host->device put mid-slot.
+    inputs = [jnp.asarray(np.full((8,), i, dtype=np.int32))
+              for i in range(3)]
+    kern(inputs[0]).block_until_ready()  # warm the one shape bucket
+    warm_total, warm_steady = sentinel.counts()
+    assert warm_total >= 1, "listener saw no warmup compile at all"
+    assert warm_steady == 0
+
+    _stub_stages_with_kernel(monkeypatch, kern, inputs)
+    pipe = plane_agg.SigAggPipeline(depth=1, finish_workers=1)
+    try:
+        with sentinel.steady_state() as win:
+            for i in range(3):
+                pipe.submit(f"slot{i}", [], [])
+            pipe.drain()
+        assert win.compiles == 0, \
+            f"steady slots recompiled {win.compiles}x"
+    finally:
+        pipe.close()
+    total, steady = sentinel.counts()
+    assert steady == 0
+    assert total == warm_total  # nothing compiled after warmup, period
+    assert sentinel.compiles_summary() == {"warmup": warm_total,
+                                           "steady": 0}
+
+
+def test_shape_drift_in_window_counts_strikes_and_fails_health(monkeypatch):
+    mode = _reset()
+    if mode == "off":  # pragma: no cover — both hook paths exist here
+        pytest.skip("no compile telemetry on this jax build")
+    guard.configure(threshold=1, cooldown=30.0)  # one strike opens
+    try:
+        kern = jax.jit(lambda x: (x + 1).sum())
+        warm = jnp.zeros((4,), jnp.int32)
+        drift = jnp.zeros((5,), jnp.int32)  # new shape bucket, built early
+        kern(warm).block_until_ready()
+
+        checker = Checker(checks=default_checks(quorum_peers=0),
+                          interval=10.0, window=30.0)
+        checker.evaluate_once()  # baseline scrape before the window
+
+        with sentinel.steady_state() as win:
+            kern(drift).block_until_ready()  # recompile inside the window
+        assert win.compiles >= 1
+        assert sentinel.counts()[1] >= 1
+        # the compile struck the breaker (threshold 1 → open) ...
+        assert guard.BREAKER.state == guard.OPEN
+        # ... and the health rule sees the counter move in its window
+        assert "sigagg_steady_state_recompile" in checker.evaluate_once()
+    finally:
+        guard.reset_for_testing()
+
+
+def test_window_blocks_implicit_host_to_device_transfer():
+    _reset()
+    kern = jax.jit(lambda x: (x + 1).sum())
+    kern(jnp.zeros((4,), jnp.int32)).block_until_ready()
+    host = np.zeros((4,), np.int32)
+    with sentinel.steady_state():
+        with pytest.raises(Exception, match="[Tt]ransfer"):
+            kern(host).block_until_ready()
+    # outside the window the same call is legal again
+    assert int(kern(host)) == 4
+
+
+def test_reset_and_summary_shape():
+    _reset()
+    assert sentinel.counts() == (0, 0)
+    assert sentinel.compiles_summary() == {"warmup": 0, "steady": 0}
+    assert not sentinel.steady_armed()
+    with sentinel.steady_state(transfer=None):
+        assert sentinel.steady_armed()
+    assert not sentinel.steady_armed()
