@@ -160,7 +160,12 @@ def main(argv: list[str] | None = None) -> int:
     new = engine.new_findings(findings, baseline)
 
     if fmt == "json":
-        counts: dict[str, int] = {}
+        # seed every rule the report covers at 0 so it affirms each rule
+        # actually ran — a clean tree and a silently-skipped rule are
+        # different things to CI (--rule narrows the covered set)
+        counts: dict[str, int] = {
+            r.id: 0 for r in eng.rules
+            if not args.rules or r.id in set(args.rules)}
         for f in findings:
             counts[f.rule] = counts.get(f.rule, 0) + 1
         new_set = set(new)

@@ -734,6 +734,15 @@ class _GraphBuilder(ast.NodeVisitor):
                 cls_info = self.idx.classes.get(t)
                 if cls_info and rest[-1] in cls_info.methods:
                     return cls_info.methods[rest[-1]].qualname
+        # bare bound-method reference (`pool.submit(self._flush)`): same
+        # own-class lookup _resolve_method_call does for self.m() calls
+        if (head == "self" and len(rest) == 1 and self._fn_stack
+                and self._fn_stack[-1].class_name):
+            cls_info = self._own_class()
+            if cls_info is not None:
+                m = self._method_on(cls_info, rest[0])
+                if m is not None:
+                    return m.qualname
         return self._resolve_dotted(dotted)
 
     def _resolve_dotted(self, dotted: str | None) -> str | None:

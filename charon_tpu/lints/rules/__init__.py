@@ -4,6 +4,8 @@ from __future__ import annotations
 
 from .aio import UntrackedTaskRule
 from .asy import EventLoopBlockRule
+from .concurrency import (AtomicityRule, LockDisciplineRule,
+                          SharedStateRule)
 from .exc import BroadExceptRule, GuardSeamRule
 from .flt import FaultSiteRule
 from .iface import ProtocolImplRule
@@ -35,6 +37,9 @@ __all__ = [
     "TraceHazardRule",
     "JitCacheKeyRule",
     "TransferRule",
+    "SharedStateRule",
+    "LockDisciplineRule",
+    "AtomicityRule",
     "default_rules",
 ]
 
@@ -60,4 +65,7 @@ def default_rules() -> list:
         TraceHazardRule(),
         JitCacheKeyRule(),
         TransferRule(),
+        SharedStateRule(),
+        LockDisciplineRule(),
+        AtomicityRule(),
     ]

@@ -1,0 +1,914 @@
+"""LINT-CNC-020/021/022 — concurrency discipline over the call graph.
+
+The crypto plane is genuinely concurrent: the asyncio event loop, the
+stage-3 finish/verify ThreadPoolExecutor, slot-watchdog timers, and the
+API verify threads all touch lock-protected shared state in
+ops/{plane_agg,plane_store,guard,mesh,sentinel}.py.  The reference ships
+Go's race detector always-on in CI; this module is the Python
+thread+asyncio analogue, built on the whole-program ProjectIndex
+(lints/project.py) the same way the trace-discipline rules (rules/jit.py)
+are.  Three rules share one discovery pass (cached on the index):
+
+LINT-CNC-020 (SharedStateRule) — infer an **execution-context set** per
+function (event-loop roots from async defs and ``call_soon``-family
+callbacks, executor contexts from the index's executor edges, ``.submit``
+futures' ``add_done_callback`` targets, and ``threading.Thread``/``Timer``
+targets) propagated over precise internal call edges, plus a
+**lock-protection map** from ``with <lock>:`` enclosures — including the
+"caller holds self._lock" helper convention already annotated in
+plane_agg.py (a comment or docstring line matching ``caller holds
+<lock>`` in the def's first lines marks the whole body as lock-held).
+Module globals and ``self.``-attributes written from ≥2 distinct contexts
+with no lock common to every write are flagged: that is a data race the
+GIL does not save you from (torn compound updates, stale reads).
+
+LINT-CNC-021 (LockDisciplineRule) — three lock-hygiene checks:
+``await`` while holding a ``threading.Lock`` (the event loop parks every
+other contender for the await's full latency); a blocking device sync
+(``jax.device_get`` / ``block_until_ready``) held under ANY lock —
+generalizing LINT-TPU-007 beyond ``SigAggPipeline._lock`` (that class
+stays TPU-007's, to keep one finding per site) and following precise
+internal call edges out of the ``with`` body; inconsistent pairwise
+lock-acquisition order across the call graph (lock A taken under B in one
+path and B under A in another deadlocks two threads); re-acquiring a
+non-reentrant ``threading.Lock`` already held on the path; and bare
+``.acquire()`` without a ``finally``-guarded release.
+
+LINT-CNC-022 (AtomicityRule) — check-then-act on shared dicts/sets
+(``if k not in d: d[k] = …``) outside the lock that protects ``d``
+elsewhere, and gauge read-modify-writes (``g.set(… g.value() …)``)
+outside any lock — the metric primitives lock each *operation*, not the
+read-compute-write sequence.
+
+Scope: ops/ and core/ (the concurrent subsystems; findings elsewhere
+would be noise — utils/metrics locks internally, app/ wiring is
+single-threaded startup).  The runtime twin is
+``testutil/interleave.py``'s seeded-interleaving ``race_stress`` harness
+(docs/robustness.md): these rules prove the discipline statically, the
+harness perturbs the real schedules.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Iterable
+
+from ..engine import Finding, SourceFile
+from ..project import CallEdge, FunctionInfo, ProjectIndex, _flatten
+
+_SCOPE = ("ops", "core")
+
+# Execution-context labels (the "who runs this" axis of the race check).
+_LOOP = "event-loop"
+_EXECUTOR = "executor"
+_TIMER = "timer-thread"
+
+# TPU-007 owns device-syncs under SigAggPipeline._lock; CNC-021 covers
+# every OTHER lock so each site reports exactly once.
+_PIPELINE_CLASS = "SigAggPipeline"
+_DEVICE_SYNCS = ("device_get", "block_until_ready")
+
+# Receiver-method mutations that write the receiver's object in place.
+_MUTATORS = frozenset({
+    "append", "appendleft", "extend", "insert", "add", "discard", "remove",
+    "clear", "update", "pop", "popleft", "popitem", "setdefault",
+    "move_to_end",
+})
+
+# Constructors whose result a _MUTATORS call actually mutates in place;
+# `.add()`/`.update()` on anything else is a component method call, not a
+# shared-container write.
+_CONTAINER_CTORS = frozenset({
+    "dict", "list", "set", "frozenset", "OrderedDict", "defaultdict",
+    "deque", "Counter",
+})
+
+
+def _is_container_expr(e: ast.expr) -> bool:
+    if isinstance(e, (ast.Dict, ast.List, ast.Set, ast.DictComp,
+                      ast.ListComp, ast.SetComp)):
+        return True
+    if isinstance(e, ast.Call):
+        dotted = _flatten(e.func)
+        return (dotted is not None
+                and dotted.rpartition(".")[2] in _CONTAINER_CTORS)
+    return False
+
+
+# `caller holds self._lock` — the helper convention plane_agg.py annotates
+# on stage-3 scheduling helpers; matched in the def's docstring or its
+# first comment lines.
+_CALLER_HOLDS_RE = re.compile(
+    r"caller holds (?:the )?([A-Za-z_][\w.]*lock[\w.]*)", re.IGNORECASE)
+_HOLDS_SCAN_LINES = 4
+
+
+def _lock_token(expr: ast.expr) -> str | None:
+    """Dotted lock expression of a with-item (`self._lock`, `_h2c_lock`,
+    `mesh._lock`) — identified by a `lock`-suffixed final segment."""
+    dotted = _flatten(expr)
+    if dotted is None:
+        return None
+    if dotted.rpartition(".")[2].lower().endswith("lock"):
+        return dotted
+    return None
+
+
+def _same_frame(node: ast.AST) -> Iterable[ast.AST]:
+    """Descendants of `node` without entering nested defs/lambdas — their
+    bodies run later, off the current lock and context."""
+    for child in ast.iter_child_nodes(node):
+        if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.Lambda)):
+            continue
+        yield child
+        yield from _same_frame(child)
+
+
+def _frame_body(fn_node: ast.AST) -> list[ast.stmt]:
+    if isinstance(fn_node, ast.Lambda):
+        return [ast.Expr(value=fn_node.body)]
+    return list(getattr(fn_node, "body", []))
+
+
+@dataclass
+class _Facts:
+    """Per-function lexical facts the three rules consume."""
+
+    fn: FunctionInfo
+    rel: str
+    holds: frozenset = frozenset()      # caller-holds convention locks
+    # (var, line, locks-held) — module-global / self-attr write sites
+    writes: list = field(default_factory=list)
+    # canonical lock tokens this function lexically acquires (with-stmts)
+    acquired: set = field(default_factory=set)
+    # (outer, inner, line) lexically nested acquisitions
+    nested: list = field(default_factory=list)
+    # (line, locks-held, callee-qualname) internal calls under a lock
+    locked_calls: list = field(default_factory=list)
+    # lines of lexical blocking device syncs (callee label per line)
+    device_syncs: list = field(default_factory=list)
+    # (line, lock) awaits under a threading lock
+    lock_awaits: list = field(default_factory=list)
+    # (line, lock, callee) lexical device syncs under a lock
+    lock_syncs: list = field(default_factory=list)
+    # (line, lock) same non-reentrant lock re-entered lexically
+    self_deadlocks: list = field(default_factory=list)
+    # (token, line) bare .acquire() calls
+    raw_acquires: list = field(default_factory=list)
+    # tokens .release()d inside a finally block
+    finally_releases: set = field(default_factory=set)
+    # (var, line, locks-held) check-then-act sites
+    cta: list = field(default_factory=list)
+    # (receiver, line, locks-held) gauge set(...value()...) sites
+    gauge_rmw: list = field(default_factory=list)
+
+
+@dataclass
+class _Model:
+    """Whole-tree concurrency model shared by the three rules."""
+
+    facts: dict = field(default_factory=dict)        # qualname -> _Facts
+    contexts: dict = field(default_factory=dict)     # qualname -> set(str)
+    lock_kind: dict = field(default_factory=dict)    # canonical -> Lock/RLock
+    # (outer, inner) -> (rel, line, via-description), first site wins
+    order_pairs: dict = field(default_factory=dict)
+    # CNC-020 verdicts, computed once so CNC-022 can defer to them even
+    # when the rules run individually (--rule LINT-CNC-022):
+    # var -> (rel, line, ctx-labels, writer-shorts)
+    shared_unlocked: dict = field(default_factory=dict)
+
+
+def _reach(index: ProjectIndex, start: str) -> set:
+    """Functions reachable from `start` over precise internal call edges
+    (the helpers a locked call executes on this thread)."""
+    seen = {start}
+    stack = [start]
+    while stack:
+        for e in index.out_edges(stack.pop()):
+            if (e.kind == "call" and e.internal and e.precise
+                    and e.callee not in seen):
+                seen.add(e.callee)
+                stack.append(e.callee)
+    return seen
+
+
+def _module_globals(mod) -> set[str]:
+    """Names assigned at module top level (plus `global X` declarations
+    anywhere in the module) — the shared-state candidates."""
+    names: set[str] = set(mod.bindings)
+    for node in mod.src.tree.body:
+        targets = []
+        if isinstance(node, ast.Assign):
+            targets = node.targets
+        elif isinstance(node, (ast.AnnAssign, ast.AugAssign)):
+            targets = [node.target]
+        for t in targets:
+            if isinstance(t, ast.Name):
+                names.add(t.id)
+    for node in ast.walk(mod.src.tree):
+        if isinstance(node, ast.Global):
+            names.update(node.names)
+    return names
+
+
+def _threadlocal_names(mod) -> set[str]:
+    """Module-level names bound to threading.local() — confined per
+    thread by construction, never shared state."""
+    return {name for name, b in mod.bindings.items()
+            if b.target.rpartition(".")[2] == "local"}
+
+
+def _own_class(fn: FunctionInfo, index: ProjectIndex):
+    """Nearest enclosing ClassInfo of `fn` (methods and their nested
+    defs), from qualname prefixes."""
+    parts = fn.qualname.split(".")
+    for cut in range(len(parts) - 1, 0, -1):
+        cls = index.classes.get(".".join(parts[:cut]))
+        if cls is not None:
+            return cls
+    return None
+
+
+class _ModelBuilder:
+    def __init__(self, index: ProjectIndex):
+        self.index = index
+        self.model = _Model()
+        self.containers: set[str] = set()
+
+    # -- lock identity -----------------------------------------------------
+
+    def _canon(self, token: str, fn: FunctionInfo) -> str:
+        """Canonical identity for a lock token at a use site: `self._lock`
+        keys on the enclosing class, module names resolve through imports
+        so `mesh._lock` and a local `_lock` in mesh.py are one lock."""
+        if token == "self" or token.startswith("self."):
+            cls = _own_class(fn, self.index)
+            attr = token[5:] if token.startswith("self.") else token
+            if cls is not None:
+                return f"{cls.qualname}.{attr}"
+            return f"{fn.qualname}.self.{attr}"
+        resolved = (self.index.resolve(f"{fn.module.name}.{token}")
+                    or self.index.resolve(token))
+        return resolved or f"{fn.module.name}.{token}"
+
+    def _collect_lock_kinds(self) -> None:
+        """Lock() vs RLock() per canonical lock, from module-level
+        bindings and `self.X = threading.[R]Lock()` constructor assigns."""
+        for mod in self.index.modules.values():
+            for name, b in mod.bindings.items():
+                tail = b.target.rpartition(".")[2]
+                if tail in ("Lock", "RLock"):
+                    self.model.lock_kind[f"{mod.name}.{name}"] = tail
+        for cls in self.index.classes.values():
+            for meth in cls.methods.values():
+                for node in ast.walk(meth.node):
+                    if not (isinstance(node, ast.Assign)
+                            and isinstance(node.value, ast.Call)):
+                        continue
+                    ctor = _flatten(node.value.func)
+                    tail = ctor.rpartition(".")[2] if ctor else ""
+                    if tail not in ("Lock", "RLock"):
+                        continue
+                    for t in node.targets:
+                        if (isinstance(t, ast.Attribute)
+                                and isinstance(t.value, ast.Name)
+                                and t.value.id == "self"):
+                            self.model.lock_kind[
+                                f"{cls.qualname}.{t.attr}"] = tail
+
+    def _collect_containers(self) -> None:
+        """Canonical keys of module globals / self-attrs bound to dict /
+        list / set-family objects — the only receivers on which a
+        mutator-method call counts as a shared-state write."""
+        def targets_of(node):
+            if isinstance(node, ast.Assign):
+                return node.targets, node.value
+            if isinstance(node, ast.AnnAssign) and node.value is not None:
+                return [node.target], node.value
+            return (), None
+
+        for mod in self.index.modules.values():
+            for node in mod.src.tree.body:
+                targets, value = targets_of(node)
+                if value is None or not _is_container_expr(value):
+                    continue
+                for t in targets:
+                    if isinstance(t, ast.Name):
+                        self.containers.add(f"{mod.name}.{t.id}")
+        for cls in self.index.classes.values():
+            for meth in cls.methods.values():
+                for node in ast.walk(meth.node):
+                    targets, value = targets_of(node)
+                    if value is None or not _is_container_expr(value):
+                        continue
+                    for t in targets:
+                        if (isinstance(t, ast.Attribute)
+                                and isinstance(t.value, ast.Name)
+                                and t.value.id == "self"):
+                            self.containers.add(f"{cls.qualname}.{t.attr}")
+
+    # -- caller-holds convention -------------------------------------------
+
+    def _body_holds(self, fn: FunctionInfo) -> frozenset:
+        src = fn.module.src
+        lines = src.text.splitlines()
+        start = getattr(fn.node, "lineno", 1) - 1
+        window = lines[start:start + _HOLDS_SCAN_LINES]
+        doc = ast.get_docstring(fn.node) if not isinstance(
+            fn.node, ast.Lambda) else None
+        if doc:
+            window.append(doc.split("\n\n")[0])
+        held = set()
+        for text in window:
+            for m in _CALLER_HOLDS_RE.finditer(text):
+                held.add(self._canon(m.group(1), fn))
+        return frozenset(held)
+
+    # -- context inference -------------------------------------------------
+
+    def _resolve_target(self, token: str | None,
+                        fn: FunctionInfo) -> str | None:
+        """Resolve a callback/target token at a call site inside `fn` to
+        an indexed function qualname (nested defs first, then self
+        methods, then module scope)."""
+        if not token:
+            return None
+        nested = f"{fn.qualname}.{token}"
+        if nested in self.index.functions:
+            return nested
+        if token.startswith("self."):
+            cls = _own_class(fn, self.index)
+            if cls is not None:
+                meth = cls.methods.get(token[5:])
+                if meth is not None:
+                    return meth.qualname
+            return None
+        resolved = (self.index.resolve(f"{fn.module.name}.{token}")
+                    or self.index.resolve(token))
+        if resolved in self.index.functions:
+            return resolved
+        return None
+
+    def _context_roots(self) -> dict:
+        roots: dict[str, set] = {}
+
+        def mark(qual: str | None, ctx: str) -> None:
+            if qual is not None:
+                roots.setdefault(qual, set()).add(ctx)
+
+        for qual, fn in self.index.functions.items():
+            if fn.is_async:
+                mark(qual, _LOOP)
+        for edges in self.index.edges.values():
+            for e in edges:
+                if e.kind == "executor" and e.internal:
+                    # aio.spawn/create_task hand a *coroutine* to the
+                    # event loop; only sync callables actually hop to a
+                    # worker thread (run_in_executor/submit/to_thread).
+                    callee = self.index.functions.get(e.callee)
+                    if callee is not None and callee.is_async:
+                        mark(e.callee, _LOOP)
+                    else:
+                        mark(e.callee, _EXECUTOR)
+        # threading.Thread/Timer targets and future/loop callbacks: the
+        # graph has plain ref edges for these, so classify them here.
+        for qual, fn in self.index.functions.items():
+            for node in ast.walk(fn.node):
+                if not isinstance(node, ast.Call):
+                    continue
+                dotted = _flatten(node.func) or ""
+                tail = dotted.rpartition(".")[2]
+                args = node.args
+                kw = {k.arg: k.value for k in node.keywords}
+                if tail == "Thread":
+                    tgt = kw.get("target")
+                    mark(self._resolve_target(_flatten(tgt) if tgt is not
+                                              None else None, fn), _TIMER)
+                elif tail == "Timer":
+                    tgt = kw.get("function") or (
+                        args[1] if len(args) > 1 else None)
+                    mark(self._resolve_target(_flatten(tgt) if tgt is not
+                                              None else None, fn), _TIMER)
+                elif tail == "add_done_callback" and args:
+                    # future callbacks run on whichever worker completes
+                    # the future — executor context
+                    mark(self._resolve_target(_flatten(args[0]), fn),
+                         _EXECUTOR)
+                elif tail in ("call_soon", "call_soon_threadsafe") and args:
+                    mark(self._resolve_target(_flatten(args[0]), fn), _LOOP)
+                elif tail in ("call_later", "call_at") and len(args) > 1:
+                    mark(self._resolve_target(_flatten(args[1]), fn), _LOOP)
+        return roots
+
+    def _propagate_contexts(self, roots: dict) -> None:
+        """BFS context labels over precise internal `call` edges: a helper
+        a loop-context function calls synchronously runs on the loop too.
+        Executor edges do NOT propagate the caller's context — the hop IS
+        the context change (the callee was rooted above)."""
+        contexts = {q: set(v) for q, v in roots.items()}
+        queue = list(contexts)
+        while queue:
+            cur = queue.pop(0)
+            for e in self.index.out_edges(cur):
+                if e.kind != "call" or not e.internal or not e.precise:
+                    continue
+                have = contexts.setdefault(e.callee, set())
+                if not contexts[cur] <= have:
+                    have.update(contexts[cur])
+                    queue.append(e.callee)
+        self.model.contexts = contexts
+
+    # -- per-function lexical scan -----------------------------------------
+
+    def build(self) -> _Model:
+        self._collect_lock_kinds()
+        self._collect_containers()
+        self._propagate_contexts(self._context_roots())
+        mod_globals = {m.name: _module_globals(m)
+                       for m in self.index.modules.values()}
+        mod_tls = {m.name: _threadlocal_names(m)
+                   for m in self.index.modules.values()}
+        for qual, fn in self.index.functions.items():
+            facts = _Facts(fn=fn, rel=fn.module.src.rel,
+                           holds=self._body_holds(fn))
+            _FnScan(self, fn, facts,
+                    mod_globals[fn.module.name],
+                    mod_tls[fn.module.name]).run()
+            self.model.facts[qual] = facts
+        self._interprocedural_pairs()
+        self._shared_verdicts()
+        return self.model
+
+    # -- CNC-020 verdicts (shared with CNC-022's dedupe) -------------------
+
+    def _shared_verdicts(self) -> None:
+        by_var: dict[str, list] = {}
+        for qual, facts in self.model.facts.items():
+            ctxs = self.model.contexts.get(qual, set())
+            for var, line, locks in facts.writes:
+                by_var.setdefault(var, []).append(
+                    (facts, line, locks | facts.holds, ctxs))
+        for var in sorted(by_var):
+            sites = [s for s in by_var[var] if s[3]]  # context-ful writers
+            ctx_union = set()
+            for _f, _l, _k, ctxs in sites:
+                ctx_union |= ctxs
+            if len(ctx_union) < 2:
+                continue
+            common = None
+            for _f, _l, locks, _c in sites:
+                common = set(locks) if common is None else common & locks
+            if common:
+                continue
+            # anchor the finding at the least-protected write site
+            facts, line, _locks, _c = min(
+                sites, key=lambda s: (len(s[2]), s[0].rel, s[1]))
+            writers = sorted({_short(s[0].fn.qualname) for s in sites})
+            self.model.shared_unlocked[var] = (
+                facts.rel, line, tuple(sorted(ctx_union)), tuple(writers))
+
+    # -- interprocedural lock-order + device-sync reach --------------------
+
+    def _interprocedural_pairs(self) -> None:
+        pairs = self.model.order_pairs
+        reach_memo: dict[str, set] = {}
+        for qual, facts in self.model.facts.items():
+            for outer, inner, line in facts.nested:
+                pairs.setdefault((outer, inner), (facts.rel, line, ""))
+            for line, locks, callee in facts.locked_calls:
+                if callee not in reach_memo:
+                    reach_memo[callee] = _reach(self.index, callee)
+                for reached in reach_memo[callee]:
+                    rf = self.model.facts.get(reached)
+                    if rf is None:
+                        continue
+                    for inner in rf.acquired:
+                        via = "" if reached == callee else f" via {callee}"
+                        for outer in locks:
+                            pairs.setdefault(
+                                (outer, inner),
+                                (facts.rel, line,
+                                 f" (calling {reached.rpartition('.')[2]}"
+                                 f"{via})"))
+
+
+class _FnScan:
+    """One function's lexical walk: writes, lock regions, patterns."""
+
+    def __init__(self, builder: _ModelBuilder, fn: FunctionInfo,
+                 facts: _Facts, mod_globals: set, mod_tls: set):
+        self.b = builder
+        self.fn = fn
+        self.facts = facts
+        self.mod_globals = mod_globals
+        self.mod_tls = mod_tls
+        self.globals_decl: set[str] = set()
+        self.locals_: set[str] = set(fn.params)
+        self.in_init = fn.name in ("__init__", "__new__", "__post_init__")
+        # internal call edges by line, for locked-call resolution
+        self.edges_at: dict[int, list[CallEdge]] = {}
+        for e in builder.index.out_edges(fn.qualname):
+            if e.kind == "call" and e.internal and e.precise:
+                self.edges_at.setdefault(e.line, []).append(e)
+
+    def run(self) -> None:
+        body = _frame_body(self.fn.node)
+        # pre-pass: global decls, local bindings, finally releases
+        for stmt in body:
+            for node in [stmt, *_same_frame(stmt)]:
+                if isinstance(node, ast.Global):
+                    self.globals_decl.update(node.names)
+                elif isinstance(node, ast.Assign):
+                    for t in node.targets:
+                        if isinstance(t, ast.Name):
+                            self.locals_.add(t.id)
+                elif isinstance(node, (ast.For, ast.AsyncFor)):
+                    for t in ast.walk(node.target):
+                        if isinstance(t, ast.Name):
+                            self.locals_.add(t.id)
+                elif isinstance(node, ast.Try):
+                    for fin in node.finalbody:
+                        for sub in [fin, *_same_frame(fin)]:
+                            if (isinstance(sub, ast.Call)
+                                    and isinstance(sub.func, ast.Attribute)
+                                    and sub.func.attr == "release"):
+                                tok = _flatten(sub.func.value)
+                                if tok:
+                                    self.facts.finally_releases.add(tok)
+        self.locals_ -= self.globals_decl
+        held = tuple(sorted(self.facts.holds))
+        for stmt in body:
+            self._scan(stmt, held)
+
+    # -- shared-variable identity ------------------------------------------
+
+    def _var_of(self, node: ast.expr) -> str | None:
+        """Canonical shared-variable key for a write target/receiver:
+        module global (`mod.X`) or self attribute (`mod.Cls.attr`)."""
+        if isinstance(node, ast.Name):
+            name = node.id
+            if name in self.mod_tls:
+                return None
+            if name in self.globals_decl or (
+                    name in self.mod_globals and name not in self.locals_):
+                return f"{self.fn.module.name}.{name}"
+            return None
+        if (isinstance(node, ast.Attribute)
+                and isinstance(node.value, ast.Name)):
+            if node.value.id == "self" and not self.in_init:
+                cls = _own_class(self.fn, self.b.index)
+                if cls is not None:
+                    return f"{cls.qualname}.{node.attr}"
+                return None
+            if (node.value.id in self.mod_tls
+                    or node.value.id in self.locals_):
+                return None
+        return None
+
+    def _record_write(self, target: ast.expr, line: int, held) -> None:
+        var = None
+        if isinstance(target, (ast.Name, ast.Attribute)):
+            var = self._var_of(target)
+        elif isinstance(target, ast.Subscript):
+            var = self._var_of(target.value)
+        if var is not None:
+            self.facts.writes.append((var, line, frozenset(held)))
+
+    # -- the walk ----------------------------------------------------------
+
+    def _scan(self, node: ast.AST, held: tuple) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            return  # separate frame: runs later, off these locks
+        if isinstance(node, ast.With):
+            self._scan_with(node, held)
+            return
+        if isinstance(node, ast.Await) and held:
+            self.facts.lock_awaits.append((node.lineno, held[-1]))
+        elif isinstance(node, ast.Assign):
+            for t in node.targets:
+                self._record_write(t, node.lineno, held)
+        elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+            self._record_write(node.target, node.lineno, held)
+        elif isinstance(node, ast.Delete):
+            for t in node.targets:
+                self._record_write(t, node.lineno, held)
+        elif isinstance(node, ast.If):
+            self._check_then_act(node, held)
+        elif isinstance(node, ast.Call):
+            self._scan_call(node, held)
+        for child in ast.iter_child_nodes(node):
+            self._scan(child, held)
+
+    def _lock_canon(self, expr: ast.expr) -> str | None:
+        """Canonical lock identity of an expression, or None if it isn't
+        one: either the name says so (`…lock` suffix — covers locks passed
+        in whose construction we never see) or the canonical binding was
+        observed assigned from threading.Lock()/RLock()."""
+        tok = _lock_token(expr)
+        if tok is not None:
+            return self.b._canon(tok, self.fn)
+        dotted = _flatten(expr)
+        if dotted is None:
+            return None
+        c = self.b._canon(dotted, self.fn)
+        return c if c in self.b.model.lock_kind else None
+
+    def _scan_with(self, node: ast.With, held: tuple) -> None:
+        canon: list[str] = []
+        for item in node.items:
+            c = self._lock_canon(item.context_expr)
+            if c is None:
+                continue
+            canon.append(c)
+            self.facts.acquired.add(c)
+            for outer in held:
+                if outer == c:
+                    if self.b.model.lock_kind.get(c) == "Lock":
+                        self.facts.self_deadlocks.append((node.lineno, c))
+                else:
+                    self.facts.nested.append((outer, c, node.lineno))
+            self._scan(item.context_expr, held)
+        inner = held + tuple(c for c in canon if c not in held)
+        for stmt in node.body:
+            self._scan(stmt, inner)
+
+    def _scan_call(self, node: ast.Call, held: tuple) -> None:
+        func = node.func
+        dotted = _flatten(func) or ""
+        tail = dotted.rpartition(".")[2]
+        # blocking device syncs (module form jax.device_get, or the
+        # method form .block_until_ready() on an array handle)
+        if isinstance(func, ast.Attribute) and func.attr in _DEVICE_SYNCS:
+            is_module_form = dotted.startswith(("jax.",))
+            if is_module_form or func.attr == "block_until_ready":
+                label = (f"jax.{func.attr}" if is_module_form
+                         else f".{func.attr}")
+                self.facts.device_syncs.append((node.lineno, label))
+                if held:
+                    self.facts.lock_syncs.append(
+                        (node.lineno, held[-1], label))
+        if tail == "acquire" and isinstance(func, ast.Attribute):
+            tok = _flatten(func.value)
+            if tok and (tok.rpartition(".")[2].lower().endswith("lock")
+                        or self._lock_canon(func.value) is not None):
+                self.facts.raw_acquires.append((tok, node.lineno))
+        # gauge RMW: X.set(... X.value() ...)
+        if tail == "set" and isinstance(func, ast.Attribute):
+            recv = _flatten(func.value)
+            if recv is not None:
+                for arg in node.args:
+                    for sub in ast.walk(arg):
+                        if (isinstance(sub, ast.Call)
+                                and isinstance(sub.func, ast.Attribute)
+                                and sub.func.attr == "value"
+                                and _flatten(sub.func.value) == recv):
+                            self.facts.gauge_rmw.append(
+                                (recv, node.lineno, frozenset(held)))
+        # mutator-method writes on shared container receivers
+        if tail in _MUTATORS and isinstance(func, ast.Attribute):
+            var = self._var_of(func.value)
+            if var is not None and var in self.b.containers:
+                self.facts.writes.append(
+                    (var, node.lineno, frozenset(held)))
+        # internal calls made while holding a lock
+        if held:
+            for e in self.edges_at.get(node.lineno, ()):
+                self.facts.locked_calls.append(
+                    (node.lineno, frozenset(held), e.callee))
+
+    def _check_then_act(self, node: ast.If, held: tuple) -> None:
+        """`if k not in d: d[k] = …` / `if d.get(k) is None: d[k] = …` on
+        a shared receiver — record with the locks held at the test."""
+        recv = self._cta_receiver(node.test)
+        if recv is None:
+            return
+        var = self._var_of_token(recv)
+        if var is None:
+            return
+        for stmt in node.body:
+            for sub in [stmt, *_same_frame(stmt)]:
+                stored = None
+                if isinstance(sub, ast.Assign):
+                    for t in sub.targets:
+                        if (isinstance(t, ast.Subscript)
+                                and _flatten(t.value) == recv):
+                            stored = sub
+                elif (isinstance(sub, ast.Call)
+                        and isinstance(sub.func, ast.Attribute)
+                        and sub.func.attr in _MUTATORS
+                        and _flatten(sub.func.value) == recv):
+                    stored = sub
+                if stored is not None:
+                    self.facts.cta.append(
+                        (var, node.lineno, frozenset(held)))
+                    return
+
+    @staticmethod
+    def _cta_receiver(test: ast.expr) -> str | None:
+        # `k not in d`
+        if (isinstance(test, ast.Compare) and len(test.ops) == 1
+                and isinstance(test.ops[0], ast.NotIn)):
+            return _flatten(test.comparators[0])
+        # `d.get(k) is None`  /  `not d.get(k)`
+        def get_recv(e: ast.expr) -> str | None:
+            if (isinstance(e, ast.Call) and isinstance(e.func, ast.Attribute)
+                    and e.func.attr == "get"):
+                return _flatten(e.func.value)
+            return None
+        if (isinstance(test, ast.Compare) and len(test.ops) == 1
+                and isinstance(test.ops[0], ast.Is)
+                and isinstance(test.comparators[0], ast.Constant)
+                and test.comparators[0].value is None):
+            return get_recv(test.left)
+        if isinstance(test, ast.UnaryOp) and isinstance(test.op, ast.Not):
+            return get_recv(test.operand)
+        return None
+
+    def _var_of_token(self, token: str) -> str | None:
+        head = token.split(".")[0]
+        if head == "self" and token.count(".") == 1:
+            return self._var_of(ast.Attribute(
+                value=ast.Name(id="self", ctx=ast.Load()),
+                attr=token.split(".")[1], ctx=ast.Load()))
+        if "." not in token:
+            return self._var_of(ast.Name(id=token, ctx=ast.Load()))
+        return None
+
+
+def _model(index: ProjectIndex) -> _Model:
+    cached = getattr(index, "_cnc_model_cache", None)
+    if cached is None:
+        cached = _ModelBuilder(index).build()
+        index._cnc_model_cache = cached
+    return cached
+
+
+def _in_scope(rel: str) -> bool:
+    return any(seg in _SCOPE for seg in rel.split("/")[:-1])
+
+
+def _short(qual: str) -> str:
+    """Trailing class.method / function segment for messages."""
+    parts = qual.split(".")
+    return ".".join(parts[-2:]) if len(parts) > 1 else qual
+
+
+class SharedStateRule:
+    id = "LINT-CNC-020"
+    description = ("module globals / self-attributes written from ≥2 "
+                   "execution contexts (event loop, executor workers, "
+                   "timer threads) must share one protecting lock")
+    project_scope = "tree"
+
+    def check_project(self, index: ProjectIndex,
+                      root: Path) -> Iterable[Finding]:
+        model = _model(index)
+        for var, (rel, line, ctxs, writers) in sorted(
+                model.shared_unlocked.items()):
+            if not _in_scope(rel):
+                continue
+            shown = ", ".join(writers[:3]) + (
+                f" +{len(writers) - 3} more" if len(writers) > 3 else "")
+            yield Finding(
+                rel, line, self.id,
+                f"`{var}` is written from {len(ctxs)} execution contexts "
+                f"({', '.join(ctxs)}) with no lock common to every write "
+                f"(writers: {shown}); hold one lock at every write or "
+                "confine the writes to a single context")
+
+
+class LockDisciplineRule:
+    id = "LINT-CNC-021"
+    description = ("no await or blocking device sync under a threading "
+                   "lock; lock-acquisition order must be globally "
+                   "consistent; .acquire() needs a finally-guarded release")
+    project_scope = "tree"
+
+    def check_project(self, index: ProjectIndex,
+                      root: Path) -> Iterable[Finding]:
+        model = _model(index)
+        yield from self._site_checks(index, model)
+        yield from self._order_checks(model)
+
+    def _site_checks(self, index: ProjectIndex,
+                     model: _Model) -> Iterable[Finding]:
+        reach_memo: dict[str, set] = {}
+        for qual, facts in sorted(model.facts.items()):
+            if not _in_scope(facts.rel):
+                continue
+            cls = _own_class(facts.fn, index)
+            in_pipeline = cls is not None and cls.name == _PIPELINE_CLASS
+            for line, lock in facts.lock_awaits:
+                yield Finding(
+                    facts.rel, line, self.id,
+                    f"`await` while holding `{lock}` parks every other "
+                    "contender of the lock for the await's full latency "
+                    "(and can deadlock if the awaited task needs it); "
+                    "release before awaiting, or use an asyncio lock via "
+                    "`async with`")
+            if not in_pipeline:  # TPU-007 owns SigAggPipeline._lock
+                for line, lock, label in facts.lock_syncs:
+                    yield Finding(
+                        facts.rel, line, self.id,
+                        f"`{label}(...)` (a blocking device sync) while "
+                        f"holding `{lock}` serializes every contender "
+                        "behind this device wait; fence/readback must run "
+                        "after the lock is released")
+                for line, locks, callee in facts.locked_calls:
+                    if callee not in reach_memo:
+                        reach_memo[callee] = _reach(index, callee)
+                    hit = self._first_sync(model, reach_memo[callee])
+                    if hit is not None:
+                        fname, label = hit
+                        yield Finding(
+                            facts.rel, line, self.id,
+                            f"call under `{sorted(locks)[-1]}` reaches "
+                            f"`{label}` in {_short(fname)} (a blocking "
+                            "device sync executed while the lock is held); "
+                            "hoist the device wait out of the locked "
+                            "region")
+            for line, lock in facts.self_deadlocks:
+                yield Finding(
+                    facts.rel, line, self.id,
+                    f"non-reentrant `{lock}` re-acquired while already "
+                    "held — this self-deadlocks; use threading.RLock or "
+                    "split the helper out of the locked region")
+            for tok, line in facts.raw_acquires:
+                if tok not in facts.finally_releases:
+                    yield Finding(
+                        facts.rel, line, self.id,
+                        f"`{tok}.acquire()` without a finally-guarded "
+                        f"`{tok}.release()` in `{facts.fn.name}`; an "
+                        "exception between them wedges every other user — "
+                        "use `with` or try/finally")
+
+    @staticmethod
+    def _first_sync(model: _Model, reached: set):
+        for fname in sorted(reached):
+            rf = model.facts.get(fname)
+            if rf is not None and rf.device_syncs:
+                return fname, rf.device_syncs[0][1]
+        return None
+
+    def _order_checks(self, model: _Model) -> Iterable[Finding]:
+        seen: set = set()
+        for (a, b), (rel, line, via) in sorted(model.order_pairs.items()):
+            if a == b or frozenset((a, b)) in seen:
+                continue
+            rev = model.order_pairs.get((b, a))
+            if rev is None:
+                continue
+            seen.add(frozenset((a, b)))
+            if not _in_scope(rel):
+                continue
+            yield Finding(
+                rel, line, self.id,
+                f"lock order inversion: `{b}` is acquired while holding "
+                f"`{a}`{via}, but `{a}` is acquired while holding `{b}` "
+                f"in {rev[0]} — two threads taking the locks in opposite "
+                "orders deadlock; pick one global order")
+
+
+class AtomicityRule:
+    id = "LINT-CNC-022"
+    description = ("check-then-act on shared dicts and gauge "
+                   "read-modify-writes must run under the protecting "
+                   "lock — the compound sequence is not atomic")
+    project_scope = "tree"
+
+    def check_project(self, index: ProjectIndex,
+                      root: Path) -> Iterable[Finding]:
+        model = _model(index)
+        # protecting locks per var: every lock observed at any write site
+        protect: dict[str, set] = {}
+        for facts in model.facts.values():
+            for var, _line, locks in facts.writes:
+                protect.setdefault(var, set()).update(locks | facts.holds)
+        for qual, facts in sorted(model.facts.items()):
+            if not _in_scope(facts.rel):
+                continue
+            for var, line, held in facts.cta:
+                guards = protect.get(var, set())
+                if var in model.shared_unlocked:
+                    continue  # CNC-020 already reported the variable
+                if guards and not (guards & (held | facts.holds)):
+                    yield Finding(
+                        facts.rel, line, self.id,
+                        f"check-then-act on `{var}` outside its protecting "
+                        f"lock ({', '.join(sorted(guards))}): another "
+                        "thread can interleave between the membership test "
+                        "and the store — move both under the lock")
+            for recv, line, held in facts.gauge_rmw:
+                if held or facts.holds:
+                    continue
+                yield Finding(
+                    facts.rel, line, self.id,
+                    f"`{recv}.set(… {recv}.value() …)` is a non-atomic "
+                    "read-modify-write: the metric locks each operation, "
+                    "not the sequence, so concurrent updates lose "
+                    "increments — hold a lock around it or use `.inc()`")

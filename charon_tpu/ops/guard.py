@@ -75,20 +75,26 @@ _watchdog_c = metrics.counter(
 CLOSED, HALF_OPEN, OPEN = 0.0, 1.0, 2.0
 
 _device_types_cache: tuple | None = None
+# classify() runs on executor workers, watchdog timers, AND the event loop
+# (any of them can see the first device failure); the lazy-import init must
+# not race a concurrent reset_for_testing.
+_device_types_lock = threading.Lock()
 
 
 def _device_types() -> tuple:
     """Exception classes that mean THE DEVICE failed, not the inputs."""
     global _device_types_cache
     if _device_types_cache is None:
-        types: list = [faults.DeviceLostFault, TimeoutError]
-        try:
-            import jax
+        with _device_types_lock:
+            if _device_types_cache is None:
+                types: list = [faults.DeviceLostFault, TimeoutError]
+                try:
+                    import jax
 
-            types.append(jax.errors.JaxRuntimeError)
-        except Exception:  # noqa: BLE001 — no jax == nothing to classify
-            pass
-        _device_types_cache = tuple(types)
+                    types.append(jax.errors.JaxRuntimeError)
+                except Exception:  # noqa: BLE001 — no jax == nothing to classify
+                    pass
+                _device_types_cache = tuple(types)
     return _device_types_cache
 
 
@@ -231,7 +237,8 @@ def configure(threshold: int | None = None, cooldown: float | None = None,
 def reset_for_testing() -> None:
     global BREAKER, _device_types_cache
     BREAKER = CircuitBreaker()
-    _device_types_cache = None
+    with _device_types_lock:
+        _device_types_cache = None
 
 
 def allow_device_dispatch() -> bool:

@@ -27,6 +27,7 @@ Replaces the hot paths of herumi's C++ G1/G2/Fp arithmetic
 from __future__ import annotations
 
 import functools
+import threading
 
 import jax
 import jax.numpy as jnp
@@ -58,6 +59,9 @@ def _pspec():
 
 
 _interpret_cache: list = []
+# first call can come from the event loop, a verify worker, or a watchdog
+# timer at once; backend probing must happen exactly once
+_interpret_lock = threading.Lock()
 
 
 def _interpret() -> bool:
@@ -66,7 +70,9 @@ def _interpret() -> bool:
     (_cpu_point_op / ops/field.py) — NOT pallas interpret mode, which
     evaluates the body eagerly op-by-op and is ~1000x slower."""
     if not _interpret_cache:
-        _interpret_cache.append(jax.default_backend() == "cpu")
+        with _interpret_lock:
+            if not _interpret_cache:
+                _interpret_cache.append(jax.default_backend() == "cpu")
     return _interpret_cache[0]
 
 
@@ -140,6 +146,7 @@ def _note_trace() -> None:
     """Called from the trace-time bodies of the WINDOW/POW_WINDOW-dependent
     jits so enable_compile_lean can detect too-late activation."""
     global _TRACED
+    # lint: disable=LINT-CNC-020 — monotonic one-way bool latch: the store is atomic and the only reader gates a startup-time config flip
     _TRACED = True
 
 

@@ -305,13 +305,21 @@ def g1_plane_from_compressed(pks: list[bytes], Bp: int,
 _EXP_SQRT = None  # (p+1)/4 window digits, lazily built
 _EXP_INV = None   # p-2 window digits
 _EXP_34 = None    # (p-3)/4 window digits
+# The tables depend on POW_WINDOW, which enable_compile_lean may still flip
+# at startup, so they must stay lazy — and the first decode can arrive from
+# the event loop, a verify worker, and a watchdog recovery at once.
+_exp_lock = threading.Lock()
 
 
 def _sqrt_inv_bits():
     global _EXP_SQRT, _EXP_INV
     if _EXP_SQRT is None:
-        _EXP_SQRT = PP.exp_digits((PF.P + 1) // 4)
-        _EXP_INV = PP.exp_digits(PF.P - 2)
+        with _exp_lock:
+            if _EXP_SQRT is None:
+                # _EXP_INV first: an unlocked reader that sees _EXP_SQRT
+                # non-None must also see _EXP_INV populated
+                _EXP_INV = PP.exp_digits(PF.P - 2)
+                _EXP_SQRT = PP.exp_digits((PF.P + 1) // 4)
     return _EXP_SQRT, _EXP_INV
 
 
@@ -320,7 +328,9 @@ def _e34_bits():
     1/root = root·s² in the same scan (p ≡ 3 mod 4)."""
     global _EXP_34
     if _EXP_34 is None:
-        _EXP_34 = PP.exp_digits((PF.P - 3) // 4)
+        with _exp_lock:
+            if _EXP_34 is None:
+                _EXP_34 = PP.exp_digits((PF.P - 3) // 4)
     return _EXP_34
 
 
@@ -355,7 +365,10 @@ def _gt_half_std(plane):
     value > (p-1)/2 (the lexicographic y-sign threshold)."""
     global _HALF_LIMBS
     if _HALF_LIMBS is None:
-        _HALF_LIMBS = [int(v) for v in F.limbs_from_int((PF.P - 1) // 2)]
+        with _exp_lock:
+            if _HALF_LIMBS is None:
+                _HALF_LIMBS = [int(v)
+                               for v in F.limbs_from_int((PF.P - 1) // 2)]
     x = plane[0]
     gt = jnp.zeros(x.shape[-2:], bool)
     eq = jnp.ones(x.shape[-2:], bool)

@@ -1,0 +1,164 @@
+"""Deterministic-interleaving race harness: the runtime half of the
+LINT-CNC-02x concurrency discipline (lints/rules/concurrency.py).
+
+The static rules prove every shared write names a lock; this module
+perturbs the *schedules* so the lock discipline is exercised, not just
+declared — the Python analogue of running the suite under `go test -race`
+with a seed sweep. Two levers, both seeded and both restored on exit:
+
+- ``sys.setswitchinterval`` dropped to a seed-chosen tiny value, so the
+  interpreter preempts threads every few hundred bytecodes instead of
+  every 5ms (a 5ms quantum hides almost every interleaving a real TPU
+  host would see — the verify thunk alone outlasts it).
+- explicit *yield points* at lock and executor boundaries:
+  :class:`InstrumentedLock` wraps a ``threading.Lock``/``RLock`` and,
+  around every acquire/release, asks the active :class:`_Interleaver`
+  whether to ``sleep(0)`` (force a context switch) or sleep a few µs
+  (let a racing thread take the lock first). Code under test can add its
+  own :func:`yield_point` markers.
+
+Determinism caveat, stated honestly: a seed pins the *decision sequence*
+(each yield point draws from ``random.Random(seed)``), not the OS
+scheduler. A failing seed usually replays, but the guarantee race_stress
+gives is coverage breadth — N seeds = N materially different schedules —
+plus the failing-seed list in the assertion message for replay.
+
+Usage::
+
+    def scenario(rng):            # rng: per-seed random.Random
+        ...drive pipeline/store/breaker...
+        assert invariant
+
+    race_stress(scenario, seeds=20)
+
+Tier-1 runs the ``race``-marked tests at 20 seeds (pytest.ini); the
+slow tier widens the sweep (see tests/test_race_interleave.py).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import random
+import sys
+import threading
+import time
+
+# The active interleaver. Plain global + atomic rebind: tests install it
+# from the driving thread before workers start and clear it after they
+# join, and instrumented code only reads it.
+_active: "_Interleaver | None" = None
+
+
+class _Interleaver:
+    """Seeded yield-decision source shared by every instrumented site."""
+
+    # switch interval range: 5µs..100µs — small enough that every lock
+    # region spans several preemption windows, large enough to keep the
+    # suite's wall clock sane.
+    _SI_LO, _SI_HI = 5e-6, 1e-4
+
+    def __init__(self, seed: int):
+        self.seed = seed
+        self._rng = random.Random(seed)
+        self._lock = threading.Lock()  # the rng itself is shared state
+        self.switch_interval = (
+            self._SI_LO + (seed % 97) / 96.0 * (self._SI_HI - self._SI_LO))
+        self.yields = 0
+
+    def maybe_yield(self, tag: str = "") -> None:
+        with self._lock:
+            r = self._rng.random()
+            self.yields += 1
+        if r < 0.40:
+            time.sleep(0)          # force a switch opportunity
+        elif r < 0.50:
+            time.sleep(2e-5)       # actively let a racing thread run
+
+
+def yield_point(tag: str = "") -> None:
+    """Explicit perturbation marker for code paths under test; no-op
+    unless an :func:`interleaving` context is active."""
+    inter = _active
+    if inter is not None:
+        inter.maybe_yield(tag)
+
+
+@contextlib.contextmanager
+def interleaving(seed: int):
+    """Install the seeded interleaver and shrink the switch interval;
+    restores both on exit (the previous interval in a finally, so a
+    failing scenario can't slow every later test down)."""
+    global _active
+    prev_interval = sys.getswitchinterval()
+    prev_active = _active
+    inter = _Interleaver(seed)
+    _active = inter
+    sys.setswitchinterval(inter.switch_interval)
+    try:
+        yield inter
+    finally:
+        _active = prev_active
+        sys.setswitchinterval(prev_interval)
+
+
+class InstrumentedLock:
+    """Wraps a threading.Lock/RLock with yield points at the boundaries:
+    before acquire (racing thread may grab it first), after acquire
+    (holder is preempted mid-critical-section), and after release
+    (waiters wake in a perturbed order). API-compatible with the wrapped
+    lock for `with`, acquire/release, and locked()."""
+
+    def __init__(self, inner=None):
+        self._inner = inner if inner is not None else threading.Lock()
+        self.acquisitions = 0
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        yield_point("lock:pre-acquire")
+        got = self._inner.acquire(blocking, timeout)
+        if got:
+            self.acquisitions += 1
+            yield_point("lock:post-acquire")
+        return got
+
+    def release(self) -> None:
+        self._inner.release()
+        yield_point("lock:post-release")
+
+    def locked(self) -> bool:
+        return self._inner.locked()
+
+    def __enter__(self) -> "InstrumentedLock":
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+
+def wrap_lock(obj, attr: str = "_lock") -> InstrumentedLock:
+    """Swap ``obj.<attr>`` for an :class:`InstrumentedLock` around the
+    existing lock object and return the wrapper (read
+    ``wrapper.acquisitions`` for a cheap contention signal)."""
+    wrapper = InstrumentedLock(getattr(obj, attr))
+    setattr(obj, attr, wrapper)
+    return wrapper
+
+
+def race_stress(scenario, seeds: int = 20, base_seed: int = 0) -> None:
+    """Run ``scenario(rng)`` under ``seeds`` distinct interleavings and
+    raise one AssertionError naming every failing seed (replay with
+    ``interleaving(seed)`` around the scenario body)."""
+    failures: list[tuple[int, BaseException]] = []
+    for i in range(seeds):
+        seed = base_seed + i
+        with interleaving(seed):
+            try:
+                scenario(random.Random(seed))
+            except BaseException as exc:  # noqa: BLE001 — collected, re-raised below
+                failures.append((seed, exc))
+    if failures:
+        detail = "; ".join(f"seed {s}: {type(e).__name__}: {e}"
+                           for s, e in failures[:5])
+        raise AssertionError(
+            f"race_stress: {len(failures)}/{seeds} interleavings failed "
+            f"(replay with interleaving(seed)): {detail}") from failures[0][1]

@@ -28,6 +28,12 @@ OUT="$(python -m charon_tpu.lints --format=json --changed "$BASE")" || {
 NEW="$(printf '%s' "$OUT" | python -c '
 import json, sys
 report = json.load(sys.stdin)
+# the gate only means something if the analyses actually ran: the report
+# enumerates every registered rule (zero-seeded), so a missing id means a
+# rule was silently skipped, and a stale rules_version means an old engine
+assert report["rules_version"] >= 12, report["rules_version"]
+for rule in ("LINT-CNC-020", "LINT-CNC-021", "LINT-CNC-022"):
+    assert rule in report["counts_by_rule"], f"{rule} did not run"
 for f in report["findings"]:
     if f["new"]:
         print("%s:%s: %s: %s" % (f["path"], f["line"], f["rule"], f["message"]))

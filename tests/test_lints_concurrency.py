@@ -1,0 +1,555 @@
+"""Fixture tests for the concurrency-discipline rules (RULES_VERSION 12):
+LINT-CNC-020 (shared state × execution contexts), LINT-CNC-021 (lock
+discipline: await/device-sync under lock, acquisition order, bare
+acquire), LINT-CNC-022 (check-then-act / gauge RMW atomicity) — plus the
+context-inference edge cases (executor hop, spawned-coroutine veto,
+timer targets, caller-holds convention), suppression handling, and
+cache-invalidation coverage mirroring tests/test_lints_project.py."""
+
+from __future__ import annotations
+
+import textwrap
+from pathlib import Path
+
+from charon_tpu.lints import Engine, SourceFile
+
+
+def write_tree(tmp_path: Path, files: dict[str, str]) -> None:
+    for rel, source in files.items():
+        path = tmp_path / rel
+        path.parent.mkdir(parents=True, exist_ok=True)
+        path.write_text(textwrap.dedent(source))
+
+
+def lint_tree(tmp_path: Path, files: dict[str, str],
+              cache: Path | None = None) -> tuple[Engine, list]:
+    write_tree(tmp_path, files)
+    eng = Engine(cache_path=cache)
+    return eng, eng.lint_paths([tmp_path], root=tmp_path)
+
+
+def findings_for(findings, rule: str) -> list:
+    return [f for f in findings if f.rule == rule]
+
+
+# ---------------------------------------------------------------------------
+# LINT-CNC-020: shared state across execution contexts
+# ---------------------------------------------------------------------------
+
+
+def test_cnc020_flags_two_context_unlocked_writes(tmp_path):
+    """An event-loop writer and an executor writer on one module dict
+    with no lock anywhere: the core data-race shape."""
+    _, findings = lint_tree(tmp_path, {"ops/svc.py": """\
+        STATE = {}
+
+        async def handle(loop, item):
+            STATE["k"] = item
+            await loop.run_in_executor(None, worker)
+
+        def worker():
+            STATE["k"] = 2
+    """})
+    hits = findings_for(findings, "LINT-CNC-020")
+    assert len(hits) == 1
+    assert "ops.svc.STATE" in hits[0].message
+    assert "event-loop" in hits[0].message
+    assert "executor" in hits[0].message
+
+
+def test_cnc020_common_lock_is_clean(tmp_path):
+    _, findings = lint_tree(tmp_path, {"ops/svc.py": """\
+        import threading
+
+        _state_lock = threading.Lock()
+        STATE = {}
+
+        async def handle(loop, item):
+            with _state_lock:
+                STATE["k"] = item
+            await loop.run_in_executor(None, worker)
+
+        def worker():
+            with _state_lock:
+                STATE["k"] = 2
+    """})
+    assert findings_for(findings, "LINT-CNC-020") == []
+
+
+def test_cnc020_caller_holds_convention(tmp_path):
+    """A `# caller holds <lock>` annotation marks the helper's whole body
+    as lock-protected — the plane_agg _note_dispatch convention. Without
+    the annotation the same shape is a finding."""
+    annotated = """\
+        import threading
+
+        _reg_lock = threading.Lock()
+        _reg = {}
+
+        async def handle(loop, v):
+            with _reg_lock:
+                _note(v)
+            await loop.run_in_executor(None, refill)
+
+        def refill():
+            with _reg_lock:
+                _note(0)
+
+        def _note(v):
+            # caller holds _reg_lock
+            _reg[v] = v
+    """
+    _, findings = lint_tree(tmp_path, {"ops/svc.py": annotated})
+    assert findings_for(findings, "LINT-CNC-020") == []
+
+    stripped = annotated.replace("    # caller holds _reg_lock\n", "")
+    (tmp_path / "ops/svc.py").write_text(textwrap.dedent(stripped))
+    eng = Engine()
+    findings = eng.lint_paths([tmp_path], root=tmp_path)
+    assert len(findings_for(findings, "LINT-CNC-020")) == 1
+
+
+def test_cnc020_single_context_is_clean(tmp_path):
+    """Loop-confined state needs no lock — two async writers are still
+    ONE execution context."""
+    _, findings = lint_tree(tmp_path, {"core/svc.py": """\
+        STATE = {}
+
+        async def put(item):
+            STATE["k"] = item
+
+        async def drop():
+            STATE.pop("k", None)
+    """})
+    assert findings_for(findings, "LINT-CNC-020") == []
+
+
+def test_cnc020_spawned_coroutine_is_loop_not_executor(tmp_path):
+    """aio.spawn/create_task hand a coroutine to the EVENT LOOP; the
+    executor-edge kind in the index must not count as a thread hop (this
+    killed false positives on core/tracker's asyncio-only state)."""
+    _, findings = lint_tree(tmp_path, {"core/svc.py": """\
+        STATE = {}
+
+        class Svc:
+            def start(self, tasks):
+                self._task = tasks.spawn(self._run())
+
+            async def _run(self):
+                STATE["k"] = 1
+
+        async def other():
+            STATE["k"] = 2
+    """})
+    assert findings_for(findings, "LINT-CNC-020") == []
+
+
+def test_cnc020_timer_target_is_its_own_context(tmp_path):
+    _, findings = lint_tree(tmp_path, {"ops/svc.py": """\
+        import threading
+
+        COUNT = {}
+
+        def arm():
+            t = threading.Timer(5.0, _expire)
+            t.start()
+
+        def _expire():
+            COUNT["n"] = 1
+
+        async def tick():
+            COUNT["n"] = 2
+    """})
+    hits = findings_for(findings, "LINT-CNC-020")
+    assert len(hits) == 1
+    assert "timer-thread" in hits[0].message
+
+
+def test_cnc020_self_attr_across_contexts(tmp_path):
+    _, findings = lint_tree(tmp_path, {"ops/svc.py": """\
+        class Agg:
+            def __init__(self):
+                self._acc = {}
+
+            async def put(self, loop, v):
+                self._acc["k"] = v
+                await loop.run_in_executor(None, self._flush)
+
+            def _flush(self):
+                self._acc.clear()
+    """})
+    hits = findings_for(findings, "LINT-CNC-020")
+    assert len(hits) == 1
+    assert "ops.svc.Agg._acc" in hits[0].message
+
+
+def test_cnc020_init_writes_and_mutator_on_component_exempt(tmp_path):
+    """__init__ happens-before every context; and `.add()` on a non-
+    container component attribute is a method call, not a container
+    write (the consensus _deadliner false-positive shape)."""
+    _, findings = lint_tree(tmp_path, {"ops/svc.py": """\
+        class Svc:
+            def __init__(self, deadliner):
+                self._deadliner = deadliner
+                self._n = 0
+
+            async def handle(self, loop, duty):
+                self._deadliner.add(duty)
+                await loop.run_in_executor(None, self._bg)
+
+            def _bg(self):
+                self._deadliner.add(None)
+    """})
+    assert findings_for(findings, "LINT-CNC-020") == []
+
+
+def test_cnc020_out_of_scope_dir_not_reported(tmp_path):
+    """The rules model the whole tree but report only ops/ and core/."""
+    _, findings = lint_tree(tmp_path, {"utils/svc.py": """\
+        STATE = {}
+
+        async def handle(loop, item):
+            STATE["k"] = item
+            await loop.run_in_executor(None, worker)
+
+        def worker():
+            STATE["k"] = 2
+    """})
+    assert findings_for(findings, "LINT-CNC-020") == []
+
+
+# ---------------------------------------------------------------------------
+# LINT-CNC-021: lock discipline
+# ---------------------------------------------------------------------------
+
+
+def test_cnc021_await_under_threading_lock(tmp_path):
+    _, findings = lint_tree(tmp_path, {"core/svc.py": """\
+        import threading
+
+        _lk = threading.Lock()
+
+        async def fetch(src):
+            with _lk:
+                return await src.get()
+
+        async def fine(src):
+            with _lk:
+                pending = src.peek()
+            return await src.get()
+    """})
+    hits = findings_for(findings, "LINT-CNC-021")
+    assert len(hits) == 1
+    assert hits[0].line == 7
+    assert "await" in hits[0].message
+
+
+def test_cnc021_device_sync_under_lock_direct_and_interprocedural(tmp_path):
+    _, findings = lint_tree(tmp_path, {"ops/st.py": """\
+        import threading
+        import jax
+
+        class Store:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._x = None
+
+            def read(self):
+                with self._lock:
+                    return jax.device_get(self._x)
+
+            def read_via(self):
+                with self._lock:
+                    return self._fetch()
+
+            def _fetch(self):
+                return jax.device_get(self._x)
+
+            def fine(self):
+                with self._lock:
+                    x = self._x
+                return jax.device_get(x)
+    """})
+    hits = findings_for(findings, "LINT-CNC-021")
+    assert len(hits) == 2
+    direct = [h for h in hits if h.line == 11]
+    via = [h for h in hits if h.line == 15]
+    assert direct and "device_get" in direct[0].message
+    assert via and "_fetch" in via[0].message
+
+
+def test_cnc021_sigagg_pipeline_class_stays_tpu007s(tmp_path):
+    """Device sync under SigAggPipeline._lock is LINT-TPU-007's finding;
+    CNC-021 must not double-report the same site."""
+    _, findings = lint_tree(tmp_path, {"ops/p.py": """\
+        import threading
+        import jax
+
+        class SigAggPipeline:
+            def read(self):
+                with self._lock:
+                    return jax.device_get(self._x)
+    """})
+    assert findings_for(findings, "LINT-CNC-021") == []
+    assert len(findings_for(findings, "LINT-TPU-007")) == 1
+
+
+def test_cnc021_lock_order_inversion_across_call_graph(tmp_path):
+    _, findings = lint_tree(tmp_path, {"ops/m.py": """\
+        import threading
+
+        _a = threading.Lock()
+        _b = threading.Lock()
+
+        def one():
+            with _a:
+                with _b:
+                    pass
+
+        def two():
+            with _b:
+                inner()
+
+        def inner():
+            with _a:
+                pass
+    """})
+    hits = findings_for(findings, "LINT-CNC-021")
+    assert len(hits) == 1
+    assert "lock order inversion" in hits[0].message
+    assert "ops.m._a" in hits[0].message and "ops.m._b" in hits[0].message
+
+
+def test_cnc021_consistent_lock_order_is_clean(tmp_path):
+    _, findings = lint_tree(tmp_path, {"ops/m.py": """\
+        import threading
+
+        _a = threading.Lock()
+        _b = threading.Lock()
+
+        def one():
+            with _a:
+                with _b:
+                    pass
+
+        def two():
+            with _a:
+                inner()
+
+        def inner():
+            with _b:
+                pass
+    """})
+    assert findings_for(findings, "LINT-CNC-021") == []
+
+
+def test_cnc021_nonreentrant_reacquire_flagged_rlock_clean(tmp_path):
+    _, findings = lint_tree(tmp_path, {"ops/m.py": """\
+        import threading
+
+        _lk = threading.Lock()
+        _rlk = threading.RLock()
+
+        def bad():
+            with _lk:
+                with _lk:
+                    pass
+
+        def fine():
+            with _rlk:
+                with _rlk:
+                    pass
+    """})
+    hits = findings_for(findings, "LINT-CNC-021")
+    assert len(hits) == 1
+    assert "non-reentrant" in hits[0].message
+    assert hits[0].line == 8
+
+
+def test_cnc021_bare_acquire_needs_finally_release(tmp_path):
+    _, findings = lint_tree(tmp_path, {"ops/m.py": """\
+        import threading
+
+        _lk = threading.Lock()
+
+        def bad():
+            _lk.acquire()
+            return work()
+
+        def good():
+            _lk.acquire()
+            try:
+                return work()
+            finally:
+                _lk.release()
+
+        def work():
+            return 1
+    """})
+    hits = findings_for(findings, "LINT-CNC-021")
+    assert len(hits) == 1
+    assert hits[0].line == 6
+    assert "finally" in hits[0].message
+
+
+# ---------------------------------------------------------------------------
+# LINT-CNC-022: atomicity
+# ---------------------------------------------------------------------------
+
+
+def test_cnc022_check_then_act_outside_protecting_lock(tmp_path):
+    """`if k not in d: d[k]=…` unlocked, while other writers protect the
+    same dict with a lock — the classic lost-insert interleaving."""
+    _, findings = lint_tree(tmp_path, {"ops/c.py": """\
+        import threading
+
+        _lk = threading.Lock()
+        _cache = {}
+
+        def put(k, v):
+            with _lk:
+                _cache[k] = v
+
+        def maybe(k, v):
+            if k not in _cache:
+                _cache[k] = v
+    """})
+    hits = findings_for(findings, "LINT-CNC-022")
+    assert len(hits) == 1
+    assert hits[0].line == 11
+    assert "check-then-act" in hits[0].message
+
+
+def test_cnc022_check_then_act_under_the_lock_is_clean(tmp_path):
+    _, findings = lint_tree(tmp_path, {"ops/c.py": """\
+        import threading
+
+        _lk = threading.Lock()
+        _cache = {}
+
+        def put(k, v):
+            with _lk:
+                _cache[k] = v
+
+        def maybe(k, v):
+            with _lk:
+                if k not in _cache:
+                    _cache[k] = v
+
+        def maybe_get(k, v):
+            with _lk:
+                if _cache.get(k) is None:
+                    _cache[k] = v
+    """})
+    assert findings_for(findings, "LINT-CNC-022") == []
+
+
+def test_cnc022_unprotected_everywhere_no_lock_to_name(tmp_path):
+    """A dict nobody locks has no 'protecting lock' to check against —
+    that situation is CNC-020's (context) call, not CNC-022's."""
+    _, findings = lint_tree(tmp_path, {"ops/c.py": """\
+        _cache = {}
+
+        def maybe(k, v):
+            if k not in _cache:
+                _cache[k] = v
+    """})
+    assert findings_for(findings, "LINT-CNC-022") == []
+
+
+def test_cnc022_gauge_rmw_outside_lock(tmp_path):
+    _, findings = lint_tree(tmp_path, {"ops/g.py": """\
+        import threading
+
+        from charon_tpu.utils import metrics
+
+        _lk = threading.Lock()
+        _g = metrics.gauge("ops_width")
+
+        def bump(d):
+            _g.set(_g.value() + d)
+
+        def bump_locked(d):
+            with _lk:
+                _g.set(_g.value() + d)
+
+        def plain_set(v):
+            _g.set(float(v))
+    """})
+    hits = findings_for(findings, "LINT-CNC-022")
+    assert len(hits) == 1
+    assert hits[0].line == 9
+    assert "read-modify-write" in hits[0].message
+
+
+# ---------------------------------------------------------------------------
+# suppression + caching
+# ---------------------------------------------------------------------------
+
+
+def test_cnc_suppression_directive_with_justification(tmp_path):
+    """`# lint: disable=LINT-CNC-020 — why` on (or above) the write line
+    suppresses exactly that rule, like every other project rule."""
+    files = {"ops/svc.py": """\
+        STATE = {}
+
+        async def handle(loop):
+            work()
+            await loop.run_in_executor(None, work)
+
+        def work():
+            # lint: disable=LINT-CNC-020 — idempotent latch; both contexts store the same value
+            STATE["k"] = 1
+    """}
+    _, findings = lint_tree(tmp_path, files)
+    assert findings_for(findings, "LINT-CNC-020") == []
+
+    stripped = {"ops/svc.py": files["ops/svc.py"].replace(
+        "    # lint: disable=LINT-CNC-020 — idempotent latch; both "
+        "contexts store the same value\n", "")}
+    (tmp_path / "ops/svc.py").write_text(
+        textwrap.dedent(stripped["ops/svc.py"]))
+    findings = Engine().lint_paths([tmp_path], root=tmp_path)
+    assert len(findings_for(findings, "LINT-CNC-020")) == 1
+
+
+def test_cnc_cache_invalidates_when_writer_module_changes(tmp_path):
+    """Tree-scope caching: a cached zero-finding verdict must flip when
+    an edit introduces the race (and flip back when the lock returns),
+    mirroring test_lints_project's dependency-fingerprint coverage."""
+    cache = tmp_path / "cache.json"
+    locked = """\
+        import threading
+
+        _lk = threading.Lock()
+        STATE = {}
+
+        async def handle(loop, item):
+            with _lk:
+                STATE["k"] = item
+            await loop.run_in_executor(None, worker)
+
+        def worker():
+            with _lk:
+                STATE["k"] = 2
+    """
+    tree = tmp_path / "tree"
+    tree.mkdir()
+    write_tree(tree, {"ops/svc.py": locked})
+    eng = Engine(cache_path=cache)
+    assert findings_for(eng.lint_paths([tree], root=tree),
+                        "LINT-CNC-020") == []
+
+    dedented = textwrap.dedent(locked)
+    racy = dedented.replace("    with _lk:\n        STATE[\"k\"] = 2",
+                            "    STATE[\"k\"] = 2")
+    assert racy != dedented
+    (tree / "ops/svc.py").write_text(racy)
+    eng2 = Engine(cache_path=cache)
+    assert len(findings_for(eng2.lint_paths([tree], root=tree),
+                            "LINT-CNC-020")) == 1
+
+    # unchanged tree: the cached project verdict is reused verbatim
+    eng3 = Engine(cache_path=cache)
+    assert len(findings_for(eng3.lint_paths([tree], root=tree),
+                            "LINT-CNC-020")) == 1

@@ -492,7 +492,8 @@ def test_cli_format_json_schema(tmp_path, capsys):
     assert rc == 1
     assert report["version"] == 2
     assert report["rules_version"] == RULES_VERSION
-    assert report["counts_by_rule"] == {"LINT-SEC-013": 1}
+    assert {k: v for k, v in report["counts_by_rule"].items()
+            if v} == {"LINT-SEC-013": 1}
     assert report["findings"][0]["path"] == "core/secrets.py"
     assert report["findings"][0]["new"] is True
 

@@ -1,0 +1,453 @@
+"""Seeded-interleaving race stress over the concurrent ops seams.
+
+The runtime half of the LINT-CNC-02x concurrency discipline
+(docs/robustness.md "concurrency discipline"): testutil/interleave.py
+shrinks the interpreter switch interval and injects seeded yield points
+at lock boundaries, then re-drives the four shared-state paths the
+static rules protect, asserting the invariants across ≥20 materially
+different schedules per test:
+
+* SigAggPipeline overlap — FIFO result order, exactly-once verify-thunk
+  execution, backlog gauges back to baseline;
+* PlaneStore eviction vs pin — pinned planes survive concurrent churn,
+  the LRU bound holds (modulo pins), the pinned gauge stays consistent;
+* CircuitBreaker half-open — exactly ONE probe admitted no matter how
+  many threads hit allow_device() at the cooldown edge;
+* H(m) cache upgrade — plane-less entries upgrade in place, bytes stay
+  deterministic, an upgrade never regresses to plane-less.
+
+Plus targeted regressions for the lazy-init races the CNC-020 burn-down
+fixed (guard._device_types, plane_agg digit tables, pallas _interpret).
+
+Everything here is `race`-marked (cheap seeds, tier-1); the wide sweep
+at the bottom is slow-tier only.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from charon_tpu.ops import guard, plane_agg, plane_store
+from charon_tpu.ops import pallas_plane as PP
+from charon_tpu.ops import field as F
+from charon_tpu.testutil import interleave
+
+pytestmark = pytest.mark.race
+
+SEEDS = 20  # tier-1 floor per scenario (acceptance criteria, ISSUE 16)
+
+
+# ---------------------------------------------------------------------------
+# harness self-checks
+# ---------------------------------------------------------------------------
+
+
+def test_interleaving_restores_switch_interval():
+    import sys
+
+    before = sys.getswitchinterval()
+    with interleave.interleaving(3) as inter:
+        # the interpreter quantizes the interval; only the magnitude and
+        # the restore matter
+        assert sys.getswitchinterval() <= 2 * inter._SI_HI
+        interleave.yield_point("here")
+        assert inter.yields >= 1
+    assert sys.getswitchinterval() == pytest.approx(before)
+    # distinct seeds must pick distinct schedules somewhere
+    assert (interleave._Interleaver(1).switch_interval
+            != interleave._Interleaver(2).switch_interval)
+
+
+def test_instrumented_lock_wraps_and_counts():
+    class Holder:
+        def __init__(self):
+            self._lock = threading.Lock()
+
+    h = Holder()
+    wrapper = interleave.wrap_lock(h)
+    assert h._lock is wrapper
+    with h._lock:
+        assert wrapper.locked()
+    assert not wrapper.locked()
+    assert wrapper.acquisitions == 1
+
+
+def test_race_stress_reports_failing_seeds():
+    def scenario(rng):
+        assert rng.random() >= 0.0  # always true
+        if scenario.fail:
+            raise AssertionError("boom")
+
+    scenario.fail = False
+    interleave.race_stress(scenario, seeds=3)
+    scenario.fail = True
+    with pytest.raises(AssertionError, match="3/3 interleavings.*seed 0"):
+        interleave.race_stress(scenario, seeds=3)
+
+
+# ---------------------------------------------------------------------------
+# SigAggPipeline: overlap FIFO + exactly-once verify + gauge convergence
+# ---------------------------------------------------------------------------
+
+
+def _stub_pipeline_stages(monkeypatch, thunk_runs):
+    """Scheduling-only stubs over the emit+verify split: finish sleeps a
+    per-slot pseudo-random sliver so completion order scrambles, the
+    verify thunk logs its slot (exactly-once check)."""
+
+    def finish(state, hash_fn=None):
+        name = state[1]
+        # slot-name-derived delay, stable across seeds: orderings come
+        # from the interleaver, not from wall-clock luck alone
+        time.sleep((hash(name) % 4) * 5e-4)
+        return name
+
+    monkeypatch.setattr(plane_agg, "_layout_slots", lambda b: b)
+    monkeypatch.setattr(plane_agg, "_fused_dispatch",
+                        lambda layout, pks, msgs: ("pending", layout))
+    monkeypatch.setattr(plane_agg, "_fused_finish", finish)
+
+    def emit(state, hash_fn=None):
+        name = finish(state, hash_fn)
+
+        def thunk():
+            interleave.yield_point("verify-thunk")
+            thunk_runs.append(name)
+            return True
+
+        return name, thunk
+
+    monkeypatch.setattr(plane_agg, "_fused_emit", emit)
+
+
+def test_race_pipeline_overlap_fifo_and_exactly_once(monkeypatch):
+    thunk_runs: list[str] = []
+    _stub_pipeline_stages(monkeypatch, thunk_runs)
+    slots = [f"slot{i}" for i in range(6)]
+    base = {g: g.value() for g in (plane_agg._finish_backlog,
+                                   plane_agg._verify_backlog,
+                                   plane_agg._submit_backlog)}
+
+    def scenario(rng):
+        del thunk_runs[:]
+        pipe = plane_agg.SigAggPipeline(depth=2, finish_workers=2,
+                                        slot_deadline=0)
+        interleave.wrap_lock(pipe)
+        try:
+            results = []
+            for name in slots:
+                results.extend(pipe.submit(name, [], []))
+            results.extend(pipe.drain())
+        finally:
+            pipe.close()
+        assert [r[0] for r in results] == slots, "FIFO drain broken"
+        assert all(ok for _, ok in results)
+        assert sorted(thunk_runs) == sorted(slots), \
+            f"verify thunks ran {len(thunk_runs)}x for {len(slots)} slots"
+        for g, b in base.items():
+            assert g.value() == b, f"{g.name} did not converge to baseline"
+
+    interleave.race_stress(scenario, seeds=SEEDS)
+
+
+def test_race_pipeline_submit_async_owned_futures(monkeypatch):
+    """Concurrent submit_async callers each get THEIR slot's result —
+    overlap never crosses futures — and the backlog drains to zero."""
+    thunk_runs: list[str] = []
+    _stub_pipeline_stages(monkeypatch, thunk_runs)
+
+    def scenario(rng):
+        pipe = plane_agg.SigAggPipeline(depth=2, finish_workers=2,
+                                        slot_deadline=0)
+        interleave.wrap_lock(pipe)
+        errors: list[str] = []
+
+        def submitter(name):
+            interleave.yield_point("pre-submit")
+            fut = pipe.submit_async(name, [], [])
+            out, ok = fut.result(timeout=10)
+            if out != name or not ok:
+                errors.append(f"{name} got {out!r}/{ok}")
+
+        threads = [threading.Thread(target=submitter, args=(f"s{i}",))
+                   for i in range(5)]
+        try:
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=15)
+            assert not errors, errors
+            # resolved slots linger in the FIFO (≤ depth) until popped;
+            # drain clears the residue and the gauge converges with it
+            assert pipe.backlog <= 2
+            pipe.drain()
+            assert pipe.backlog == 0
+            assert plane_agg._submit_backlog.value() == 0
+        finally:
+            pipe.close()
+
+    interleave.race_stress(scenario, seeds=SEEDS)
+
+
+# ---------------------------------------------------------------------------
+# PlaneStore: eviction churn vs pinned survival
+# ---------------------------------------------------------------------------
+
+
+def _pk_set(n: int, tag: str) -> list[bytes]:
+    return [hashlib.sha256(f"{tag}:{i}".encode()).digest()[:48]
+            for i in range(n)]
+
+
+def test_race_plane_store_eviction_vs_pin(monkeypatch):
+    decode_calls: list[str] = []
+
+    def fake_decode(pks, Bc, **kw):
+        decode_calls.append(bytes(pks[0]).hex()[:8])
+        interleave.yield_point("decode")
+        return ("plane", len(pks), Bc)
+
+    monkeypatch.setattr(plane_agg, "g1_plane_from_compressed", fake_decode)
+    monkeypatch.setattr(plane_agg, "g1_subgroup_ok", lambda p: True)
+    pinned = _pk_set(4, "pinned")
+
+    def scenario(rng):
+        store = plane_store.PlaneStore(max_entries=4)
+        interleave.wrap_lock(store)
+        store.pin(pinned)
+        store.chunk_planes(pinned, [(0, 4)], [8])
+
+        def churn(tag):
+            for i in range(8):
+                store.chunk_planes(_pk_set(3, f"{tag}{i}"), [(0, 3)], [8])
+
+        def pin_cycle():
+            other = _pk_set(2, "cycle")
+            for _ in range(6):
+                store.pin(other)
+                store.chunk_planes(other, [(0, 2)], [8])
+                store.unpin(other)
+
+        threads = [threading.Thread(target=churn, args=(t,))
+                   for t in ("a", "b")] + [threading.Thread(target=pin_cycle)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=15)
+
+        # pinned planes survived every eviction: re-request is a pure hit
+        del decode_calls[:]
+        store.chunk_planes(pinned, [(0, 4)], [8])
+        assert not decode_calls, "pinned chunk was evicted under churn"
+        stats = store.stats()
+        assert stats["pinned_sets"] == 1
+        # LRU bound holds modulo the pin-protected entries
+        unpinned = [k for k in store._entries
+                    if k[0] != store.digest(pinned)]
+        assert len(unpinned) <= store.max_entries
+        # the gauge agrees with the instance at rest
+        assert plane_store._pinned_g.value() == len(store._pinned)
+
+    interleave.race_stress(scenario, seeds=SEEDS)
+
+
+# ---------------------------------------------------------------------------
+# CircuitBreaker: half-open admits exactly one probe
+# ---------------------------------------------------------------------------
+
+
+def test_race_breaker_half_open_single_probe():
+    def scenario(rng):
+        br = guard.CircuitBreaker(threshold=1, cooldown=0.002)
+        interleave.wrap_lock(br)
+        br.record_failure()
+        assert br.state == guard.OPEN
+        time.sleep(0.004)  # past the cooldown: next gate goes half-open
+
+        admitted: list[bool] = []
+        barrier = threading.Barrier(8)
+
+        def prober():
+            barrier.wait(timeout=5)
+            interleave.yield_point("probe")
+            admitted.append(br.allow_device())
+
+        threads = [threading.Thread(target=prober) for _ in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=10)
+        assert admitted.count(True) == 1, \
+            f"half-open admitted {admitted.count(True)} probes"
+        assert br.state == guard.HALF_OPEN
+        br.record_success()
+        assert br.state == guard.CLOSED
+        assert br.allow_device()
+
+    interleave.race_stress(scenario, seeds=SEEDS)
+
+
+# ---------------------------------------------------------------------------
+# H(m) cache: bytes/planes accessors racing the in-place upgrade
+# ---------------------------------------------------------------------------
+
+
+def _fake_h2c_bytes(key: bytes) -> bytes:
+    return hashlib.sha256(key).digest() * 3  # deterministic 96 bytes
+
+
+def _fake_planes(comp: bytes):
+    return (np.full((2, F.LIMBS), comp[0], np.int32),
+            np.full((2, F.LIMBS), comp[1], np.int32))
+
+
+def test_race_h2c_cache_upgrade(monkeypatch):
+    monkeypatch.setattr(plane_agg, "_hash_to_g2_native", _fake_h2c_bytes)
+    monkeypatch.setattr(plane_agg, "_planes_from_compressed", _fake_planes)
+    monkeypatch.setattr(plane_agg, "_verify_device_path", lambda: False)
+    monkeypatch.setattr(plane_agg, "_h2c_lock",
+                        interleave.InstrumentedLock())
+    msgs = [f"duty{i}".encode() for i in range(6)]
+
+    def scenario(rng):
+        with plane_agg._h2c_lock:
+            plane_agg._h2c_cache.clear()
+
+        def bytes_caller():
+            for m in rng.sample(msgs, len(msgs)):
+                out = plane_agg.hash_to_g2_cached(m)
+                assert out == _fake_h2c_bytes(m)
+
+        def planes_caller():
+            hx, hy = plane_agg.hash_to_g2_planes(list(msgs))
+            for i, m in enumerate(msgs):
+                exp_x, exp_y = _fake_planes(_fake_h2c_bytes(m))
+                assert np.array_equal(hx[i], exp_x)
+                assert np.array_equal(hy[i], exp_y)
+
+        threads = ([threading.Thread(target=bytes_caller) for _ in range(2)]
+                   + [threading.Thread(target=planes_caller)
+                      for _ in range(2)])
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=15)
+
+        # every entry holds the deterministic bytes; upgraded entries
+        # never regress to plane-less once populated
+        with plane_agg._h2c_lock:
+            entries = {k: (e[0], e[1]) for k, e in
+                       plane_agg._h2c_cache.items()}
+        for key, (comp, planes) in entries.items():
+            assert comp == _fake_h2c_bytes(key)
+            if planes is not None:
+                assert np.array_equal(planes[0], _fake_planes(comp)[0])
+        # a full planes pass now upgrades everything and stays upgraded
+        plane_agg.hash_to_g2_planes(list(msgs))
+        with plane_agg._h2c_lock:
+            assert all(e[1] is not None
+                       for e in plane_agg._h2c_cache.values())
+
+    interleave.race_stress(scenario, seeds=SEEDS)
+
+
+# ---------------------------------------------------------------------------
+# regressions for the CNC-020 lazy-init fixes
+# ---------------------------------------------------------------------------
+
+
+def _hammer(fn, nthreads=8, timeout=10):
+    results: list = []
+    barrier = threading.Barrier(nthreads)
+
+    def run():
+        barrier.wait(timeout=5)
+        interleave.yield_point("init")
+        results.append(fn())
+
+    threads = [threading.Thread(target=run) for _ in range(nthreads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=timeout)
+    assert len(results) == nthreads
+    return results
+
+
+def test_race_device_types_single_init():
+    """guard._device_types: the lazy jax-import init is double-check
+    locked (CNC-020 fix) — concurrent first calls all see one tuple."""
+
+    def scenario(rng):
+        guard.reset_for_testing()
+        results = _hammer(guard._device_types)
+        assert all(r == results[0] for r in results)
+        assert results[0]  # non-empty taxonomy
+
+    interleave.race_stress(scenario, seeds=5)
+
+
+def test_race_lazy_digit_tables_single_build(monkeypatch):
+    """plane_agg digit tables (_EXP_SQRT/_EXP_INV/_EXP_34/_HALF_LIMBS)
+    build once under _exp_lock (CNC-020 fix); readers never see a
+    half-populated pair."""
+
+    def scenario(rng):
+        plane_agg._EXP_SQRT = plane_agg._EXP_INV = plane_agg._EXP_34 = None
+        plane_agg._HALF_LIMBS = None
+        pairs = _hammer(plane_agg._sqrt_inv_bits)
+        for sqrt_d, inv_d in pairs:
+            assert sqrt_d is not None and inv_d is not None
+            assert np.array_equal(sqrt_d, pairs[0][0])
+            assert np.array_equal(inv_d, pairs[0][1])
+        e34s = _hammer(plane_agg._e34_bits, nthreads=4)
+        assert all(np.array_equal(e, e34s[0]) for e in e34s)
+
+    interleave.race_stress(scenario, seeds=5)
+
+
+def test_race_interpret_probe_single(monkeypatch):
+    """pallas_plane._interpret: backend probe happens exactly once even
+    under concurrent first calls (CNC-020 fix)."""
+
+    def scenario(rng):
+        monkeypatch.setattr(PP, "_interpret_cache", [])
+        results = _hammer(PP._interpret, nthreads=6)
+        assert len(PP._interpret_cache) == 1
+        assert all(r == results[0] for r in results)
+
+    interleave.race_stress(scenario, seeds=5)
+
+
+# ---------------------------------------------------------------------------
+# slow tier: wide seed sweep over the richest scenario
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_race_pipeline_overlap_wide_sweep(monkeypatch):
+    thunk_runs: list[str] = []
+    _stub_pipeline_stages(monkeypatch, thunk_runs)
+    slots = [f"slot{i}" for i in range(6)]
+
+    def scenario(rng):
+        del thunk_runs[:]
+        pipe = plane_agg.SigAggPipeline(depth=2, finish_workers=2,
+                                        slot_deadline=0)
+        interleave.wrap_lock(pipe)
+        try:
+            results = []
+            for name in slots:
+                results.extend(pipe.submit(name, [], []))
+            results.extend(pipe.drain())
+        finally:
+            pipe.close()
+        assert [r[0] for r in results] == slots
+        assert sorted(thunk_runs) == sorted(slots)
+
+    interleave.race_stress(scenario, seeds=200)
