@@ -140,6 +140,26 @@ async def _run(cfg) -> dict:
               file=sys.stderr)
     print(f"# pairing paths: device={tail['pairing_paths']['device']:.0f} "
           f"native={tail['pairing_paths']['native']:.0f}", file=sys.stderr)
+    # cluster-telemetry tail keys: consensus round behaviour and
+    # threshold-progress latency for the run, same quantile idiom as
+    # verify_phase, plus the full SLO scorecard rendered off the same
+    # registry the keys above read piecemeal
+    hists = metrics.snapshot_quantiles()
+
+    def _hist_tail(prefix: str) -> dict:
+        out = {}
+        for key, stats in hists.items():
+            if key.startswith(prefix) and stats.get("count"):
+                out[key] = {"p50_s": round(stats["p50"], 4),
+                            "p99_s": round(stats["p99"], 4),
+                            "count": stats["count"]}
+        return out
+
+    tail["consensus"] = _hist_tail("core_consensus_round_duration_seconds")
+    tail["quorum_latency"] = _hist_tail("core_parsig_quorum_latency_seconds")
+    from charon_tpu.utils import scorecard as scorecard_mod
+    tail["scorecard"] = scorecard_mod.build_scorecard(
+        compiles=tail["compiles"])
     shed = report.client_tallies.get("shed_503", 0)
     print(f"# {report.client_requests} client requests in "
           f"{report.elapsed_s:.1f}s ({report.achieved_rps:.1f} req/s), "

@@ -198,6 +198,23 @@ def default_checks(quorum_peers: int,
               lambda w: (w.gauge_sum("dkg_ceremony_state") > 0
                          and w.gauge_delta("dkg_ceremony_state") <= 0
                          and w.counter_delta("dkg_round_retries_total") > 0)),
+        Check("consensus_round_changes_high",
+              "QBFT instances are burning round changes in the window "
+              "(core_consensus_round_changes_total moved more than 3 times — "
+              "leaders are timing out or justification is repeatedly failing; "
+              "check inter-node latency and core_consensus_unjust_total; "
+              "docs/observability.md consensus metrics)",
+              lambda w: w.counter_delta(
+                  "core_consensus_round_changes_total") > 3),
+        Check("parsig_quorum_slow",
+              f"partial-signature quorum p99 above {slot_seconds / 3:.1f}s (a "
+              "third of slot time) — the gap between the first partial and "
+              "the t-th is eating the duty budget before aggregation starts "
+              "(slow peers or parsigex backpressure; "
+              "core_parsig_quorum_latency_seconds)",
+              lambda w: w.histogram_quantile(
+                  "core_parsig_quorum_latency_seconds")
+              > slot_seconds / 3),
         Check("high_error_log_rate", "more than 5 error logs in the window",
               lambda w: w.counter_delta("log_messages_total", "error") > 5),
         Check("high_warning_log_rate", "more than 10 warning logs in the window",

@@ -6,9 +6,14 @@
                 recent validatorapi traffic (reference monitoringapi.go:107)
   /debug/qbft   sniffed consensus instances as JSON (reference
                 app/qbftdebug.go:22 serves them gzipped)
-  /debug/traces recent finished spans as JSON; ?fmt=chrome downloads the
-                buffer as a Chrome-trace file loadable in Perfetto /
-                chrome://tracing (docs/observability.md)
+  /debug/traces recent finished spans as JSON; ?trace_id=... filters to one
+                trace (the cluster trace collector fetches one duty's spans
+                per node this way); ?fmt=chrome downloads the selection as a
+                Chrome-trace file loadable in Perfetto / chrome://tracing
+                (docs/observability.md)
+  /debug/scorecard
+                the per-epoch SLO scorecard (utils/scorecard.py) rendered
+                from this node's live registry
   /debug/duty/{slot}/{type}
                 one duty's flight: the span-assembled latency timeline plus
                 the tracker's verdict for that duty, if analysed
@@ -56,6 +61,7 @@ class MonitoringAPI:
         app.router.add_get("/readyz", self._readyz)
         app.router.add_get("/debug/qbft", self._qbft)
         app.router.add_get("/debug/traces", self._traces)
+        app.router.add_get("/debug/scorecard", self._scorecard)
         app.router.add_get("/debug/duty/{slot}/{type}", self._duty)
         self._app = app
 
@@ -131,6 +137,9 @@ class MonitoringAPI:
         buffer rendered as a downloadable Chrome-trace file that loads in
         Perfetto / chrome://tracing."""
         spans = tracer.finished_spans()
+        trace_id = request.query.get("trace_id")
+        if trace_id:
+            spans = [s for s in spans if s.trace_id == trace_id]
         fmt = request.query.get("fmt", "json")
         if fmt == "chrome":
             body = json.dumps(tracer.to_chrome_trace(spans))
@@ -150,9 +159,17 @@ class MonitoringAPI:
             "start": s.start,
             "end": s.end,
             "attrs": {k: str(v) for k, v in s.attrs.items()},
-            "events": [{"name": ev.name, "ts": ev.ts} for ev in s.events],
+            "events": [{"name": ev.name, "ts": ev.ts,
+                        "attrs": {k: str(v) for k, v in ev.attrs.items()}}
+                       for ev in s.events],
         } for s in spans[-limit:]]
         return web.json_response({"spans": out, "total_buffered": len(spans)})
+
+    async def _scorecard(self, request: web.Request) -> web.Response:
+        """The node's SLO scorecard from the live registry (the compose
+        harness and soak tooling fetch + merge these per node)."""
+        from ..utils import scorecard
+        return web.json_response(scorecard.build_scorecard())
 
     async def _duty(self, request: web.Request) -> web.Response:
         """One duty's assembled latency timeline + the tracker's verdict.

@@ -11,12 +11,20 @@ gives the same discipline, with futures for the blocking Await.
 from __future__ import annotations
 
 import asyncio
+import time as time_mod
 
-from ..utils import errors, log
+from ..utils import errors, log, metrics
 from .deadline import Deadliner
 from .types import Duty, PubKey, SignedData, SignedDataSet
 
 _log = log.with_topic("aggsigdb")
+
+# The consumer side of threshold progress: how long fetcher/vapi callers
+# block waiting for an aggregate that quorum has not yet produced. A cached
+# hit observes ~0, so the histogram's upper quantiles isolate the waits.
+_await_hist = metrics.histogram(
+    "core_aggsigdb_await_seconds",
+    "Time await_ blocked before the aggregate existed", ("type",))
 
 
 class MemDB:  # lint: implements=AggSigDB
@@ -78,9 +86,15 @@ class MemDB:  # lint: implements=AggSigDB
         by_root = self._data.get((duty, pubkey))
         if by_root:
             if root is None:
+                _await_hist.observe(0.0, str(duty.type))
                 return next(iter(by_root.values())).clone()
             if root in by_root:
+                _await_hist.observe(0.0, str(duty.type))
                 return by_root[root].clone()
         fut = asyncio.get_running_loop().create_future()
         self._waiters.setdefault((duty, pubkey, root), []).append(fut)
-        return await fut
+        t0 = time_mod.monotonic()
+        try:
+            return await fut
+        finally:
+            _await_hist.observe(time_mod.monotonic() - t0, str(duty.type))

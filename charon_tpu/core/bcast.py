@@ -84,6 +84,10 @@ class Broadcaster:
         _bcast_counter.inc(str(duty.type))
         delay = time.time() - self._chain.slot_start_time(duty.slot)
         _bcast_delay.observe(delay, str(duty.type))
+        # Terminal marker of the duty's cluster-wide trace: a merged trace
+        # reads "submitted" per node without consulting the beacon mock.
+        tracer.event("bcast_submitted", duty=str(duty),
+                     validators=len(signed), delay_s=round(delay, 4))
         _log.info("broadcast duty to beacon node", duty=str(duty),
                   validators=len(signed), delay_sec=round(delay, 3))
 

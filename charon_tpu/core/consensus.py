@@ -26,7 +26,7 @@ import time as time_mod
 from dataclasses import dataclass, field
 from typing import Awaitable, Callable
 
-from ..utils import aio, errors, k1util, log, metrics
+from ..utils import aio, errors, k1util, log, metrics, tracer
 from . import qbft
 from .deadline import Deadliner
 from .gater import DutyGaterFunc
@@ -52,6 +52,25 @@ _consensus_timeout = metrics.counter(
     "core_consensus_timeout_total", "Consensus timeouts", ("duty", "timer"))
 _consensus_error = metrics.counter(
     "core_consensus_error_total", "Consensus errors", ())
+# Round-level QBFT observability (ISSUE 18): per-instance metrics above say
+# WHETHER consensus converged; these say WHAT each round did while it ran.
+_round_duration = metrics.histogram(
+    "core_consensus_round_duration_seconds",
+    "Time a QBFT round ran before ending (round change or decide)",
+    ("round",))
+_round_changes = metrics.counter(
+    "core_consensus_round_changes_total",
+    "QBFT round transitions by the rule that fired them", ("rule",))
+_msgs_total = metrics.counter(
+    "core_consensus_msgs_total",
+    "Consensus wire messages by QBFT type and direction",
+    ("type", "direction"))
+_unjust_total = metrics.counter(
+    "core_consensus_unjust_total",
+    "Consensus messages dropped by the justification rules", ("type",))
+_decided_total = metrics.counter(
+    "core_consensus_decided_total",
+    "Decided consensus instances by the round they decided in", ("round",))
 
 RECV_BUFFER = 100  # buffered inbound messages per instance (component.go:29)
 
@@ -568,67 +587,112 @@ class Component:  # lint: implements=Consensus
             return
         timer = self._timer_func(duty)
         sniffed = inst.sniffed
-
-        def decide(instance, value_hash, qcommit) -> None:
-            inst.decided_at = time_mod.monotonic()
-            sniffed.decided_hash = value_hash.hex()
-            _decided_rounds.set(qcommit[0].round, str(duty.type), timer.type)
-            value_json = inst.values.get(value_hash)
-            if value_json is None:
-                _log.error("decided value not in instance values",
-                           duty=str(duty))
+        # Instance span under the duty's deterministic trace: identical trace
+        # id on every peer, so a cluster-merged trace shows all N instances
+        # of one duty side by side. The eager/inbound start paths arrive
+        # without a duty context; propose arrives inside one — only root the
+        # context when it isn't already this duty's.
+        if tracer.current_trace_id() != tracer.duty_trace_id(
+                duty.slot, str(duty.type)):
+            tracer.rooted_ctx(duty.slot, str(duty.type))
+        round_starts: dict[int, float] = {1: time_mod.monotonic()}
+        with tracer.start_span("consensus/instance", duty=str(duty),
+                               timer=timer.type,
+                               peer=self._peer_idx) as span:
+            def decide(instance, value_hash, qcommit) -> None:
+                now = time_mod.monotonic()
+                inst.decided_at = now
+                sniffed.decided_hash = value_hash.hex()
+                decided_round = qcommit[0].round
+                _decided_rounds.set(decided_round, str(duty.type), timer.type)
+                _decided_total.inc(str(decided_round))
+                started = round_starts.get(decided_round)
+                if started is not None:
+                    _round_duration.observe(now - started, str(decided_round))
+                span.add_event("consensus_decided", round=decided_round,
+                               leader=leader(duty, decided_round, self._nodes),
+                               partials=len(qcommit))
+                value_json = inst.values.get(value_hash)
+                if value_json is None:
+                    _log.error("decided value not in instance values",
+                               duty=str(duty))
+                    if not inst.done_fut.done():
+                        inst.done_fut.set_result("failed")
+                    return
                 if not inst.done_fut.done():
-                    inst.done_fut.set_result("failed")
-                return
+                    inst.done_fut.set_result("decided")
+                aio.spawn(self._notify(duty, value_json),
+                          name=f"consensus-decide-{duty}")
+
+            def log_round_change(instance_, process, old_round, new_round,
+                                 rule, round_msgs) -> None:
+                now = time_mod.monotonic()
+                started = round_starts.get(old_round)
+                if started is not None and new_round != old_round:
+                    _round_duration.observe(now - started, str(old_round))
+                round_starts.setdefault(new_round, now)
+                _round_changes.inc(str(rule))
+                span.add_event("round_change", old_round=old_round,
+                               new_round=new_round, rule=str(rule),
+                               leader=leader(duty, new_round, self._nodes),
+                               round_msgs=len(round_msgs))
+                sniffed.add_msg({"event": "round_change", "round": old_round,
+                                 "new_round": new_round, "rule": str(rule),
+                                 "t": time_mod.time()})
+
+            def log_unjust(instance_, process, m: qbft.Msg) -> None:
+                _unjust_total.inc(str(m.type))
+                sniffed.add_msg({"event": "unjust", "type": int(m.type),
+                                 "round": m.round, "source": m.source,
+                                 "t": time_mod.time()})
+
+            definition = qbft.Definition(
+                is_leader=lambda inst_, r, p: leader(inst_, r, self._nodes) == p,
+                new_timer=timer.new_timer,
+                decide=decide,
+                nodes=self._nodes,
+                log_upon_rule=lambda *a: sniffed.add_msg(
+                    {"event": "rule", "rule": str(a[-1]), "t": time_mod.time()}),
+                log_round_change=log_round_change,
+                log_unjust=log_unjust,
+            )
+
+            async def broadcast(m: qbft.Msg) -> None:
+                wire = encode_wire(m, self._privkey, self._peer_idx,
+                                   inst.values, inst.sig_cache)
+                _msgs_total.inc(str(m.type), "send")
+                sniffed.add_msg({"event": "send", "type": int(m.type),
+                                 "round": m.round, "t": time_mod.time(),
+                                 "wire": wire})
+                # Deliver to self directly (the algorithm expects its own
+                # messages back) and to all peers via the transport.
+                inst.recv.put_nowait(m)
+                await self._transport.broadcast(wire)
+
+            transport = qbft.Transport(broadcast, inst.recv)
+            # The qbft event loop never returns on its own: after deciding it
+            # keeps answering late peers' ROUND-CHANGEs with DECIDED until the
+            # duty deadline cancels it (reference: runInstance blocks until the
+            # duty context closes). Run it as a task; the caller is released as
+            # soon as the instance decides.
+            inst.qbft_task = aio.spawn(
+                qbft.run(definition, transport, duty, self._peer_idx,
+                         inst.hash_fut),
+                name=f"qbft-{duty}")
+            done, _ = await asyncio.wait({inst.qbft_task, inst.done_fut},
+                                         return_when=asyncio.FIRST_COMPLETED)
+            if inst.done_fut in done:
+                if inst.done_fut.result() == "decided":
+                    return
+                raise errors.new("consensus failed", duty=str(duty))
             if not inst.done_fut.done():
-                inst.done_fut.set_result("decided")
-            aio.spawn(self._notify(duty, value_json),
-                      name=f"consensus-decide-{duty}")
-
-        definition = qbft.Definition(
-            is_leader=lambda inst_, r, p: leader(inst_, r, self._nodes) == p,
-            new_timer=timer.new_timer,
-            decide=decide,
-            nodes=self._nodes,
-            log_upon_rule=lambda *a: sniffed.add_msg(
-                {"event": "rule", "rule": str(a[-1]), "t": time_mod.time()}),
-        )
-
-        async def broadcast(m: qbft.Msg) -> None:
-            wire = encode_wire(m, self._privkey, self._peer_idx, inst.values,
-                               inst.sig_cache)
-            sniffed.add_msg({"event": "send", "type": int(m.type),
-                             "round": m.round, "t": time_mod.time(),
-                             "wire": wire})
-            # Deliver to self directly (the algorithm expects its own
-            # messages back) and to all peers via the transport.
-            inst.recv.put_nowait(m)
-            await self._transport.broadcast(wire)
-
-        transport = qbft.Transport(broadcast, inst.recv)
-        # The qbft event loop never returns on its own: after deciding it
-        # keeps answering late peers' ROUND-CHANGEs with DECIDED until the
-        # duty deadline cancels it (reference: runInstance blocks until the
-        # duty context closes). Run it as a task; the caller is released as
-        # soon as the instance decides.
-        inst.qbft_task = aio.spawn(
-            qbft.run(definition, transport, duty, self._peer_idx,
-                     inst.hash_fut),
-            name=f"qbft-{duty}")
-        done, _ = await asyncio.wait({inst.qbft_task, inst.done_fut},
-                                     return_when=asyncio.FIRST_COMPLETED)
-        if inst.done_fut in done:
-            if inst.done_fut.result() == "decided":
-                return
-            raise errors.new("consensus failed", duty=str(duty))
-        if not inst.done_fut.done():
-            inst.done_fut.set_result("failed")
-        if inst.qbft_task.cancelled():
-            raise errors.new("consensus timeout", duty=str(duty))
-        exc = inst.qbft_task.exception()
-        _consensus_error.inc()
-        raise errors.wrap(exc or errors.new("qbft loop exited"),
-                          "consensus instance failed", duty=str(duty))
+                inst.done_fut.set_result("failed")
+            if inst.qbft_task.cancelled():
+                raise errors.new("consensus timeout", duty=str(duty))
+            exc = inst.qbft_task.exception()
+            _consensus_error.inc()
+            raise errors.wrap(exc or errors.new("qbft loop exited"),
+                              "consensus instance failed", duty=str(duty))
 
     async def _notify(self, duty: Duty, value_json: dict) -> None:
         if "__priority__" in value_json:
@@ -655,8 +719,10 @@ class Component:  # lint: implements=Consensus
             m, values = decode_and_verify_wire(wire, self._pubkeys,
                                                self._gater, sig_cache)
         except Exception as exc:  # noqa: BLE001 — invalid peer msg dropped
+            _msgs_total.inc("invalid", "recv")
             _log.warn("dropping invalid consensus message", err=exc)
             return
+        _msgs_total.inc(str(m.type), "recv")
         if self._deadliner is not None and not self._deadliner.add(m.instance):
             return
         inst = self._instance(m.instance)

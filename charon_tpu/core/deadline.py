@@ -73,11 +73,18 @@ class Deadliner:
             delay = deadline - self._clock()
             if delay > 0:
                 self._wake.clear()
+                # asyncio.wait, not wait_for: on Python 3.10 wait_for can
+                # swallow an external cancel that races its timeout (or the
+                # event firing), leaving this loop running forever after
+                # task.cancel() — which deadlocks stop() paths that gather
+                # the gc/trim tasks consuming this iterator.
+                waiter = asyncio.ensure_future(self._wake.wait())
                 try:
-                    await asyncio.wait_for(self._wake.wait(), timeout=delay)
+                    done, _ = await asyncio.wait({waiter}, timeout=delay)
+                finally:
+                    waiter.cancel()
+                if done:
                     continue  # new duty added; re-evaluate the head
-                except asyncio.TimeoutError:
-                    pass
             heapq.heappop(self._heap)
             self._pending.discard(duty)
             yield duty

@@ -10,6 +10,7 @@ exist for a duty+validator, fires the threshold subscribers → SigAgg
 
 from __future__ import annotations
 
+import time as time_mod
 from collections import defaultdict
 
 from ..utils import errors, log, metrics
@@ -20,6 +21,19 @@ _log = log.with_topic("parsigdb")
 
 _store_counter = metrics.counter(
     "core_parsigdb_store_total", "Partial signatures stored", ("source",))
+# Threshold-progress instrumentation (ISSUE 18): the DV-critical question is
+# "how long from the FIRST partial to the t-th matching partial, and which
+# peer is dragging" — latency per duty type, contribution counts per share.
+_quorum_latency = metrics.histogram(
+    "core_parsig_quorum_latency_seconds",
+    "First partial seen to threshold reached, per duty+validator", ("type",))
+_contrib_counter = metrics.counter(
+    "core_parsig_contributions_total",
+    "Stored (non-duplicate) partials by contributing share index",
+    ("share_idx",))
+_partials_at_quorum = metrics.gauge(
+    "core_parsig_partials_at_quorum_count",
+    "Partials already present when the threshold fired", ("type",))
 
 # Duty types where one validator legitimately signs several distinct payloads
 # per duty — e.g. one SyncCommitteeSelection per subcommittee for the same
@@ -43,6 +57,9 @@ class MemDB:  # lint: implements=ParSigDB
                          dict[tuple[int, bytes], ParSignedData]] = defaultdict(dict)
         # Threshold fires once per (duty, pubkey, message_root).
         self._fired: set[tuple[Duty, PubKey, bytes]] = set()
+        # (duty, pubkey) -> monotonic time the FIRST partial landed; the
+        # quorum-latency histogram measures from here to threshold.
+        self._first_seen: dict[tuple[Duty, PubKey], float] = {}
         self._internal_subs = []
         self._threshold_subs = []
 
@@ -60,6 +77,8 @@ class MemDB:  # lint: implements=ParSigDB
             for key in [k for k in self._sigs if k[0] == duty]:
                 del self._sigs[key]
             self._fired = {f for f in self._fired if f[0] != duty}
+            for key in [k for k in self._first_seen if k[0] == duty]:
+                del self._first_seen[key]
 
     async def store_internal(self, duty: Duty, parsigs: ParSignedDataSet) -> None:
         """Store our own VC's partials and fan out to internal subscribers
@@ -108,6 +127,9 @@ class MemDB:  # lint: implements=ParSigDB
                                           share_idx=psd.share_idx)
                 continue
             self._sigs[key][(psd.share_idx, root)] = psd.clone()
+            now = time_mod.monotonic()
+            self._first_seen.setdefault(key, now)
+            _contrib_counter.inc(str(psd.share_idx))
             if (duty, pubkey, root) in self._fired:
                 continue
             matching = self._root_group(key, root)
@@ -116,6 +138,9 @@ class MemDB:  # lint: implements=ParSigDB
             # getThresholdMatching:198).
             if len(matching) >= self._threshold:
                 self._fired.add((duty, pubkey, root))
+                _quorum_latency.observe(now - self._first_seen[key],
+                                        str(duty.type))
+                _partials_at_quorum.set(len(self._sigs[key]), str(duty.type))
                 hits[pubkey].append(matching[: self._threshold])
         if equivocation is not None:
             _log.warn("equivocating partial in batch", err=equivocation,

@@ -27,7 +27,9 @@ from .types import Duty, ParSignedData, ParSignedDataSet, PubKey
 _log = log.with_topic("parsigex")
 
 _recv_counter = metrics.counter(
-    "core_parsigex_received_total", "Partials received from peers", ("verified",))
+    "core_parsigex_received_total",
+    "Partials received from peers, by handling result "
+    "(verified / verify_failed / unknown_duty / fault)", ("result",))
 
 VerifyFunc = Callable[[Duty, PubKey, ParSignedData], Awaitable[None]]
 
@@ -146,17 +148,17 @@ class ParSigEx:
                       err=exc, duty=str(duty))
             return
         if not self._gater(duty):
-            _recv_counter.inc("gated", amount=len(parsigs))
+            _recv_counter.inc("unknown_duty", amount=len(parsigs))
             _log.warn("dropping gated duty from peer", duty=str(duty))
             return
         if self._verify_set is not None:
             try:
                 await self._verify_set(duty, parsigs)
             except Exception as exc:  # noqa: BLE001 — invalid peer data dropped
-                _recv_counter.inc("invalid", amount=len(parsigs))
+                _recv_counter.inc("verify_failed", amount=len(parsigs))
                 _log.warn("dropping invalid peer partials", err=exc, duty=str(duty))
                 return
-        _recv_counter.inc("ok", amount=len(parsigs))
+        _recv_counter.inc("verified", amount=len(parsigs))
         for fn in self._subs:
             await fn(duty, {k: v.clone() for k, v in parsigs.items()})
 

@@ -122,9 +122,20 @@ class Definition:
     nodes: int
     fifo_limit: int = 1000
     # Optional debug hooks (reference LogUponRule/LogRoundChange/LogUnjust).
-    log_upon_rule: Callable[..., None] | None = None
-    log_round_change: Callable[..., None] | None = None
-    log_unjust: Callable[..., None] | None = None
+    # Call shapes (see run() below; the consensus component wires all three
+    # into its round-level metrics and span events):
+    #   log_upon_rule(instance, process, round, msg, rule)
+    #     — after every non-duplicate rule firing,
+    #   log_round_change(instance, process, old_round, new_round, rule,
+    #                    round_msgs)
+    #     — before the round advances; round_msgs are the old round's
+    #       buffered messages,
+    #   log_unjust(instance, process, msg)
+    #     — a message failed the justification rules and was dropped.
+    log_upon_rule: Callable[[Any, int, int, "Msg", UponRule], None] | None = None
+    log_round_change: Callable[[Any, int, int, int, UponRule, list["Msg"]],
+                               None] | None = None
+    log_unjust: Callable[[Any, int, "Msg"], None] | None = None
 
     @property
     def quorum(self) -> int:

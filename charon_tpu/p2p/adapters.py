@@ -11,6 +11,15 @@ protobuf analogue) and move them over TCPNode protocols:
   /charon/parsigex/2.0.0        partial-signature sets
   /charon/consensus/qbft/2.0.0  signed QBFT wire messages
   /charon/leadercast/1.0.0      leadercast proposals
+
+Every outbound envelope is stamped with the sender's trace context (a
+`"trace": {"trace_id", "span_id"}` key, `tracer.current_context()`); the
+receive path adopts it so handler spans attach to the sender's trace with
+the sender's span as remote parent. Decoding tolerates an absent key — a
+peer running an older build simply doesn't stamp, and duty-carrying
+messages still align cluster-wide through the deterministic duty trace id
+(`tracer.rooted_ctx` fallback). For non-duty messages (priority protocol)
+the stamp is the ONLY context carry.
 """
 
 from __future__ import annotations
@@ -27,7 +36,7 @@ from ..core.types import (
     decode_unsigned,
     encode_unsigned,
 )
-from ..utils import log
+from ..utils import log, tracer
 from .node import TCPNode
 
 _log = log.with_topic("p2p")
@@ -49,6 +58,26 @@ def _decode_duty(obj: dict) -> Duty:
     return Duty(int(obj["slot"]), DutyType(int(obj["type"])))
 
 
+def _stamp(payload: dict) -> dict:
+    """Add the sender's trace context to an outbound envelope (in place)."""
+    ctx = tracer.current_context()
+    if ctx is not None:
+        payload["trace"] = ctx
+    return payload
+
+
+def _adopt(obj: dict, duty: Duty | None = None) -> bool:
+    """Adopt the envelope's trace context; with a duty, fall back to its
+    deterministic trace when the envelope carries none (old peer). Returns
+    whether ANY context is now active (i.e. a recv span is attributable)."""
+    if tracer.attach_context(obj.get("trace")) is not None:
+        return True
+    if duty is not None:
+        tracer.rooted_ctx(duty.slot, str(duty.type))
+        return True
+    return False
+
+
 class ParSigExTCPTransport:
     """The reference's real parsigex path: direct n^2 broadcast over p2p
     streams (core/parsigex/parsigex.go:105-130); replaces MemTransport."""
@@ -63,10 +92,10 @@ class ParSigExTCPTransport:
         self._handler = handler
 
     async def broadcast(self, from_idx: int, duty: Duty, parsigs: ParSignedDataSet) -> None:
-        payload = json.dumps({
+        payload = json.dumps(_stamp({
             "duty": _encode_duty(duty),
             "parsigs": {pk: psd.to_json() for pk, psd in parsigs.items()},
-        }).encode()
+        })).encode()
         self._node.broadcast(PROTO_PARSIGEX, payload)
 
     async def _on_message(self, sender_idx: int, payload: bytes) -> None:
@@ -75,7 +104,10 @@ class ParSigExTCPTransport:
         obj = json.loads(payload.decode())
         duty = _decode_duty(obj["duty"])
         parsigs = {pk: ParSignedData.from_json(v) for pk, v in obj["parsigs"].items()}
-        await self._handler(duty, parsigs)
+        _adopt(obj, duty)
+        with tracer.start_span("p2p/parsigex_recv", duty=str(duty),
+                               sender=sender_idx, parsigs=len(parsigs)):
+            await self._handler(duty, parsigs)
         return None
 
 
@@ -93,12 +125,21 @@ class ConsensusTCPEndpoint:
         self._handler = handler
 
     async def broadcast(self, wire: dict) -> None:
-        self._node.broadcast(PROTO_CONSENSUS, json.dumps(wire).encode())
+        # The stamp rides the wire dict as an extra top-level key:
+        # decode_and_verify_wire only reads msg/justification/values, so old
+        # peers ignore it and signatures are unaffected.
+        self._node.broadcast(PROTO_CONSENSUS,
+                             json.dumps(_stamp(dict(wire))).encode())
 
     async def _on_message(self, sender_idx: int, payload: bytes) -> None:
         if self._handler is None:
             return None
-        await self._handler(json.loads(payload.decode()))
+        obj = json.loads(payload.decode())
+        if _adopt(obj):
+            with tracer.start_span("p2p/consensus_recv", sender=sender_idx):
+                await self._handler(obj)
+        else:
+            await self._handler(obj)
         return None
 
 
@@ -116,15 +157,23 @@ class PriorityTCPTransport:
         self._handler = handler
 
     async def broadcast(self, slot: int, topics_json: list) -> None:
-        payload = json.dumps({"slot": slot, "topics": topics_json}).encode()
+        payload = json.dumps(_stamp(
+            {"slot": slot, "topics": topics_json})).encode()
         self._node.broadcast(PROTO_PRIORITY, payload)
 
     async def _on_message(self, sender_idx: int, payload: bytes) -> None:
         if self._handler is None:
             return None
         obj = json.loads(payload.decode())
-        await self._handler(sender_idx, int(obj["slot"]),
-                            list(obj["topics"]))
+        # Non-duty message: the envelope stamp is the only context carry.
+        if _adopt(obj):
+            with tracer.start_span("p2p/priority_recv", sender=sender_idx,
+                                   slot=int(obj["slot"])):
+                await self._handler(sender_idx, int(obj["slot"]),
+                                    list(obj["topics"]))
+        else:
+            await self._handler(sender_idx, int(obj["slot"]),
+                                list(obj["topics"]))
         return None
 
 
@@ -140,10 +189,10 @@ class LeadercastTCPTransport:
         self._handler = handler
 
     async def broadcast(self, from_idx: int, duty: Duty, data: UnsignedDataSet) -> None:
-        payload = json.dumps({
+        payload = json.dumps(_stamp({
             "duty": _encode_duty(duty),
             "data": {pk: encode_unsigned(v) for pk, v in data.items()},
-        }).encode()
+        })).encode()
         self._node.broadcast(PROTO_LEADERCAST, payload)
 
     async def _on_message(self, sender_idx: int, payload: bytes) -> None:
@@ -152,5 +201,8 @@ class LeadercastTCPTransport:
         obj = json.loads(payload.decode())
         duty = _decode_duty(obj["duty"])
         data = {pk: decode_unsigned(v) for pk, v in obj["data"].items()}
-        await self._handler(duty, clone_set(data))
+        _adopt(obj, duty)
+        with tracer.start_span("p2p/leadercast_recv", duty=str(duty),
+                               sender=sender_idx):
+            await self._handler(duty, clone_set(data))
         return None

@@ -40,9 +40,17 @@ except ModuleNotFoundError:
     # for wire speed. See utils/pureaes.HashAEAD.
     from ..utils.pureaes import HashAEAD as AESGCM
 
-from ..utils import errors, k1util
+from ..utils import errors, k1util, metrics
 
 _MAX_FRAME = 32 * 1024 * 1024  # hard cap; duty payloads are << 1 MiB
+
+# Envelope-level wire accounting: trace-context stamping (p2p/adapters.py)
+# grows every payload by a few dozen bytes, and this is the one place ALL
+# cluster traffic funnels through — the counters make that overhead (and any
+# payload-size regression) visible per direction on /metrics.
+_bytes_counter = metrics.counter(
+    "p2p_channel_bytes_total",
+    "Plaintext bytes through authenticated channels", ("direction",))
 
 
 class HandshakeError(RuntimeError):
@@ -183,12 +191,14 @@ class SecureChannel:
     async def write(self, frame: bytes) -> None:
         ct = self._send.encrypt(self._nonce(self._send_salt, self._send_seq), frame, b"")
         self._send_seq += 1
+        _bytes_counter.inc("out", amount=len(frame))
         await self._inner.write(ct)
 
     async def read(self) -> bytes:
         ct = await self._inner.read()
         pt = self._recv.decrypt(self._nonce(self._recv_salt, self._recv_seq), ct, b"")
         self._recv_seq += 1
+        _bytes_counter.inc("in", amount=len(pt))
         return pt
 
     async def close(self) -> None:

@@ -40,6 +40,8 @@ _log = log.with_topic("p2p")
 
 _msg_counter = metrics.counter("p2p_messages_total", "P2P messages", ("direction", "result"))
 _peer_gauge = metrics.gauge("p2p_peer_connected", "Peer connection state", ("peer",))
+_broadcast_counter = metrics.counter(
+    "p2p_broadcast_total", "Cluster-wide broadcasts by protocol", ("protocol",))
 
 KIND_ONEWAY, KIND_REQUEST, KIND_RESPONSE, KIND_ERROR = 0, 1, 2, 3
 
@@ -262,6 +264,7 @@ class TCPNode:
                      for _ in range(_random.randrange(1, 512)))
 
     def broadcast(self, protocol: str, payload: bytes) -> None:
+        _broadcast_counter.inc(protocol)
         for idx in self.peers:
             self.send_async(idx, protocol, payload)
 

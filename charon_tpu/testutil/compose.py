@@ -106,7 +106,12 @@ class ComposeCluster:
         await self.server.start()
         env = dict(os.environ)
         env["CHARON_BEACON_NODE_ENDPOINTS"] = self.server.base_url
-        env.pop("JAX_PLATFORMS", None)  # nodes never touch the device
+        # Nodes never touch the device: force the host backend so the TPU
+        # plugin's instance-metadata probe (minutes of 403 retries when
+        # several processes race for the chip) can't stall a node's
+        # assemble at the mesh probe (ops/mesh.device_count via the
+        # coalescer's flush sizing).
+        env["JAX_PLATFORMS"] = "cpu"
         for i in range(self.num_nodes):
             # per-node log FILES: pipes would fill (~64KB) with nothing
             # draining them and block the node mid-run
@@ -154,6 +159,65 @@ class ComposeCluster:
             return path.read_text(errors="replace")
         except OSError:
             return ""
+
+    # -- cluster telemetry collection ------------------------------------
+
+    async def _fetch_json(self, i: int, path: str) -> dict | None:
+        """GET a monitoring endpoint off node i; None when the node is
+        unreachable (crashed or not yet listening)."""
+        import aiohttp
+
+        url = f"http://127.0.0.1:{self.monitoring_ports[i]}{path}"
+        try:
+            async with aiohttp.ClientSession() as session:
+                async with session.get(
+                        url, timeout=aiohttp.ClientTimeout(total=5)) as resp:
+                    if resp.status != 200:
+                        return None
+                    return await resp.json()
+        except (aiohttp.ClientError, asyncio.TimeoutError, OSError):
+            return None
+
+    async def node_spans(self, i: int,
+                         trace_id: str | None = None) -> list[dict]:
+        """One node's finished spans from /debug/traces (optionally one
+        trace), as the raw span dicts tracer.merge_cluster accepts."""
+        path = "/debug/traces?limit=100000"
+        if trace_id:
+            path += f"&trace_id={trace_id}"
+        body = await self._fetch_json(i, path)
+        return body["spans"] if body else []
+
+    async def cluster_trace(self, trace_id: str | None = None,
+                            out_path=None) -> dict:
+        """The cluster-scope Chrome trace: every node's span buffer fetched
+        over /debug/traces, merged clock-aligned into one file with a lane
+        per node (utils/tracer.merge_cluster). `trace_id` narrows to a
+        single duty's trace — the cross-node view of one decision."""
+        from ..utils import tracer
+
+        per_node = await asyncio.gather(
+            *(self.node_spans(i, trace_id) for i in range(self.num_nodes)))
+        merged = tracer.merge_cluster(
+            {f"node{i}": spans for i, spans in enumerate(per_node)})
+        if out_path is not None:
+            import json as json_mod
+            Path(out_path).write_text(json_mod.dumps(merged))
+        return merged
+
+    async def cluster_scorecard(self, out_path=None) -> dict:
+        """Per-node SLO scorecards fetched over /debug/scorecard, merged
+        into the cluster card (utils/scorecard.merge_scorecards)."""
+        from ..utils import scorecard
+
+        cards = await asyncio.gather(
+            *(self._fetch_json(i, "/debug/scorecard")
+              for i in range(self.num_nodes)))
+        merged = scorecard.merge_scorecards(
+            {f"node{i}": c for i, c in enumerate(cards) if c is not None})
+        if out_path is not None:
+            scorecard.write_scorecard(str(out_path), merged)
+        return merged
 
 
 class SimulatedCrash(RuntimeError):

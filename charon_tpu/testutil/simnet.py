@@ -64,7 +64,19 @@ class SimNode:
         self.sched.stop()
         for t in self.tasks:
             t.cancel()
-        await asyncio.gather(*self.tasks, return_exceptions=True)
+        # Re-cancel stragglers instead of gathering unconditionally: a task
+        # whose first cancel was swallowed (e.g. by a wait_for race) would
+        # otherwise hang this stop forever.
+        while self.tasks:
+            done, pending = await asyncio.wait(self.tasks, timeout=5)
+            for t in done:
+                if not t.cancelled():
+                    t.exception()  # retrieve, so the loop doesn't warn
+            if not pending:
+                break
+            self.tasks = list(pending)
+            for t in pending:
+                t.cancel()
         if self.tcp_node is not None:
             await self.tcp_node.stop()
 

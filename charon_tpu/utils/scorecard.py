@@ -1,0 +1,239 @@
+"""Per-epoch SLO scorecard — the cluster's health, one JSON object.
+
+ROADMAP item 4 (mainnet soak + byzantine consensus chaos) names its top-line
+artifact "a per-epoch SLO scorecard"; this module renders it from the same
+metric registry `/metrics` serves, so a soak report and a production alert
+read identical series. One scorecard summarizes one node's registry; the
+compose harness emits one per node plus a cluster-level merge
+(`testutil/compose.ComposeCluster.cluster_scorecard`), and `bench_vapi.py` /
+the dryruns append one to their JSON tails.
+
+Schema (`charon-tpu/scorecard/v1`) — every latency is seconds, every `p99`
+is the worst labeled series' p99 (bucket-upper-bound; a series whose p99
+exceeds the top bucket substitutes its mean so the field stays numeric):
+
+  duty_e2e        scheduled → terminal latency (core_duty_e2e_latency_seconds)
+  missed_duties   tracker-failed duties by step (core_tracker_failed_duties_total)
+  consensus       decided instances by round, rounds>1 fraction, round
+                  durations, round changes by rule, msgs by direction,
+                  justification failures
+  quorum_latency  first partial → threshold (core_parsig_quorum_latency_seconds)
+  parsigex        inbound partials by result (verification failures visible)
+  fallback        sigagg fallback count + pairing path split (native residual)
+  compiles        warmup/steady split from the PR-15 sentinel — `steady`
+                  MUST be 0 after warmup
+
+Unpopulated sections render with null aggregates (not absent keys), so a
+consumer can distinguish "no traffic" from "schema drift".
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import re
+from typing import Any
+
+from . import metrics
+
+SCHEMA = "charon-tpu/scorecard/v1"
+
+_LABEL_RE = re.compile(r'(\w+)="([^"]*)"')
+
+
+def _labels_of(key: str, name: str) -> dict[str, str] | None:
+    """Parse a snapshot key (`name` or `name{l="v",...}`) into its labels;
+    None when the key belongs to a different metric."""
+    if key == name:
+        return {}
+    if key.startswith(name + "{") and key.endswith("}"):
+        return dict(_LABEL_RE.findall(key[len(name) + 1:-1]))
+    return None
+
+
+def _counter_series(snap: dict[str, float], name: str,
+                    label: str | None = None) -> dict[str, float]:
+    """{label value (or ""): value} for every series of a counter/gauge."""
+    out: dict[str, float] = {}
+    for key, val in snap.items():
+        labels = _labels_of(key, name)
+        if labels is None:
+            continue
+        k = labels.get(label, "") if label else ",".join(
+            f"{n}={v}" for n, v in labels.items())
+        out[k] = out.get(k, 0.0) + val
+    return out
+
+
+def _finite_q(stats: dict[str, float], stat: str) -> float | None:
+    """A series' quantile, substituting the mean when it saturated the top
+    histogram bucket (keeps the scorecard numeric instead of Infinity)."""
+    val = stats.get(stat)
+    count = stats.get("count") or 0.0
+    if not count:
+        return None
+    if val is None or math.isinf(val):
+        return stats.get("sum", 0.0) / count
+    return val
+
+
+def _finite_p99(stats: dict[str, float]) -> float | None:
+    return _finite_q(stats, "p99")
+
+
+def _hist_summary(hists: dict[str, dict[str, float]], name: str,
+                  label: str | None = None) -> dict[str, Any]:
+    """Worst-series p99 + total count + per-label breakdown of a histogram."""
+    by: dict[str, dict[str, Any]] = {}
+    total = 0.0
+    worst: float | None = None
+    for key, stats in hists.items():
+        labels = _labels_of(key, name)
+        if labels is None:
+            continue
+        k = (labels.get(label, "") if label else ",".join(
+            f"{n}={v}" for n, v in labels.items())) or "_"
+        p99 = _finite_p99(stats)
+        by[k] = {"count": stats.get("count", 0.0),
+                 "p50_s": _finite_q(stats, "p50"), "p99_s": p99}
+        total += stats.get("count", 0.0)
+        if p99 is not None:
+            worst = p99 if worst is None else max(worst, p99)
+    return {"p99_s": worst, "count": total, "by": by}
+
+
+def build_scorecard(registry: "metrics.Registry | None" = None, *,
+                    compiles: dict[str, int] | None = None,
+                    epoch: dict[str, Any] | None = None,
+                    node: str | None = None) -> dict[str, Any]:
+    """Render the scorecard from `registry` (default: the process registry).
+
+    `compiles` overrides the sentinel's warmup/steady split (tests hand in
+    synthetic values; production omits it and the PR-15 sentinel is read).
+    `epoch` is caller-provided scoping metadata (slot range, epoch number,
+    slot seconds) stamped through verbatim; `node` labels the emitting node.
+    """
+    reg = registry if registry is not None else metrics.default_registry
+    snap = reg.snapshot()
+    hists = reg.snapshot_quantiles()
+
+    duty_e2e = _hist_summary(hists, "core_duty_e2e_latency_seconds", "type")
+    missed_by = _counter_series(snap, "core_tracker_failed_duties_total",
+                                "step")
+
+    decided_by_round = _counter_series(snap, "core_consensus_decided_total",
+                                       "round")
+    decided = sum(decided_by_round.values())
+    gt1 = sum(v for r, v in decided_by_round.items()
+              if r.isdigit() and int(r) > 1)
+    consensus = {
+        "decided": decided,
+        "decided_by_round": decided_by_round,
+        "rounds_gt1_fraction": (gt1 / decided) if decided else None,
+        "round_changes_by_rule": _counter_series(
+            snap, "core_consensus_round_changes_total", "rule"),
+        "round_duration": _hist_summary(
+            hists, "core_consensus_round_duration_seconds", "round"),
+        "msgs_by_direction": _counter_series(
+            snap, "core_consensus_msgs_total", "direction"),
+        "unjust_total": sum(_counter_series(
+            snap, "core_consensus_unjust_total").values()),
+        "timeouts_total": sum(_counter_series(
+            snap, "core_consensus_timeout_total").values()),
+    }
+
+    quorum = _hist_summary(hists, "core_parsig_quorum_latency_seconds",
+                           "type")
+    parsigex = _counter_series(snap, "core_parsigex_received_total",
+                               "result")
+    contributions = _counter_series(snap, "core_parsig_contributions_total",
+                                    "share_idx")
+
+    pairing = _counter_series(snap, "ops_pairing_total", "path")
+    device = pairing.get("device", 0.0)
+    native = pairing.get("native", 0.0)
+    fallback = {
+        "sigagg_fallback_total": sum(_counter_series(
+            snap, "ops_sigagg_fallback_total").values()),
+        "pairing": {
+            "device": device, "native": native,
+            "native_fraction": (native / (device + native)
+                                if (device + native) else None),
+        },
+    }
+
+    if compiles is None:
+        try:
+            from ..ops import sentinel
+            compiles = sentinel.compiles_summary()
+        except Exception:  # noqa: BLE001 — sentinel absent/uninstalled
+            compiles = {"warmup": 0, "steady": 0}
+
+    card: dict[str, Any] = {
+        "schema": SCHEMA,
+        "duty_e2e": duty_e2e,
+        "missed_duties": {"total": sum(missed_by.values()),
+                          "by_step": missed_by},
+        "consensus": consensus,
+        "quorum_latency": quorum,
+        "parsigex": {"received_by_result": parsigex,
+                     "contributions_by_share": contributions},
+        "fallback": fallback,
+        "compiles": compiles,
+    }
+    if epoch is not None:
+        card["epoch"] = epoch
+    if node is not None:
+        card["node"] = node
+    return card
+
+
+def merge_scorecards(cards: dict[str, dict[str, Any]]) -> dict[str, Any]:
+    """Cluster view over per-node scorecards: counts sum, latencies take the
+    worst node, `compiles.steady` sums (ANY steady recompile anywhere is a
+    finding). Per-node cards ride along under `nodes`."""
+    cards = dict(cards)
+    merged: dict[str, Any] = {"schema": SCHEMA, "nodes": cards}
+    if not cards:
+        return merged
+
+    def worst(path_get) -> float | None:
+        vals = [v for v in (path_get(c) for c in cards.values())
+                if v is not None]
+        return max(vals) if vals else None
+
+    def total(path_get) -> float:
+        return sum(path_get(c) or 0.0 for c in cards.values())
+
+    merged["duty_e2e"] = {
+        "p99_s": worst(lambda c: c["duty_e2e"]["p99_s"]),
+        "count": total(lambda c: c["duty_e2e"]["count"]),
+    }
+    merged["missed_duties"] = {
+        "total": total(lambda c: c["missed_duties"]["total"])}
+    decided = total(lambda c: c["consensus"]["decided"])
+    gt1 = sum((c["consensus"]["rounds_gt1_fraction"] or 0.0)
+              * c["consensus"]["decided"] for c in cards.values())
+    merged["consensus"] = {
+        "decided": decided,
+        "rounds_gt1_fraction": (gt1 / decided) if decided else None,
+        "round_changes": total(lambda c: sum(
+            c["consensus"]["round_changes_by_rule"].values())),
+        "unjust_total": total(lambda c: c["consensus"]["unjust_total"]),
+    }
+    merged["quorum_latency"] = {
+        "p99_s": worst(lambda c: c["quorum_latency"]["p99_s"]),
+        "count": total(lambda c: c["quorum_latency"]["count"]),
+    }
+    merged["compiles"] = {
+        "warmup": int(total(lambda c: c["compiles"].get("warmup", 0))),
+        "steady": int(total(lambda c: c["compiles"].get("steady", 0))),
+    }
+    return merged
+
+
+def write_scorecard(path: str, card: dict[str, Any]) -> str:
+    """Write one scorecard JSON file and return the path."""
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(card, f, indent=2, sort_keys=True)
+    return path

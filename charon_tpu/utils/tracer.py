@@ -90,6 +90,7 @@ def rooted_ctx(duty_slot: int, duty_type: str) -> str:
     trace_id = h[:32]
     _current_trace.set(trace_id)
     _current_span.set(None)
+    _remote_parent.set(None)
     return trace_id
 
 
@@ -101,6 +102,55 @@ def duty_trace_id(duty_slot: int, duty_type: str) -> str:
     return h[:32]
 
 
+def current_trace_id() -> str | None:
+    """The calling task's trace id, or None outside any trace."""
+    return _current_trace.get()
+
+
+# -- cross-node context carry ------------------------------------------------
+#
+# Duty traffic aligns across nodes for free (deterministic duty trace ids),
+# but parent-span linkage — and ANY alignment for non-duty messages — needs
+# the sender's context stamped into the p2p envelope. `current_context()`
+# renders the calling task's context as a plain JSON-safe dict the p2p
+# adapters drop into their payloads; `attach_context()` on the receive path
+# adopts it (tolerating absence: a peer running an older build simply omits
+# the key, and duty handlers fall back to `rooted_ctx`). The remote parent
+# span id is carried in a dedicated contextvar, so the receiver's next
+# `start_span` parents under the sender's span without holding a local Span
+# object for it.
+
+_remote_parent: contextvars.ContextVar[str | None] = contextvars.ContextVar(
+    "charon_remote_parent", default=None)
+
+
+def current_context() -> dict[str, str] | None:
+    """Wire-portable form of the calling task's trace context (or None)."""
+    trace_id = _current_trace.get()
+    if trace_id is None:
+        return None
+    ctx: dict[str, str] = {"trace_id": trace_id}
+    span = _current_span.get()
+    if span is not None:
+        ctx["span_id"] = span.span_id
+    return ctx
+
+
+def attach_context(ctx: Any) -> str | None:
+    """Adopt a peer's wire context; returns the trace id, or None when the
+    envelope carried no usable context (old peer / non-traced sender)."""
+    if not isinstance(ctx, dict):
+        return None
+    trace_id = ctx.get("trace_id")
+    if not isinstance(trace_id, str) or not trace_id:
+        return None
+    _current_trace.set(trace_id)
+    _current_span.set(None)
+    span_id = ctx.get("span_id")
+    _remote_parent.set(span_id if isinstance(span_id, str) and span_id else None)
+    return trace_id
+
+
 @contextmanager
 def start_span(name: str, **attrs: Any):
     trace_id = _current_trace.get()
@@ -108,7 +158,7 @@ def start_span(name: str, **attrs: Any):
         trace_id = hashlib.sha256(f"{name}{time.time_ns()}".encode()).hexdigest()[:32]
         _current_trace.set(trace_id)
     parent = _current_span.get()
-    parent_id = parent.span_id if parent is not None else None
+    parent_id = parent.span_id if parent is not None else _remote_parent.get()
     span_id = hashlib.sha256(
         f"{trace_id}{parent_id}{name}{time.monotonic_ns()}".encode()).hexdigest()[:16]
     span = Span(trace_id, span_id, parent_id, name, time.time(), attrs=dict(attrs))
@@ -222,3 +272,121 @@ def write_chrome_trace(path: str, spans: Iterable[Span] | None = None) -> str:
     with open(path, "w", encoding="utf-8") as f:
         json.dump(to_chrome_trace(spans), f)
     return path
+
+
+# -- cluster trace merging ---------------------------------------------------
+#
+# A ComposeCluster (or a real multi-host mesh) has one span buffer per NODE;
+# the /debug/traces endpoint serves each node's buffer as JSON. merge_cluster
+# joins them into one Chrome trace with per-node lanes: each node becomes a
+# process (pid) so the cluster reads as N horizontal bands, each span name a
+# thread (tid) shared across nodes so the same pipeline step lines up
+# vertically. Clock alignment rides the deterministic duty trace ids: for
+# every trace id two nodes share, the first-span start offsets estimate the
+# pairwise clock skew, and each node's timestamps are shifted by the median
+# estimate against the reference node (the first lane). Nodes sharing no
+# trace with the reference stay unshifted.
+
+
+def span_from_json(obj: dict) -> Span:
+    """Rebuild a Span from its /debug/traces JSON form."""
+    span = Span(
+        trace_id=str(obj.get("trace_id", "")),
+        span_id=str(obj.get("span_id", "")),
+        parent_id=obj.get("parent_id") or None,
+        name=str(obj.get("name", "")),
+        start=float(obj.get("start", 0.0)),
+        end=float(obj.get("end") or 0.0),
+        attrs=dict(obj.get("attrs") or {}),
+    )
+    for ev in obj.get("events") or []:
+        span.events.append(SpanEvent(str(ev.get("name", "")),
+                                     float(ev.get("ts", 0.0)),
+                                     dict(ev.get("attrs") or {})))
+    return span
+
+
+def _coerce_spans(spans: Iterable[Span | dict]) -> list[Span]:
+    return [s if isinstance(s, Span) else span_from_json(s) for s in spans]
+
+
+def _skew_to_reference(ref: list[Span], other: list[Span]) -> float:
+    """Median offset (seconds) to ADD to `other`'s timestamps so shared
+    traces' first spans line up with `ref`'s. 0.0 when nothing is shared."""
+    ref_first: dict[str, float] = {}
+    for s in ref:
+        if s.trace_id not in ref_first or s.start < ref_first[s.trace_id]:
+            ref_first[s.trace_id] = s.start
+    deltas: list[float] = []
+    other_first: dict[str, float] = {}
+    for s in other:
+        if s.trace_id not in other_first or s.start < other_first[s.trace_id]:
+            other_first[s.trace_id] = s.start
+    for tid, start in other_first.items():
+        if tid in ref_first:
+            deltas.append(ref_first[tid] - start)
+    if not deltas:
+        return 0.0
+    deltas.sort()
+    return deltas[len(deltas) // 2]
+
+
+def merge_cluster(node_spans: dict[str, Iterable[Span | dict]],
+                  align: bool = True) -> dict:
+    """Merge per-node span sets into ONE clock-aligned Chrome trace.
+
+    `node_spans` maps node name -> spans (Span objects or /debug/traces JSON
+    dicts). Returns the Chrome trace-event object: pid = node lane (labeled
+    with the node name and its applied skew), tid = span name (shared across
+    lanes), span/event args carry trace_id so Perfetto can filter one duty
+    across all lanes.
+    """
+    lanes = {name: _coerce_spans(spans) for name, spans in node_spans.items()}
+    names = list(lanes)
+    offsets = {name: 0.0 for name in names}
+    if align and len(names) > 1:
+        ref = lanes[names[0]]
+        for name in names[1:]:
+            offsets[name] = _skew_to_reference(ref, lanes[name])
+    tids: dict[str, int] = {}
+    out: list[dict] = []
+    for pid, name in enumerate(names, start=1):
+        off = offsets[name]
+        for span in lanes[name]:
+            tid = tids.setdefault(span.name, len(tids) + 1)
+            args = {k: str(v) for k, v in span.attrs.items()}
+            args["trace_id"] = span.trace_id
+            args["span_id"] = span.span_id
+            args["node"] = name
+            if span.parent_id:
+                args["parent_id"] = span.parent_id
+            end = span.end if span.end else span.start
+            out.append({
+                "name": span.name,
+                "cat": "charon",
+                "ph": "X",
+                "ts": (span.start + off) * 1e6,
+                "dur": max(end - span.start, 0.0) * 1e6,
+                "pid": pid,
+                "tid": tid,
+                "args": args,
+            })
+            for ev in span.events:
+                out.append({
+                    "name": ev.name,
+                    "cat": "charon",
+                    "ph": "i",
+                    "s": "t",
+                    "ts": (ev.ts + off) * 1e6,
+                    "pid": pid,
+                    "tid": tid,
+                    "args": {k: str(v) for k, v in ev.attrs.items()},
+                })
+    for pid, name in enumerate(names, start=1):
+        label = name if not offsets[name] else f"{name} (skew {offsets[name] * 1e3:+.1f}ms)"
+        out.append({"name": "process_name", "ph": "M", "ts": 0, "pid": pid,
+                    "tid": 0, "args": {"name": label}})
+        for sname, tid in tids.items():
+            out.append({"name": "thread_name", "ph": "M", "ts": 0, "pid": pid,
+                        "tid": tid, "args": {"name": sname}})
+    return {"traceEvents": out, "displayTimeUnit": "ms"}

@@ -1,0 +1,689 @@
+"""Cluster-scope telemetry: cross-node trace propagation through the p2p
+envelopes, consensus/threshold-progress instrumentation, the cluster trace
+merger, and the per-epoch SLO scorecard."""
+
+import asyncio
+import contextvars
+import json
+
+import aiohttp
+
+from charon_tpu.app.monitoring import MonitoringAPI
+from charon_tpu.core import consensus, parsigdb, parsigex, qbft
+from charon_tpu.core.types import (
+    Duty,
+    DutyType,
+    ParSignedData,
+    pubkey_from_bytes,
+)
+from charon_tpu.core.unsigneddata import AttestationDataUnsigned
+from charon_tpu.eth2 import spec
+from charon_tpu.p2p import adapters
+from charon_tpu.utils import k1util, metrics, scorecard, tracer
+
+
+def _run(coro, timeout=60):
+    async def wrapped():
+        return await asyncio.wait_for(coro, timeout)
+
+    return asyncio.run(wrapped())
+
+
+def _in_fresh_ctx(fn, *args):
+    """Run fn in a copied contextvars context — a stand-in for the receiving
+    node's fresh handler task."""
+    return contextvars.copy_context().run(fn, *args)
+
+
+# ---------------------------------------------------------------------------
+# context carry primitives
+
+
+def test_current_context_attach_roundtrip():
+    tracer.reset_for_testing()
+    tracer.rooted_ctx(5, "attester")
+    with tracer.start_span("core/consensus") as s:
+        ctx = tracer.current_context()
+    assert ctx == {"trace_id": tracer.duty_trace_id(5, "attester"),
+                   "span_id": s.span_id}
+
+    def receiver():
+        assert tracer.attach_context(ctx) == ctx["trace_id"]
+        with tracer.start_span("p2p/consensus_recv") as r:
+            pass
+        return r
+
+    r = _in_fresh_ctx(receiver)
+    assert r.trace_id == ctx["trace_id"]
+    assert r.parent_id == ctx["span_id"]
+
+
+def test_attach_context_tolerates_absent_and_malformed():
+    assert tracer.attach_context(None) is None
+    assert tracer.attach_context("bogus") is None
+    assert tracer.attach_context({}) is None
+    assert tracer.attach_context({"trace_id": ""}) is None
+    assert tracer.attach_context({"trace_id": 7}) is None
+    # span_id is optional: trace-only context adopts without a remote parent
+    def receiver():
+        assert tracer.attach_context({"trace_id": "ab" * 16}) == "ab" * 16
+        with tracer.start_span("x") as r:
+            pass
+        return r
+
+    r = _in_fresh_ctx(receiver)
+    assert r.trace_id == "ab" * 16
+    assert r.parent_id is None
+
+
+def test_rooted_ctx_clears_remote_parent():
+    def receiver():
+        tracer.attach_context({"trace_id": "cd" * 16, "span_id": "ff" * 8})
+        tracer.rooted_ctx(9, "proposer")
+        with tracer.start_span("core/fetcher") as r:
+            pass
+        return r
+
+    r = _in_fresh_ctx(receiver)
+    assert r.trace_id == tracer.duty_trace_id(9, "proposer")
+    assert r.parent_id is None
+
+
+# ---------------------------------------------------------------------------
+# p2p envelope round-trips
+
+
+class _FakeNode:
+    """Captures broadcasts; handler registry like p2p.node.TCPNode."""
+
+    def __init__(self):
+        self.handlers = {}
+        self.sent = []  # (protocol, payload bytes)
+
+    def register_handler(self, protocol, fn):
+        self.handlers[protocol] = fn
+
+    def broadcast(self, protocol, payload):
+        self.sent.append((protocol, payload))
+
+
+def _parsig(i: int) -> ParSignedData:
+    from charon_tpu.core.signeddata import BeaconCommitteeSelection
+
+    return ParSignedData(BeaconCommitteeSelection(3, 100, bytes([i]) * 96), i)
+
+
+def test_parsigex_envelope_roundtrips_trace():
+    tracer.reset_for_testing()
+    duty = Duty(7, DutyType.PREPARE_AGGREGATOR)
+    pk = pubkey_from_bytes(b"\xaa" * 48)
+    sender_node, recv_node = _FakeNode(), _FakeNode()
+    sender = adapters.ParSigExTCPTransport(sender_node)
+    receiver = adapters.ParSigExTCPTransport(recv_node)
+    seen = []
+
+    async def handler(duty_, parsigs_):
+        seen.append((tracer.current_trace_id(), duty_, parsigs_))
+
+    receiver.register(1, handler)
+
+    async def run():
+        tracer.rooted_ctx(duty.slot, str(duty.type))
+        with tracer.start_span("core/parsigex") as s:
+            await sender.broadcast(0, duty, {pk: _parsig(1)})
+        (proto, payload), = sender_node.sent
+        assert proto == adapters.PROTO_PARSIGEX
+        obj = json.loads(payload.decode())
+        assert obj["trace"] == {
+            "trace_id": tracer.duty_trace_id(duty.slot, str(duty.type)),
+            "span_id": s.span_id}
+        # deliver on the "other node" in a fresh task context
+        await asyncio.ensure_future(
+            recv_node.handlers[adapters.PROTO_PARSIGEX](0, payload))
+        return s
+
+    s = _run(run())
+    trace_seen, duty_seen, parsigs_seen = seen[0]
+    assert trace_seen == tracer.duty_trace_id(duty.slot, str(duty.type))
+    assert duty_seen == duty and list(parsigs_seen) == [pk]
+    # the handler span is parented under the SENDER's span
+    recv_spans = [sp for sp in tracer.finished_spans()
+                  if sp.name == "p2p/parsigex_recv"]
+    assert recv_spans and recv_spans[0].parent_id == s.span_id
+    assert recv_spans[0].trace_id == s.trace_id
+
+
+def test_parsigex_envelope_backward_compat_without_stamp():
+    """An old peer's envelope (no "trace" key) still lands in the duty's
+    deterministic trace via the rooted_ctx fallback."""
+    tracer.reset_for_testing()
+    duty = Duty(8, DutyType.PREPARE_AGGREGATOR)
+    pk = pubkey_from_bytes(b"\xbb" * 48)
+    recv_node = _FakeNode()
+    receiver = adapters.ParSigExTCPTransport(recv_node)
+    seen = []
+
+    async def handler(duty_, parsigs_):
+        seen.append(tracer.current_trace_id())
+
+    receiver.register(1, handler)
+    payload = json.dumps({
+        "duty": {"slot": duty.slot, "type": int(duty.type)},
+        "parsigs": {pk: _parsig(2).to_json()},
+    }).encode()
+
+    async def run():
+        await asyncio.ensure_future(
+            recv_node.handlers[adapters.PROTO_PARSIGEX](0, payload))
+
+    _run(run())
+    assert seen == [tracer.duty_trace_id(duty.slot, str(duty.type))]
+    recv_spans = [sp for sp in tracer.finished_spans()
+                  if sp.name == "p2p/parsigex_recv"]
+    assert recv_spans and recv_spans[0].parent_id is None
+
+
+def test_consensus_endpoint_stamp_is_extra_key_only():
+    """The consensus stamp rides the wire dict as an extra top-level key:
+    the original wire keys are untouched (signatures unaffected), and a
+    stripped stamp still reaches the handler (old peer)."""
+    tracer.reset_for_testing()
+    sender_node, recv_node = _FakeNode(), _FakeNode()
+    sender = adapters.ConsensusTCPEndpoint(sender_node)
+    receiver = adapters.ConsensusTCPEndpoint(recv_node)
+    seen = []
+
+    async def handler(wire):
+        seen.append((tracer.current_trace_id(), wire))
+
+    receiver.register(handler)
+    wire = {"msg": {"type": 1}, "justification": [], "values": {}}
+
+    async def run():
+        tracer.rooted_ctx(3, "attester")
+        with tracer.start_span("consensus/instance") as s:
+            await sender.broadcast(wire)
+        (_, payload), = sender_node.sent
+        obj = json.loads(payload.decode())
+        assert {k: obj[k] for k in wire} == wire  # wire keys unchanged
+        assert obj["trace"]["span_id"] == s.span_id
+        await asyncio.ensure_future(
+            recv_node.handlers[adapters.PROTO_CONSENSUS](2, payload))
+        # old-peer frame: no stamp, handler still runs (no adopted trace)
+        del obj["trace"]
+        await asyncio.ensure_future(
+            recv_node.handlers[adapters.PROTO_CONSENSUS](
+                2, json.dumps(obj).encode()))
+        return s
+
+    s = _run(run())
+    assert len(seen) == 2
+    assert seen[0][0] == tracer.duty_trace_id(3, "attester")
+    assert {k: seen[0][1][k] for k in wire} == wire
+    recv_spans = [sp for sp in tracer.finished_spans()
+                  if sp.name == "p2p/consensus_recv"]
+    assert len(recv_spans) == 1  # only the stamped frame opened a recv span
+    assert recv_spans[0].parent_id == s.span_id
+
+
+def test_priority_envelope_is_only_carry():
+    """Non-duty messages have no deterministic trace to fall back to: the
+    stamp is the only carry, and without it no recv span opens."""
+
+    def body():
+        # earlier tests' rooted_ctx calls linger in the main thread's root
+        # context; clear so "no context" is actually observable
+        tracer._current_trace.set(None)
+        tracer._current_span.set(None)
+        tracer._remote_parent.set(None)
+        _priority_body()
+
+    contextvars.copy_context().run(body)
+
+
+def _priority_body():
+    tracer.reset_for_testing()
+    sender_node, recv_node = _FakeNode(), _FakeNode()
+    sender = adapters.PriorityTCPTransport(sender_node)
+    receiver = adapters.PriorityTCPTransport(recv_node)
+    seen = []
+
+    async def handler(sender_idx, slot, topics):
+        seen.append(tracer.current_trace_id())
+
+    receiver.register(handler)
+
+    async def run():
+        # broadcast in its own task so the sender's span context does not
+        # leak into the delivery tasks (like the real node's accept loop)
+        async def send():
+            with tracer.start_span("priority/propose") as s:
+                await sender.broadcast(11, [{"topic": "proto"}])
+            return s
+
+        s = await asyncio.ensure_future(send())
+        (_, payload), = sender_node.sent
+        await asyncio.ensure_future(
+            recv_node.handlers[adapters.PROTO_PRIORITY](1, payload))
+        stripped = json.loads(payload.decode())
+        del stripped["trace"]
+        await asyncio.ensure_future(
+            recv_node.handlers[adapters.PROTO_PRIORITY](
+                1, json.dumps(stripped).encode()))
+        return s
+
+    s = _run(run())
+    assert seen[0] == s.trace_id      # stamped: adopted
+    assert seen[1] is None            # stripped: orphan, no context
+    recv = [sp for sp in tracer.finished_spans()
+            if sp.name == "p2p/priority_recv"]
+    assert len(recv) == 1 and recv[0].parent_id == s.span_id
+
+
+# ---------------------------------------------------------------------------
+# consensus instrumentation
+
+
+def _att_data(slot, seed=0):
+    return AttestationDataUnsigned(
+        spec.AttestationData(
+            slot=slot, index=1,
+            beacon_block_root=bytes([seed]) * 32,
+            source=spec.Checkpoint(0, b"\x00" * 32),
+            target=spec.Checkpoint(1, bytes([seed]) * 32)),
+        spec.AttesterDuty(pubkey=b"\xab" * 48, slot=slot, validator_index=0,
+                          committee_index=1, committee_length=1,
+                          committees_at_slot=1, validator_committee_index=0))
+
+
+class _FastTimer:
+    type = "fast"
+    eager = False
+
+    def new_timer(self, round_):
+        async def wait():
+            await asyncio.sleep(0.15)
+
+        return wait, lambda: None
+
+
+def _counter_values(name, label):
+    return scorecard._counter_series(
+        metrics.default_registry.snapshot(), name, label)
+
+
+def test_consensus_round_change_metrics_and_span_events():
+    """Dead round-1 leader: the other peers time out into round 2 and
+    decide there — the dormant log_round_change hook now feeds the round
+    metrics, and the instance span carries the round_change/decided events."""
+
+    async def run():
+        tracer.reset_for_testing()
+        before_changes = sum(_counter_values(
+            "core_consensus_round_changes_total", "rule").values())
+        before_decided = _counter_values(
+            "core_consensus_decided_total", "round")
+        n = 4
+        fabric = consensus.MemTransport()
+        privs = [k1util.generate_private_key() for _ in range(n)]
+        pubkeys = {i: k1util.public_key(privs[i]) for i in range(n)}
+        comps = []
+        duty = Duty(0, DutyType.ATTESTER)
+        assert consensus.leader(duty, 1, n) == 3  # round-1 leader is dead
+        for i in range(n):
+            ep = fabric.endpoint()
+            if i == 3:
+                ep.register(None)
+                comps.append(None)
+                continue
+            comps.append(consensus.Component(
+                ep, peer_idx=i, nodes=n, privkey=privs[i],
+                peer_pubkeys=pubkeys, deadliner=None, gater=lambda d: True,
+                timer_func=lambda duty: _FastTimer()))
+        decided = {i: [] for i in range(3)}
+
+        def _record(lst, ds):
+            lst.append(ds)
+
+        for i in range(3):
+            comps[i].subscribe(lambda duty_, ds, i=i: _record(decided[i], ds))
+        pk = f"0x{'ab' * 49}"
+        await asyncio.gather(*(comps[i].propose(
+            duty, {pk: _att_data(duty.slot, seed=i)}) for i in range(3)))
+        deadline = asyncio.get_running_loop().time() + 20
+        while not all(decided[i] for i in range(3)):
+            assert asyncio.get_running_loop().time() < deadline
+            await asyncio.sleep(0.05)
+        # the instance span closes when _run_instance exits — give the
+        # instance tasks a moment past the decide callbacks
+        while not any(sp.name == "consensus/instance"
+                      for sp in tracer.finished_spans()):
+            assert asyncio.get_running_loop().time() < deadline
+            await asyncio.sleep(0.05)
+        return before_changes, before_decided
+
+    before_changes, before_decided = _run(run())
+    after_changes = _counter_values(
+        "core_consensus_round_changes_total", "rule")
+    assert sum(after_changes.values()) > before_changes
+    after_decided = _counter_values("core_consensus_decided_total", "round")
+    gt1 = sum(v for r, v in after_decided.items() if int(r) > 1) - \
+        sum(v for r, v in before_decided.items() if int(r) > 1)
+    assert gt1 >= 1  # decided in a round > 1 on at least one peer
+    # round-duration histogram saw the timed-out round end
+    hists = metrics.snapshot_quantiles("core_consensus_round_duration_seconds")
+    assert sum(s["count"] for s in hists.values()) > 0
+    # the instance span carries the events
+    inst = [sp for sp in tracer.finished_spans()
+            if sp.name == "consensus/instance"]
+    assert inst
+    events = [ev.name for sp in inst for ev in sp.events]
+    assert "round_change" in events
+    assert "consensus_decided" in events
+    ev = next(ev for sp in inst for ev in sp.events
+              if ev.name == "consensus_decided")
+    assert int(ev.attrs["round"]) >= 1 and "leader" in ev.attrs
+    # send/recv message accounting moved
+    msgs = _counter_values("core_consensus_msgs_total", "direction")
+    assert msgs.get("send", 0) > 0 and msgs.get("recv", 0) > 0
+
+
+# ---------------------------------------------------------------------------
+# threshold-progress instrumentation
+
+
+def test_parsigdb_quorum_latency_and_contributions():
+    pk = pubkey_from_bytes(b"\xcc" * 48)
+    before = metrics.snapshot_quantiles(
+        "core_parsig_quorum_latency_seconds")
+    before_count = sum(s["count"] for s in before.values())
+    before_contrib = _counter_values(
+        "core_parsig_contributions_total", "share_idx")
+
+    async def run():
+        db = parsigdb.MemDB(3)
+        fires = []
+
+        async def on_threshold(duty, payload):
+            fires.append(payload)
+
+        db.subscribe_threshold(on_threshold)
+        duty = Duty(9, DutyType.PREPARE_AGGREGATOR)
+        for i in (1, 2, 3, 4):  # one extra partial past the threshold
+            await db.store_external(duty, {pk: _parsig(i)})
+        assert len(fires) == 1
+
+    _run(run())
+    after = metrics.snapshot_quantiles("core_parsig_quorum_latency_seconds")
+    assert sum(s["count"] for s in after.values()) == before_count + 1
+    key = 'core_parsig_quorum_latency_seconds{type="prepare_aggregator"}'
+    assert after[key]["count"] >= 1
+    after_contrib = _counter_values(
+        "core_parsig_contributions_total", "share_idx")
+    for i in (1, 2, 3, 4):
+        assert after_contrib.get(str(i), 0) >= \
+            before_contrib.get(str(i), 0) + 1
+    gauge = _counter_values(
+        "core_parsig_partials_at_quorum_count", "type")
+    assert gauge.get("prepare_aggregator") == 3.0  # partials when it FIRED
+
+
+def test_parsigex_result_labels():
+    async def run():
+        deltas = {}
+
+        def snap():
+            return _counter_values("core_parsigex_received_total", "result")
+
+        transport = parsigex.MemTransport()
+        pk = pubkey_from_bytes(b"\xdd" * 48)
+        duty = Duty(4, DutyType.PREPARE_AGGREGATOR)
+
+        # unknown_duty: gater refuses
+        before = snap()
+        ex = parsigex.ParSigEx(transport, 0, gater=lambda d: False)
+        await ex._handle(duty, {pk: _parsig(1)})
+        deltas["unknown_duty"] = snap().get("unknown_duty", 0) - \
+            before.get("unknown_duty", 0)
+
+        # verify_failed: verifier raises
+        async def bad_verify(duty_, parsigs_):
+            raise RuntimeError("bad signature")
+
+        before = snap()
+        ex = parsigex.ParSigEx(transport, 1, gater=lambda d: True,
+                               verify_set=bad_verify)
+        await ex._handle(duty, {pk: _parsig(1)})
+        deltas["verify_failed"] = snap().get("verify_failed", 0) - \
+            before.get("verify_failed", 0)
+
+        # verified: no verifier (simnet shape) counts as verified
+        before = snap()
+        got = []
+
+        async def sink(d, p):
+            got.append(p)
+
+        ex = parsigex.ParSigEx(transport, 2, gater=lambda d: True)
+        ex.subscribe(sink)
+        await ex._handle(duty, {pk: _parsig(1)})
+        assert got
+        deltas["verified"] = snap().get("verified", 0) - \
+            before.get("verified", 0)
+        return deltas
+
+    deltas = _run(run())
+    assert deltas == {"unknown_duty": 1, "verify_failed": 1, "verified": 1}
+
+
+# ---------------------------------------------------------------------------
+# /debug/traces filter + /debug/scorecard
+
+
+async def _get_json(api, path):
+    async with aiohttp.ClientSession() as session:
+        async with session.get(
+                f"http://{api.host}:{api.port}{path}") as resp:
+            return resp.status, await resp.json()
+
+
+def test_debug_traces_trace_id_filter():
+    tracer.reset_for_testing()
+    tracer.rooted_ctx(1, "attester")
+    with tracer.start_span("core/fetcher"):
+        pass
+    tracer.rooted_ctx(2, "attester")
+    with tracer.start_span("core/consensus"):
+        pass
+    want = tracer.duty_trace_id(1, "attester")
+
+    async def run():
+        api = MonitoringAPI(port=0)
+        await api.start()
+        try:
+            status, body = await _get_json(
+                api, f"/debug/traces?trace_id={want}")
+            assert status == 200
+            assert body["total_buffered"] == 1
+            assert [s["name"] for s in body["spans"]] == ["core/fetcher"]
+            assert all(s["trace_id"] == want for s in body["spans"])
+            # chrome format honours the same filter
+            status, chrome = await _get_json(
+                api, f"/debug/traces?fmt=chrome&trace_id={want}")
+            assert status == 200
+            names = {e["name"] for e in chrome["traceEvents"]
+                     if e["ph"] == "X"}
+            assert names == {"core/fetcher"}
+            # no filter: both traces present
+            _, body_all = await _get_json(api, "/debug/traces")
+            assert body_all["total_buffered"] == 2
+        finally:
+            await api.stop()
+
+    _run(run())
+
+
+def test_debug_scorecard_endpoint():
+    async def run():
+        api = MonitoringAPI(port=0)
+        await api.start()
+        try:
+            status, card = await _get_json(api, "/debug/scorecard")
+            assert status == 200
+            assert card["schema"] == scorecard.SCHEMA
+            for key in ("duty_e2e", "missed_duties", "consensus",
+                        "quorum_latency", "parsigex", "fallback", "compiles"):
+                assert key in card
+        finally:
+            await api.stop()
+
+    _run(run())
+
+
+# ---------------------------------------------------------------------------
+# scorecard unit tests (synthetic registries)
+
+
+def test_scorecard_synthetic_registry():
+    reg = metrics.Registry()
+    reg.histogram("core_duty_e2e_latency_seconds", "", ("type",)) \
+        .observe(0.2, "attester")
+    dec = reg.counter("core_consensus_decided_total", "", ("round",))
+    dec.inc("1", amount=3)
+    dec.inc("2")
+    reg.counter("core_consensus_round_changes_total", "", ("rule",)) \
+        .inc("round_timeout")
+    reg.histogram("core_parsig_quorum_latency_seconds", "", ("type",)) \
+        .observe(0.05, "attester")
+    reg.counter("core_parsigex_received_total", "", ("result",)) \
+        .inc("verified", amount=9)
+    reg.counter("core_tracker_failed_duties_total", "", ("step",)) \
+        .inc("consensus")
+    card = scorecard.build_scorecard(
+        reg, compiles={"warmup": 4, "steady": 0}, node="node0",
+        epoch={"slots": [0, 7]})
+    assert card["schema"] == scorecard.SCHEMA
+    assert card["duty_e2e"]["p99_s"] is not None
+    assert card["duty_e2e"]["by"]["attester"]["count"] == 1.0
+    assert card["consensus"]["decided"] == 4.0
+    assert card["consensus"]["rounds_gt1_fraction"] == 0.25
+    assert card["consensus"]["round_changes_by_rule"] == {
+        "round_timeout": 1.0}
+    assert card["quorum_latency"]["p99_s"] is not None
+    assert card["parsigex"]["received_by_result"] == {"verified": 9.0}
+    assert card["missed_duties"] == {"total": 1.0,
+                                     "by_step": {"consensus": 1.0}}
+    assert card["compiles"] == {"warmup": 4, "steady": 0}
+    assert card["node"] == "node0" and card["epoch"] == {"slots": [0, 7]}
+    json.dumps(card)  # JSON-serializable, no Infinity
+
+
+def test_scorecard_empty_registry_renders_nulls():
+    card = scorecard.build_scorecard(
+        metrics.Registry(), compiles={"warmup": 0, "steady": 0})
+    assert card["duty_e2e"]["p99_s"] is None
+    assert card["consensus"]["decided"] == 0
+    assert card["consensus"]["rounds_gt1_fraction"] is None
+    assert card["quorum_latency"]["p99_s"] is None
+    assert card["fallback"]["pairing"]["native_fraction"] is None
+    json.dumps(card)
+
+
+def test_scorecard_p99_saturation_stays_numeric():
+    """A series whose p99 saturates the top bucket substitutes its mean —
+    the scorecard must stay valid JSON (no Infinity)."""
+    reg = metrics.Registry()
+    h = reg.histogram("core_duty_e2e_latency_seconds", "", ("type",))
+    for _ in range(10):
+        h.observe(99.0, "attester")  # far above the top default bucket
+    card = scorecard.build_scorecard(
+        reg, compiles={"warmup": 0, "steady": 0})
+    p99 = card["duty_e2e"]["p99_s"]
+    assert p99 is not None and p99 != float("inf")
+    assert abs(p99 - 99.0) < 1e-6  # the mean substitute
+    json.dumps(card, allow_nan=False)
+
+
+def test_merge_scorecards_cluster_semantics():
+    def _card(decided, gt1_fraction, e2e_p99, steady):
+        reg = metrics.Registry()
+        card = scorecard.build_scorecard(
+            reg, compiles={"warmup": 1, "steady": steady})
+        card["duty_e2e"] = {"p99_s": e2e_p99, "count": 10.0, "by": {}}
+        card["consensus"]["decided"] = decided
+        card["consensus"]["rounds_gt1_fraction"] = gt1_fraction
+        card["quorum_latency"] = {"p99_s": 0.02, "count": 5.0, "by": {}}
+        return card
+
+    merged = scorecard.merge_scorecards({
+        "node0": _card(10, 0.1, 0.3, 0),
+        "node1": _card(10, 0.3, 0.5, 2),
+    })
+    assert merged["duty_e2e"]["p99_s"] == 0.5          # worst node
+    assert merged["duty_e2e"]["count"] == 20.0          # summed
+    assert abs(merged["consensus"]["rounds_gt1_fraction"] - 0.2) < 1e-9
+    assert merged["compiles"]["steady"] == 2            # summed: a finding
+    assert set(merged["nodes"]) == {"node0", "node1"}
+    assert scorecard.merge_scorecards({})["nodes"] == {}
+
+
+def test_write_scorecard(tmp_path):
+    card = scorecard.build_scorecard(
+        metrics.Registry(), compiles={"warmup": 0, "steady": 0})
+    path = scorecard.write_scorecard(str(tmp_path / "card.json"), card)
+    assert json.loads(open(path).read())["schema"] == scorecard.SCHEMA
+
+
+# ---------------------------------------------------------------------------
+# cluster trace merging
+
+
+def _span_dict(trace_id, span_id, name, start, end, parent=None, events=()):
+    return {"trace_id": trace_id, "span_id": span_id, "parent_id": parent,
+            "name": name, "start": start, "end": end, "attrs": {},
+            "events": [{"name": n, "ts": ts, "attrs": {}}
+                       for n, ts in events]}
+
+
+def test_merge_cluster_clock_alignment():
+    t = tracer.duty_trace_id(41, "attester")
+    # node1's clock is 100s ahead of node0's for the same duty
+    node0 = [_span_dict(t, "a1", "consensus/instance", 10.0, 10.4,
+                        events=[("consensus_decided", 10.3)])]
+    node1 = [_span_dict(t, "b1", "consensus/instance", 110.02, 110.41,
+                        parent="a1"),
+             _span_dict("deadbeef" * 4, "b2", "core/fetcher", 111.0, 111.1)]
+    merged = tracer.merge_cluster({"node0": node0, "node1": node1})
+    evs = merged["traceEvents"]
+    xs = {(e["args"]["node"], e["args"]["span_id"]): e
+          for e in evs if e["ph"] == "X"}
+    ref_ts = xs[("node0", "a1")]["ts"]
+    aligned_ts = xs[("node1", "b1")]["ts"]
+    # skew-corrected: the shared trace's first spans line up (±50ms)
+    assert abs(aligned_ts - ref_ts) < 50_000
+    # the unshared trace shifted by the SAME lane offset
+    assert abs(xs[("node1", "b2")]["ts"] - 11.0 * 1e6) < 50_000
+    # lanes are distinct pids; span name shares one tid across lanes
+    assert xs[("node0", "a1")]["pid"] != xs[("node1", "b1")]["pid"]
+    assert xs[("node0", "a1")]["tid"] == xs[("node1", "b1")]["tid"]
+    # parent linkage survives into args for cross-lane drill-down
+    assert xs[("node1", "b1")]["args"]["parent_id"] == "a1"
+    # skew is labeled on the shifted lane's process meta
+    labels = [e["args"]["name"] for e in evs
+              if e["ph"] == "M" and e["name"] == "process_name"]
+    assert any("skew" in lbl and "node1" in lbl for lbl in labels)
+    assert any(lbl == "node0" for lbl in labels)
+    # the instant event shifted with its span
+    inst = [e for e in evs if e["ph"] == "i"]
+    assert inst and abs(inst[0]["ts"] - 10.3 * 1e6) < 1.0
+
+
+def test_merge_cluster_accepts_span_objects_and_no_overlap():
+    tracer.reset_for_testing()
+    tracer.rooted_ctx(2, "attester")
+    with tracer.start_span("core/sigagg"):
+        pass
+    spans = tracer.finished_spans()
+    merged = tracer.merge_cluster({"only": spans}, align=False)
+    xs = [e for e in merged["traceEvents"] if e["ph"] == "X"]
+    assert len(xs) == 1 and xs[0]["name"] == "core/sigagg"

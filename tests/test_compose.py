@@ -90,3 +90,88 @@ class TestComposeFuzz:
                 await cluster.stop()
 
         _run(run())
+
+
+class TestComposeTelemetry:
+    def test_cluster_trace_and_scorecard(self, tmp_path):
+        """4 real node processes: one attestation duty's deterministic trace
+        id collects consensus + parsigex + sigagg spans from ALL FOUR nodes
+        into one merged clock-aligned Chrome trace, and the per-epoch SLO
+        scorecard merges with non-null aggregates and zero steady compiles."""
+
+        async def run():
+            from charon_tpu.utils import tracer
+
+            cluster = ComposeCluster.generate(
+                tmp_path, num_nodes=4, threshold=3, num_validators=1)
+            await cluster.start()
+            try:
+                await cluster.await_attestations(min_count=2, timeout=60)
+
+                # every node must hold consensus/parsigex/sigagg spans of
+                # the SAME duty trace (the recv handlers adopt the sender's
+                # envelope stamp; local steps root the deterministic id).
+                # The earliest slots can predate a slow-starting node's
+                # pipeline, so scan attested slots newest-first.
+                want = ("consensus", "parsigex", "sigagg")
+                deadline = asyncio.get_event_loop().time() + 45
+                trace_id = None
+                attempts = {}
+                while trace_id is None:
+                    slots = sorted({a.data.slot
+                                    for a in cluster.mock.attestations},
+                                   reverse=True)
+                    for slot in slots:
+                        tid = tracer.duty_trace_id(slot, "attester")
+                        per_node = [await cluster.node_spans(i, tid)
+                                    for i in range(4)]
+                        if all(all(any(part in s["name"] for s in spans)
+                                   for part in want)
+                               for spans in per_node):
+                            trace_id = tid
+                            break
+                        attempts[slot] = [
+                            (i, sorted({s["name"] for s in spans}))
+                            for i, spans in enumerate(per_node)]
+                    if trace_id is None:
+                        assert asyncio.get_event_loop().time() < deadline, \
+                            attempts
+                        await asyncio.sleep(0.5)
+
+                # merged Chrome trace: one lane per node, the duty trace id
+                # on every event, clock-aligned lanes
+                merged = await cluster.cluster_trace(
+                    trace_id, out_path=tmp_path / "cluster-trace.json")
+                xs = [e for e in merged["traceEvents"] if e["ph"] == "X"]
+                assert {e["pid"] for e in xs} == {1, 2, 3, 4}
+                assert all(e["args"]["trace_id"] == trace_id for e in xs)
+                assert (tmp_path / "cluster-trace.json").exists()
+                # cross-node parenting: some recv span points at a span id
+                # that lives on a DIFFERENT node's lane
+                by_id = {e["args"]["span_id"]: e for e in xs}
+                assert any(
+                    e["args"].get("parent_id") in by_id
+                    and by_id[e["args"]["parent_id"]]["pid"] != e["pid"]
+                    for e in xs), "no cross-node parent linkage"
+
+                # scorecard: poll until the slower aggregates (duty e2e is
+                # observed at the tracker's deadline) land on every node
+                deadline = asyncio.get_event_loop().time() + 30
+                while True:
+                    card = await cluster.cluster_scorecard(
+                        out_path=tmp_path / "scorecard.json")
+                    if (len(card["nodes"]) == 4
+                            and card["duty_e2e"]["p99_s"] is not None
+                            and card["consensus"]["rounds_gt1_fraction"]
+                            is not None
+                            and card["quorum_latency"]["p99_s"] is not None):
+                        break
+                    assert asyncio.get_event_loop().time() < deadline, card
+                    await asyncio.sleep(0.5)
+                assert card["consensus"]["decided"] >= 1
+                assert card["compiles"]["steady"] == 0
+                assert (tmp_path / "scorecard.json").exists()
+            finally:
+                await cluster.stop()
+
+        _run(run(), timeout=150)

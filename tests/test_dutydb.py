@@ -154,3 +154,35 @@ def test_agg_attestation_and_sync_contribution_resolution():
         assert got2.subcommittee_index == 2
 
     _run(run())
+
+
+def test_deadliner_consumer_cancel_races_wake():
+    """Cancelling an expired() consumer must terminate it promptly even when
+    the cancel races a concurrent add() waking the iterator — the stop() path
+    of every gc/trim task gathers on exactly this."""
+    import time as time_mod
+
+    from charon_tpu.core import deadline
+    from charon_tpu.eth2.spec import ChainSpec
+
+    async def run():
+        spec_obj = ChainSpec(
+            genesis_time=time_mod.time(), seconds_per_slot=10)
+        dl = deadline.Deadliner(deadline.new_duty_deadline_func(spec_obj))
+        assert dl.add(Duty(1_000_000, DutyType.ATTESTER))
+
+        async def consume():
+            async for _ in dl.expired():
+                pass
+
+        for i in range(20):
+            t = asyncio.create_task(consume())
+            await asyncio.sleep(0)
+            # wake and cancel back-to-back in one loop iteration
+            dl.add(Duty(1_000_000 + i, DutyType.ATTESTER))
+            t.cancel()
+            await asyncio.wait_for(
+                asyncio.gather(t, return_exceptions=True), 2)
+            assert t.done()
+
+    _run(run())
