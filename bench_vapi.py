@@ -53,6 +53,22 @@ def _parse_args(argv: list[str] | None) -> argparse.Namespace:
     p.add_argument("--no-selection-storm", action="store_true")
     p.add_argument("--coalesce-budget", type=float, default=12.0,
                    help="sigagg deadline budget (s) behind the 503 shed")
+    p.add_argument("--profile", choices=("steady", "ramp", "spike"),
+                   default="steady",
+                   help="deterministic arrival shaping of the per-slot "
+                        "parsigex storm (testutil/loadgen.PROFILES)")
+    p.add_argument("--autotune", choices=("off", "latency", "throughput"),
+                   default="off",
+                   help="close the loop over the slot-shaping policy "
+                        "(ops/autotune); the trajectory rides the JSON tail")
+    p.add_argument("--initial", choices=("bad", "default"), default="bad",
+                   help="starting SlotPolicy when autotuning: 'bad' is the "
+                        "deliberately mis-tuned flush_at=8/depth=1 the "
+                        "tuner must climb out of (ISSUE 19 acceptance); "
+                        "'default' starts from the hand-tuned resolution")
+    p.add_argument("--microbench", action="store_true",
+                   help="append an autotune-convergence row to "
+                        "MICROBENCH.jsonl (requires --autotune)")
     return p.parse_args(argv)
 
 
@@ -84,6 +100,11 @@ def _config(args: argparse.Namespace):
         genesis_delay=defaults["genesis_delay"],
         vc_timeout=defaults["vc_timeout"],
         coalesce_budget_s=args.coalesce_budget,
+        profile=args.profile,
+        autotune=args.autotune,
+        initial_policy=({"flush_at": 8, "pipeline_depth": 1}
+                        if args.autotune != "off" and args.initial == "bad"
+                        else None),
     )
 
 
@@ -160,6 +181,19 @@ async def _run(cfg) -> dict:
     from charon_tpu.utils import scorecard as scorecard_mod
     tail["scorecard"] = scorecard_mod.build_scorecard(
         compiles=tail["compiles"])
+    at = tail.get("autotune")
+    if at:
+        final = at.get("final", {})
+        print(f"# autotune[{at.get('objective')}]: "
+              f"{at.get('decisions', 0)} decisions, "
+              f"rejections={at.get('rejections', {})}, "
+              f"converged_slot={at.get('converged_slot')}, "
+              f"final flush_at={final.get('flush_at')} "
+              f"depth={final.get('pipeline_depth')} "
+              f"workers={final.get('finish_workers')} "
+              f"budget={final.get('deadline_budget_s')} "
+              f"(epoch {final.get('epoch')}, frozen={at.get('frozen')})",
+              file=sys.stderr)
     shed = report.client_tallies.get("shed_503", 0)
     print(f"# {report.client_requests} client requests in "
           f"{report.elapsed_s:.1f}s ({report.achieved_rps:.1f} req/s), "
@@ -173,10 +207,57 @@ async def _run(cfg) -> dict:
     return tail
 
 
+def _append_microbench(tail: dict, args: argparse.Namespace) -> None:
+    """Append the `autotune-convergence` ledger row (bench.py's
+    MICROBENCH.jsonl idiom: append-only, best-effort — the bench never
+    fails on ledger IO). Records slots-to-converge plus the final knob
+    set vs the hand-tuned target so regressions in the control loop show
+    up the same way kernel regressions do."""
+    import os
+    import pathlib
+    import subprocess
+
+    at = tail.get("autotune") or {}
+    try:
+        commit = subprocess.run(
+            ["git", "rev-parse", "--short", "HEAD"],
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+            capture_output=True, text=True, timeout=10).stdout.strip()
+    except Exception:  # noqa: BLE001
+        commit = "unknown"
+    rec = {
+        "ts": round(time.time(), 1),
+        "commit": commit or "unknown",
+        "metric": "autotune-convergence",
+        "profile": args.profile,
+        "objective": at.get("objective"),
+        "slots": tail.get("slots_run"),
+        "slots_to_converge": at.get("converged_slot"),
+        "decisions": at.get("decisions"),
+        "rejections": at.get("rejections"),
+        "frozen": at.get("frozen"),
+        "final": at.get("final"),
+        "hand_tuned": at.get("hand_tuned"),
+        "achieved_rps": tail.get("achieved_rps"),
+        "steady_compiles": (tail.get("compiles") or {}).get("steady"),
+        "tag": "bench_vapi",
+    }
+    try:
+        path = pathlib.Path(__file__).resolve().parent / "MICROBENCH.jsonl"
+        with open(path, "a") as fh:
+            fh.write(json.dumps(rec) + "\n")
+    except OSError:
+        pass
+    print(f"# microbench row appended: autotune-convergence @ {commit}",
+          file=sys.stderr)
+
+
 def main(argv: list[str] | None = None) -> None:
     args = _parse_args(argv)
     cfg = _config(args)
     tail = asyncio.run(_run(cfg))
+    if args.microbench and args.autotune != "off":
+        _append_microbench(tail, args)
     print(json.dumps(tail))
 
 

@@ -103,6 +103,13 @@ class Config:
     # dispatch backlog before the coalescer sheds new submissions (the
     # router answers 503 + Retry-After); None disables admission control
     coalesce_budget_s: float | None = 12.0
+    # closed-loop slot-policy autotuner (ops/autotune.py, docs/perf.md
+    # "slot shaping"): "off" keeps the static policy; "latency" sheds
+    # deadline budget to defend the vapi p99 SLO under spikes;
+    # "throughput" grows flush/depth/workers toward device saturation.
+    # Any non-off mode installs the initial SlotPolicy from this Config
+    # and subscribes the tuner to the scheduler's slot ticks.
+    autotune_mode: str = "off"
     # largest request body the validator-API router will read (413 above)
     vapi_max_body_bytes: int = 2 * 1024 * 1024
     test: TestConfig = field(default_factory=TestConfig)
@@ -128,6 +135,7 @@ class App:
     infosync: infosync_mod.InfoSync | None = None
     recaster: bcast_mod.Recaster | None = None
     beacon: object = None
+    autotuner: object = None  # ops/autotune.AutoTuner when autotune_mode != off
     tasks: list[asyncio.Task] = field(default_factory=list)
     _dbs: list = field(default_factory=list)
 
@@ -454,6 +462,27 @@ async def assemble(config: Config) -> App:
     agg.subscribe(recaster.on_broadcast)
     sched.subscribe_slots(recaster.on_slot)
 
+    # Closed-loop slot-policy autotuner (ops/autotune, docs/perf.md "slot
+    # shaping"): install the Config-derived initial SlotPolicy so every
+    # consumer reads one atomic snapshot, then subscribe the tuner to the
+    # slot ticks — one observation + at most one knob move per slot. The
+    # hand-tuned target the throughput objective converges toward is the
+    # policy resolution as configured (Config fields → env → defaults).
+    autotuner = None
+    if config.autotune_mode != "off":
+        from ..ops import autotune as autotune_mod
+        from ..ops import policy as policy_mod
+        from . import config as appconfig_mod
+
+        policy_mod.install(appconfig_mod.initial_policy(config))
+        autotuner = autotune_mod.AutoTuner(
+            config.autotune_mode, slot_seconds=chain.seconds_per_slot)
+        autotuner.bind(coalescer=coalescer)
+        sched.subscribe_slots(autotuner.on_slot)
+        _log.info("slot-policy autotuner armed",
+                  objective=config.autotune_mode,
+                  policy_epoch=policy_mod.current().epoch)
+
     vapi_router = VapiRouter(vapi, bn_base_url=config.beacon_urls[0] if config.beacon_urls else None,
                              host=config.vapi_host, port=config.vapi_port,
                              coalescer=coalescer,
@@ -465,7 +494,7 @@ async def assemble(config: Config) -> App:
     health = Checker(quorum_peers=quorum)
 
     app = App(config=config, node=node, sched=sched, vapi=vapi,
-              recaster=recaster, beacon=beacon,
+              recaster=recaster, beacon=beacon, autotuner=autotuner,
               vapi_router=vapi_router, monitoring=monitoring, tracker=track,
               inclusion=inclusion, health=health, ping=ping, peerinfo=peerinfo,
               relay_client=relay_client, keys=keys, lock=lock,

@@ -1,0 +1,63 @@
+"""Config → SlotPolicy bridge: the initial-value layer of the policy seam.
+
+`app.Config` carries operator-set knob values (CLI flags, deployment
+config); this module turns them into the initial
+:class:`~charon_tpu.ops.policy.SlotPolicy` snapshot `app.assemble`
+installs when autotuning is on. Fields the operator did not set stay
+``None`` (unmanaged), so the policy accessors fall through to the env
+vars and built-in defaults — env vars remain initial-value overrides,
+exactly as before the seam existed.
+
+Alongside `ops/policy.py`, this file is one of the two modules where
+reading the slot-shaping knob env vars is sanctioned (LINT-TPU-023):
+config parsing is definitionally the place where environment becomes
+configuration.
+"""
+
+from __future__ import annotations
+
+import os
+
+from ..ops import policy as policy_mod
+
+
+def initial_policy(config, **overrides) -> policy_mod.SlotPolicy:
+    """The SlotPolicy snapshot assemble installs for a node built from
+    `config`. Precedence per knob: explicit `overrides` (the bench
+    harness's deliberately-bad starting point) → Config field → None
+    (unmanaged: the accessors resolve env → default lazily). The
+    coalescer admission budget IS lifted from Config: assemble only
+    installs this snapshot when a tuner is armed, and the budget is the
+    latency objective's shed rung — it must be policy-managed for the
+    tuner to move it (an un-tuned node keeps the budget local to
+    `TblsCoalescer` and never installs a policy)."""
+    fields = dict(
+        sigagg_devices=config.sigagg_devices,
+        deadline_budget_s=config.coalesce_budget_s,
+        breaker_threshold=config.breaker_threshold,
+        breaker_cooldown_s=config.breaker_cooldown_s,
+        slot_deadline_s=config.slot_deadline_s,
+    )
+    fields.update(overrides)
+    return policy_mod.SlotPolicy(**fields)
+
+
+def env_overrides() -> dict:
+    """The knob env vars currently set in the process environment, as a
+    `{policy_field: raw_string}` dict — diagnostic surface for logs and
+    the monitoring API (which env-layer values the lazy accessors would
+    resolve). Reading them here (not at the consumer sites) is the whole
+    point of the seam."""
+    mapping = {
+        "pipeline_depth": policy_mod.ENV_PIPELINE_DEPTH,
+        "finish_workers": policy_mod.ENV_FINISH_WORKERS,
+        "sigagg_devices": policy_mod.ENV_SIGAGG_DEVICES,
+        "device_verify": policy_mod.ENV_DEVICE_VERIFY,
+        "field_plane": policy_mod.ENV_FIELD_PLANE,
+        "h2c_cache_cap": policy_mod.ENV_H2C_CACHE_CAP,
+        "breaker_threshold": policy_mod.ENV_BREAKER_THRESHOLD,
+        "breaker_cooldown_s": policy_mod.ENV_BREAKER_COOLDOWN,
+        "slot_deadline_s": policy_mod.ENV_SLOT_DEADLINE,
+    }
+    return {field: os.environ[env] for field, env in mapping.items()
+            if env in os.environ}

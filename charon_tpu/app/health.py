@@ -85,6 +85,18 @@ class MetricWindow:
                 if mname == name and all(lbl in key for lbl in label_filter)]
         return max(vals) if vals else 0.0
 
+    def histogram_quantile_first(self, name: str, *label_filter: str,
+                                 stat: str = "p99") -> float:
+        """Like histogram_quantile but over the OLDEST buffered scrape —
+        the window-start baseline. Trend rules compare it against the
+        latest value: the autotune oscillation check fails when the tuner
+        keeps deciding while this baseline never improves."""
+        if not self._snaps:
+            return 0.0
+        vals = [h[stat] for (mname, key), h in self._snaps[0][2].items()
+                if mname == name and all(lbl in key for lbl in label_filter)]
+        return max(vals) if vals else 0.0
+
     def counter_delta(self, name: str, *label_filter: str) -> float:
         """Counter increase over the buffered window. A series appearing
         mid-window counts from zero (counters are monotonic)."""
@@ -215,6 +227,28 @@ def default_checks(quorum_peers: int,
               lambda w: w.histogram_quantile(
                   "core_parsig_quorum_latency_seconds")
               > slot_seconds / 3),
+        Check("autotune_oscillating",
+              "the slot-policy tuner is churning without improving the "
+              "front door: more than 6 accepted moves in the window "
+              "(ops_autotune_decisions_total) while the vapi p99 is no "
+              "better than it was at window start — the control loop is "
+              "hunting; pin the knobs (autotune_mode=off) or widen the "
+              "objective's tolerance (docs/perf.md slot shaping)",
+              lambda w: (w.counter_delta("ops_autotune_decisions_total") > 6
+                         and w.histogram_quantile_first(
+                             "vapi_route_latency_seconds") > 0
+                         and w.histogram_quantile(
+                             "vapi_route_latency_seconds")
+                         >= w.histogram_quantile_first(
+                             "vapi_route_latency_seconds"))),
+        Check("policy_epoch_stale",
+              "the tuner recorded accepted decisions in the window "
+              "(ops_autotune_decisions_total moved) but the installed "
+              "policy epoch (ops_policy_epoch) did not advance — decisions "
+              "are not reaching the policy seam, so consumers are running "
+              "on a stale snapshot (docs/perf.md slot shaping)",
+              lambda w: (w.counter_delta("ops_autotune_decisions_total") > 0
+                         and w.gauge_delta("ops_policy_epoch") <= 0)),
         Check("high_error_log_rate", "more than 5 error logs in the window",
               lambda w: w.counter_delta("log_messages_total", "error") > 5),
         Check("high_warning_log_rate", "more than 10 warning logs in the window",

@@ -70,10 +70,15 @@ class _Window:
     `window` seconds after the first submission. `dispatch(reqs)` runs in
     an asyncio task and must resolve every request's future itself."""
 
-    def __init__(self, kind: str, window: float, flush_at: int, dispatch):
+    def __init__(self, kind: str, window: float, flush_at: int | None,
+                 dispatch):
         self.kind = kind
         self.window = window
-        self.flush_at = flush_at
+        # None = policy-managed: resolve through the SlotPolicy seam on
+        # every trigger check, so a tuner move or a mesh clamp change is
+        # reflected by the NEXT submission without rebuilding the window
+        # (ISSUE-19 bugfix — this used to be frozen at construction).
+        self._flush_at = flush_at
         self._dispatch = dispatch
         self._q: list[tuple[int, object, asyncio.Future]] = []
         self._timer: asyncio.TimerHandle | None = None
@@ -83,6 +88,21 @@ class _Window:
         self._seen: dict[object, set] = {}
         self._expected: dict[object, int] = {}
         self._unkeyed = 0
+
+    @property
+    def flush_at(self) -> int:
+        """The live count trigger: an explicit constructor value wins,
+        otherwise the SlotPolicy resolution (installed policy → env →
+        TILE × resolved mesh devices, recomputed per call)."""
+        if self._flush_at is not None:
+            return self._flush_at
+        from ..ops import policy as policy_mod
+
+        return policy_mod.flush_at_default()
+
+    @flush_at.setter
+    def flush_at(self, value: int | None) -> None:
+        self._flush_at = value
 
     async def submit(self, size: int, payload, key=None,
                      expected: int | None = None, contributor=None):
@@ -234,11 +254,11 @@ class TblsCoalescer:
         # below min_device_batch/min_device_verify, so a count-triggered
         # flush always takes the device path; the window timer still
         # bounds latency for batches that never fill.
-        if flush_at is None:
-            from ..ops import mesh as mesh_mod
-            from ..ops.pallas_plane import TILE
-
-            flush_at = TILE * max(1, mesh_mod.device_count())
+        #
+        # flush_at=None stays None here: the windows resolve it through
+        # the SlotPolicy seam on every trigger check (ops/policy
+        # .flush_at_default recomputes TILE × device_count), so a mesh
+        # clamp change or a tuner move lands without a restart.
         self._agg = _Window("agg", window, flush_at, self._dispatch_agg)
         self._ver = _Window("verify", window, flush_at, self._dispatch_ver)
         self.flushes = 0
@@ -249,13 +269,28 @@ class TblsCoalescer:
         # `overload_streak` CONSECUTIVE device-class flush failures new
         # work is shed for `overload_cooldown_s` (half-open style: the
         # first successful dispatch after the cooldown clears the state).
-        self.deadline_budget_s = deadline_budget_s
+        self._deadline_budget_s = deadline_budget_s
         self.overload_streak = max(1, overload_streak)
         self.overload_cooldown_s = overload_cooldown_s
         self._inflight = 0            # fused dispatches currently running
         self._ewma_s = 0.0            # smoothed wall time per fused dispatch
         self._device_fail_streak = 0  # consecutive device-class failures
         self._overloaded_until = 0.0  # monotonic instant fail-fast expires
+
+    @property
+    def deadline_budget_s(self) -> float | None:
+        """The live admission budget: a policy-MANAGED value (the
+        autotuner shedding under a spike) overrides the constructor/
+        assigned value; an unmanaged policy (deadline_budget_s=None)
+        leaves the local value — including admission-off None — alone."""
+        from ..ops import policy as policy_mod
+
+        managed = policy_mod.deadline_budget_override()
+        return managed if managed is not None else self._deadline_budget_s
+
+    @deadline_budget_s.setter
+    def deadline_budget_s(self, value: float | None) -> None:
+        self._deadline_budget_s = value
 
     # ---- public API ------------------------------------------------------
 

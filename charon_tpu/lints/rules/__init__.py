@@ -13,8 +13,9 @@ from .jit import JitCacheKeyRule, TraceHazardRule, TransferRule
 from .obs import DutySpanRule, MetricDriftRule
 from .sec import SecretTaintRule
 from .tpu import (DeviceDtypeRule, FieldPlaneRoutingRule,
-                  MeshTopologyRule, NativePairingRoutingRule,
-                  PipelineLockSyncRule, PlaneStoreRoutingRule)
+                  KnobEnvReadRule, MeshTopologyRule,
+                  NativePairingRoutingRule, PipelineLockSyncRule,
+                  PlaneStoreRoutingRule)
 from .vapi import StrictBodyRule
 
 __all__ = [
@@ -28,6 +29,7 @@ __all__ = [
     "MeshTopologyRule",
     "NativePairingRoutingRule",
     "FieldPlaneRoutingRule",
+    "KnobEnvReadRule",
     "ProtocolImplRule",
     "DutySpanRule",
     "StrictBodyRule",
@@ -56,6 +58,7 @@ def default_rules() -> list:
         MeshTopologyRule(),
         NativePairingRoutingRule(),
         FieldPlaneRoutingRule(),
+        KnobEnvReadRule(),
         ProtocolImplRule(),
         DutySpanRule(),
         StrictBodyRule(),

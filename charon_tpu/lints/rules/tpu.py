@@ -485,3 +485,128 @@ class FieldPlaneRoutingRule:
                 "escapes the XLA-vs-Pallas bit-identity oracle, and can't "
                 "be A/B'd by bench_stages --field-plane; route the product "
                 "through ops.curve._mont_mul (or _fq_mul_many)")
+
+
+# The slot-shaping knob env vars — mirrors the ENV_* block in
+# ops/policy.py (the knob list is the contract between the two files).
+_KNOB_ENV_NAMES = frozenset({
+    "CHARON_TPU_PIPELINE_DEPTH",
+    "CHARON_TPU_FINISH_WORKERS",
+    "CHARON_TPU_SIGAGG_DEVICES",
+    "CHARON_TPU_DEVICE_VERIFY",
+    "CHARON_TPU_FIELD_PLANE",
+    "CHARON_TPU_H2C_CACHE_CAP",
+    "CHARON_TPU_BREAKER_THRESHOLD",
+    "CHARON_TPU_BREAKER_COOLDOWN_S",
+    "CHARON_TPU_SLOT_DEADLINE_S",
+})
+# Exported constant names that carry a knob env name across modules
+# (policy's canonical ENV_* plus the compatibility re-exports in
+# ops/mesh and ops/guard) — `os.environ.get(guard.SLOT_DEADLINE_ENV)`
+# is the same bypass as spelling the string out.
+_KNOB_ENV_CONSTS = {
+    "ENV_PIPELINE_DEPTH": "CHARON_TPU_PIPELINE_DEPTH",
+    "ENV_FINISH_WORKERS": "CHARON_TPU_FINISH_WORKERS",
+    "ENV_SIGAGG_DEVICES": "CHARON_TPU_SIGAGG_DEVICES",
+    "ENV_DEVICE_VERIFY": "CHARON_TPU_DEVICE_VERIFY",
+    "ENV_FIELD_PLANE": "CHARON_TPU_FIELD_PLANE",
+    "ENV_H2C_CACHE_CAP": "CHARON_TPU_H2C_CACHE_CAP",
+    "ENV_BREAKER_THRESHOLD": "CHARON_TPU_BREAKER_THRESHOLD",
+    "ENV_BREAKER_COOLDOWN": "CHARON_TPU_BREAKER_COOLDOWN_S",
+    "ENV_SLOT_DEADLINE": "CHARON_TPU_SLOT_DEADLINE_S",
+    "DEVICES_ENV": "CHARON_TPU_SIGAGG_DEVICES",
+    "BREAKER_THRESHOLD_ENV": "CHARON_TPU_BREAKER_THRESHOLD",
+    "BREAKER_COOLDOWN_ENV": "CHARON_TPU_BREAKER_COOLDOWN_S",
+    "SLOT_DEADLINE_ENV": "CHARON_TPU_SLOT_DEADLINE_S",
+}
+
+
+class KnobEnvReadRule:
+    id = "LINT-TPU-023"
+    description = ("slot-shaping knob env vars are read ONLY by the policy "
+                   "seam (ops/policy.py accessors, app/config.py parsing) — "
+                   "an os.environ read elsewhere sees the process-start "
+                   "value and silently ignores the installed SlotPolicy "
+                   "snapshot the autotuner is steering")
+
+    @staticmethod
+    def _sanctioned(src: SourceFile) -> bool:
+        base = src.rel.split("/")[-1]
+        return ((base == "policy.py" and src.in_dir("ops"))
+                or (base == "config.py" and src.in_dir("app")))
+
+    @staticmethod
+    def _module_consts(tree: ast.Module) -> dict[str, str]:
+        """Module-level `NAME = <knob env>` string constants, resolving one
+        level of indirection through literals, knob-carrying attribute
+        re-exports, and already-resolved local names."""
+        env: dict[str, str] = {}
+        for stmt in tree.body:
+            if not (isinstance(stmt, ast.Assign)
+                    and all(isinstance(t, ast.Name) for t in stmt.targets)):
+                continue
+            val = stmt.value
+            name: str | None = None
+            if (isinstance(val, ast.Constant) and isinstance(val.value, str)
+                    and val.value in _KNOB_ENV_NAMES):
+                name = val.value
+            elif (isinstance(val, ast.Attribute)
+                    and val.attr in _KNOB_ENV_CONSTS):
+                name = _KNOB_ENV_CONSTS[val.attr]
+            elif isinstance(val, ast.Name) and val.id in env:
+                name = env[val.id]
+            if name is not None:
+                for tgt in stmt.targets:
+                    env[tgt.id] = name  # type: ignore[union-attr]
+        return env
+
+    @staticmethod
+    def _knob_name(node: ast.expr, consts: dict[str, str]) -> str | None:
+        """The knob env name `node` denotes, or None."""
+        if isinstance(node, ast.Constant) and isinstance(node.value, str):
+            return node.value if node.value in _KNOB_ENV_NAMES else None
+        if isinstance(node, ast.Name):
+            return consts.get(node.id) or _KNOB_ENV_CONSTS.get(node.id)
+        if isinstance(node, ast.Attribute):
+            return _KNOB_ENV_CONSTS.get(node.attr)
+        return None
+
+    @staticmethod
+    def _is_environ(node: ast.expr) -> bool:
+        return ((isinstance(node, ast.Name) and node.id == "environ")
+                or (isinstance(node, ast.Attribute)
+                    and node.attr == "environ"))
+
+    def check(self, src: SourceFile) -> Iterable[Finding]:
+        # whole-package scope; the seam and the config parser are the two
+        # sanctioned readers. Env WRITES (mesh.set_override, guard.
+        # configure) stay legal everywhere — they feed the initial-value
+        # layer the accessors then resolve.
+        if self._sanctioned(src):
+            return
+        consts = self._module_consts(src.tree)
+        for node in ast.walk(src.tree):
+            knob: str | None = None
+            if isinstance(node, ast.Call):
+                func = node.func
+                is_get = (isinstance(func, ast.Attribute)
+                          and func.attr == "get"
+                          and self._is_environ(func.value))
+                is_getenv = ((isinstance(func, ast.Attribute)
+                              and func.attr == "getenv")
+                             or (isinstance(func, ast.Name)
+                                 and func.id == "getenv"))
+                if (is_get or is_getenv) and node.args:
+                    knob = self._knob_name(node.args[0], consts)
+            elif (isinstance(node, ast.Subscript)
+                    and isinstance(node.ctx, ast.Load)
+                    and self._is_environ(node.value)):
+                knob = self._knob_name(node.slice, consts)
+            if knob is None:
+                continue
+            yield Finding(
+                src.rel, node.lineno, self.id,
+                f"env read of slot-shaping knob `{knob}` bypasses the "
+                "SlotPolicy seam; call the matching ops.policy accessor "
+                "(policy resolves installed snapshot -> env -> default, so "
+                "tuner moves and test monkeypatching both keep working)")

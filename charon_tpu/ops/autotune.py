@@ -1,0 +1,487 @@
+"""Online slot-policy autotuner — closes the loop over ops/policy.py.
+
+The paper's hard part 3 is "a batching window policy that hits p50
+latency targets at 12 s slots while filling the device" (SURVEY §7).
+Every signal needed to tune that policy already exists — the five-phase
+`ops_device_dispatch_seconds` split, the finish/verify backlog gauges,
+the coalescer's arrival/overload counters, route-level vapi latency,
+and the PR-15 compile sentinel — but until now the levers were hand-set
+constants. This module consumes those signals between slots and moves
+the :class:`~charon_tpu.ops.policy.SlotPolicy` knobs under an explicit
+objective:
+
+  * ``throughput`` — fill the device: grow `flush_at` toward the
+    hand-tuned TILE×devices window, restore pipeline depth to double
+    buffering, and widen the finish pool when the stage-3 backlog is
+    the bound. The convergence bar (ISSUE 19): from a deliberately bad
+    start (flush_at=8, depth=1), reach ≥85% of the hand-tuned
+    validators/s with zero steady-state compiles.
+  * ``latency`` — protect the vapi p99 SLO: when the route p99 (or a
+    shed/overload burst) crosses the line, shed the coalescer's
+    deadline budget so the front door 503s early instead of queueing
+    the spike; restore the budget once the spike clears.
+
+**The compile sentinel is a hard constraint, not a signal.** Every
+`flush_at` candidate is mapped to the pow2 bucket signature the device
+verify graphs actually compile (`ops/buckets.pow2_bucket`, the same
+math as `plane_agg.warm_verify_graphs`); once the steady-state window
+is armed, a candidate whose signature is not in the warmed set is
+rejected before it can trigger an in-window recompile
+(`ops_autotune_rejected_total{reason="bucket"}`). A sentinel strike
+while tuning FREEZES the policy: the tuner stops moving anything and
+counts `reason="sentinel_strike"` / `reason="frozen"` instead — a
+recompiling policy is worse than a suboptimal one.
+
+Decisions are deterministic functions of the observation stream (no
+wall clock, no randomness): tests feed synthetic
+:class:`Observation`\\ s and assert the exact trajectory. Each applied
+decision bumps the policy epoch, increments
+`ops_autotune_decisions_total{knob}`, and emits an `autotune.decision`
+tracer span event; the full trajectory rides bench_vapi's JSON tail.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from ..utils import log, metrics, tracer
+from . import policy as policy_mod
+
+_log = log.with_topic("autotune")
+
+OBJECTIVES = ("latency", "throughput")
+
+_decisions_c = metrics.counter(
+    "ops_autotune_decisions_total",
+    "Slot-policy moves the autotuner applied, by knob "
+    "(flush_at / pipeline_depth / finish_workers / deadline_budget_s)",
+    ("knob",))
+_rejected_c = metrics.counter(
+    "ops_autotune_rejected_total",
+    "Candidate policy moves the autotuner rejected, by reason: bucket = "
+    "the move would leave the warmed pow2 bucket set and recompile "
+    "inside the steady window, sentinel_strike = a steady-state "
+    "recompile fired while tuning (policy freezes), frozen = move "
+    "proposed after the freeze, degraded = plane breaker open or "
+    "fallbacks moving (never tune a failing plane)",
+    ("reason",))
+
+#: Smallest flush window the tuner will propose — below this the batch
+#: cannot reach the device-eligibility minimum and coalescing is moot.
+MIN_FLUSH = 8
+MAX_DEPTH = 4
+MAX_FINISH_WORKERS = 8
+
+
+@dataclass(frozen=True)
+class Observation:
+    """One slot's observed signals, in whatever units the registry
+    serves (seconds / items / counts-per-slot deltas). Deterministic
+    tests construct these directly; production builds them with
+    :class:`RegistryObserver`."""
+
+    slot: int
+    vapi_p99_s: float = 0.0        # route-level p99 this window
+    arrival_rate: float = 0.0      # coalescer submissions/s
+    backlog_seconds: float = 0.0   # coalescer drain estimate
+    finish_backlog: float = 0.0    # ops_sigagg_finish_backlog gauge
+    verify_backlog: float = 0.0    # ops_sigagg_verify_backlog gauge
+    shed: float = 0.0              # overload 503s this slot (delta)
+    fallbacks: float = 0.0         # ops_sigagg_fallback_total delta
+    breaker_open: bool = False     # ops_plane_breaker_state != closed
+    steady_compiles: int = 0       # sentinel steady count (cumulative)
+    phase_p50_s: dict = field(default_factory=dict)  # pack/execute/...
+
+
+def bucket_signature(flush_at: int, pair_tile: int | None = None,
+                     h2c_max: int | None = None) -> tuple:
+    """The pow2 bucket family a `flush_at` window compiles, mirroring
+    `plane_agg.warm_verify_graphs`: the monolithic pairing bucket for
+    flush_at+1 pairs (capped at the pair tile, beyond which slots run
+    the chunked family at a FIXED tile bucket), and the capped h2c
+    miss-set bucket. Two flush values with equal signatures dispatch
+    bit-identical graph shapes — moving between them can never
+    recompile. `pair_tile`/`h2c_max` default from ops.pairing/ops.h2c
+    and fall back to their production constants when jax is absent
+    (tests exercise the math without a backend)."""
+    from . import buckets
+
+    if pair_tile is None or h2c_max is None:
+        try:
+            from . import h2c as h2c_mod
+            from . import pairing as pairing_mod
+
+            pair_tile = pair_tile or pairing_mod.MAX_PAIR_TILE
+            h2c_max = h2c_max or h2c_mod.MAX_BATCH
+        except Exception:  # noqa: BLE001 — no backend: production constants
+            pair_tile = pair_tile or 512
+            h2c_max = h2c_max or 1024
+    pairs = flush_at + 1
+    pair_bucket = min(pair_tile, buckets.pow2_bucket(pairs, floor=2))
+    chunked = pairs > pair_tile
+    h2c_bucket = min(h2c_max, buckets.pow2_bucket(max(1, flush_at), floor=2))
+    return (pair_bucket, chunked, h2c_bucket)
+
+
+@dataclass
+class Decision:
+    """One applied (or rejected) tuner move."""
+
+    slot: int
+    knob: str
+    old: object
+    new: object
+    reason: str
+    accepted: bool
+    epoch: int = 0
+
+    def to_json(self) -> dict:
+        return {"slot": self.slot, "knob": self.knob, "old": self.old,
+                "new": self.new, "reason": self.reason,
+                "accepted": self.accepted, "epoch": self.epoch}
+
+
+class AutoTuner:
+    """Between-slots controller over the SlotPolicy seam (module doc).
+
+    `steady_armed`/`steady_compiles` are injectable suppliers so tests
+    pin the sentinel state without arming the real global window; they
+    default to the PR-15 sentinel.
+    """
+
+    def __init__(self, objective: str, slot_seconds: float = 12.0,
+                 slo_s: float | None = None,
+                 hand_tuned: policy_mod.SlotPolicy | None = None,
+                 steady_armed=None, steady_compiles=None,
+                 pair_tile: int | None = None, h2c_max: int | None = None):
+        if objective not in OBJECTIVES:
+            raise ValueError(
+                f"objective must be one of {OBJECTIVES}, got {objective!r}")
+        self.objective = objective
+        self.slot_seconds = slot_seconds
+        # the serving SLO the latency objective defends: a third of the
+        # slot, same line the vapi_latency_high health rule draws
+        self.slo_s = slo_s if slo_s is not None else slot_seconds / 3.0
+        # the hand-tuned steady state this host would be configured to by
+        # an operator: the resolved defaults (TILE×devices flush, depth 2,
+        # 2 finish workers) — the throughput objective's target and the
+        # warm bucket set's anchor
+        self.hand_tuned = (hand_tuned if hand_tuned is not None
+                          else policy_mod.current())
+        self._pair_tile, self._h2c_max = pair_tile, h2c_max
+        self._steady_armed = (steady_armed if steady_armed is not None
+                              else self._sentinel_armed)
+        self._steady_compiles = (steady_compiles
+                                 if steady_compiles is not None
+                                 else self._sentinel_steady)
+        self._base_compiles = self._steady_compiles()
+        # bucket families already compiled: the warmed set (anchored at
+        # the hand-tuned flush) plus whatever the starting policy already
+        # traced during warmup; accepted warmup moves extend it.
+        start = policy_mod.flush_at_default()
+        self._visited = {self._sig(self.hand_tuned.flush_at or start),
+                         self._sig(start)}
+        self.frozen = False
+        self._calm_slots = 0       # consecutive healthy slots (latency)
+        self.decisions: list[Decision] = []
+        self.rejections: dict[str, int] = {}
+        self.policy_epochs: list[dict] = []
+        self._record_epoch(slot=-1)
+
+    # -- sentinel plumbing -------------------------------------------------
+
+    @staticmethod
+    def _sentinel_armed() -> bool:
+        from . import sentinel
+
+        return sentinel.steady_armed()
+
+    @staticmethod
+    def _sentinel_steady() -> int:
+        from . import sentinel
+
+        return sentinel.compiles_summary().get("steady", 0)
+
+    def _sig(self, flush_at: int) -> tuple:
+        return bucket_signature(flush_at, self._pair_tile, self._h2c_max)
+
+    # -- bookkeeping -------------------------------------------------------
+
+    def _record_epoch(self, slot: int) -> None:
+        pol = policy_mod.current()
+        self.policy_epochs.append({
+            "slot": slot, "epoch": pol.epoch,
+            "flush_at": pol.flush_at,
+            "pipeline_depth": pol.pipeline_depth,
+            "finish_workers": pol.finish_workers,
+            "deadline_budget_s": pol.deadline_budget_s,
+        })
+
+    def _reject(self, slot: int, knob: str, old, new, reason: str) -> None:
+        self.rejections[reason] = self.rejections.get(reason, 0) + 1
+        _rejected_c.inc(reason)
+        self.decisions.append(Decision(slot, knob, old, new, reason, False))
+        tracer.event("autotune.rejected", slot=slot, knob=knob,
+                     reason=reason, old=old, new=new)
+
+    def _apply(self, slot: int, knob: str, old, new, reason: str) -> Decision:
+        pol = policy_mod.update(**{knob: new})
+        _decisions_c.inc(knob)
+        dec = Decision(slot, knob, old, new, reason, True, epoch=pol.epoch)
+        self.decisions.append(dec)
+        self._record_epoch(slot)
+        tracer.event("autotune.decision", slot=slot, knob=knob,
+                     old=old, new=new, reason=reason, epoch=pol.epoch)
+        _log.info("autotune decision", slot=slot, knob=knob, old=old,
+                  new=new, reason=reason, objective=self.objective)
+        return dec
+
+    def _try_flush(self, slot: int, old: int, new: int,
+                   reason: str) -> Decision | None:
+        """Apply a flush_at move under the sentinel constraint: inside an
+        armed steady window only already-compiled bucket families are
+        reachable; during warmup a new family compiles now (and joins the
+        visited set) rather than later."""
+        sig = self._sig(new)
+        if sig not in self._visited:
+            if self._steady_armed():
+                self._reject(slot, "flush_at", old, new, "bucket")
+                return None
+            self._visited.add(sig)
+        return self._apply(slot, "flush_at", old, new, reason)
+
+    # -- the control loop --------------------------------------------------
+
+    def observe(self, obs: Observation) -> Decision | None:
+        """Consume one slot's signals; apply at most ONE policy move (a
+        between-slots controller that moves one knob at a time is
+        attributable — the oscillation health rule can pin any thrash on
+        a single signal). Returns the applied decision, or None."""
+        if self._steady_compiles() > self._base_compiles and not self.frozen:
+            # a compile landed inside the armed steady window WHILE we
+            # were steering — whatever we believed about the warmed set
+            # is wrong; freeze rather than dig deeper
+            self.frozen = True
+            self._base_compiles = self._steady_compiles()
+            self.rejections["sentinel_strike"] = (
+                self.rejections.get("sentinel_strike", 0) + 1)
+            _rejected_c.inc("sentinel_strike")
+            tracer.event("autotune.frozen", slot=obs.slot)
+            _log.warn("autotune FROZEN: steady-state recompile while "
+                      "tuning", slot=obs.slot)
+        if self.frozen:
+            self._reject(obs.slot, "policy", None, None, "frozen")
+            return None
+        if obs.breaker_open or obs.fallbacks > 0:
+            # the guard is already re-shaping slots down its ladder;
+            # steering on top of a degraded plane conflates two
+            # controllers — hold until it heals
+            self._reject(obs.slot, "policy", None, None, "degraded")
+            return None
+        if self.objective == "throughput":
+            return self._observe_throughput(obs)
+        return self._observe_latency(obs)
+
+    def _observe_throughput(self, obs: Observation) -> Decision | None:
+        pol = policy_mod.current()
+        hand = self.hand_tuned
+        # 1) the stage-3 pool is the bound: finish backlog persistently
+        #    above the in-flight depth means fences queue faster than the
+        #    workers drain them — widen the pool first (cheapest move)
+        if (obs.finish_backlog > pol.pipeline_depth
+                and pol.finish_workers < MAX_FINISH_WORKERS):
+            return self._apply(obs.slot, "finish_workers",
+                               pol.finish_workers, pol.finish_workers + 1,
+                               "finish_backlog>depth")
+        if (obs.verify_backlog > 2 * pol.pipeline_depth
+                and pol.finish_workers < MAX_FINISH_WORKERS):
+            return self._apply(obs.slot, "finish_workers",
+                               pol.finish_workers, pol.finish_workers + 1,
+                               "verify_backlog")
+        # 2) restore double buffering: depth 1 serializes pack behind
+        #    execute; the hand-tuned depth overlaps them
+        target_depth = min(MAX_DEPTH, hand.pipeline_depth or 2)
+        if pol.pipeline_depth < target_depth:
+            return self._apply(obs.slot, "pipeline_depth",
+                               pol.pipeline_depth, pol.pipeline_depth + 1,
+                               "restore_double_buffering")
+        # 3) grow the batching window toward the hand-tuned TILE×devices
+        #    flush, one pow2 step per slot, while nothing is shedding and
+        #    the backlog leaves headroom in the slot
+        target_flush = hand.flush_at or pol.flush_at
+        if (pol.flush_at < target_flush and obs.shed == 0
+                and obs.backlog_seconds < self.slot_seconds / 2):
+            new = min(target_flush, max(MIN_FLUSH, pol.flush_at * 2))
+            return self._try_flush(obs.slot, pol.flush_at, new,
+                                   "fill_device")
+        # 4) converged on shape: hand back any deadline budget a previous
+        #    latency-mode shed left behind
+        base_budget = hand.deadline_budget_s
+        if (base_budget is not None and pol.deadline_budget_s is not None
+                and pol.deadline_budget_s < base_budget):
+            new = min(base_budget, pol.deadline_budget_s * 2)
+            return self._apply(obs.slot, "deadline_budget_s",
+                               pol.deadline_budget_s, new, "restore_budget")
+        return None
+
+    def _observe_latency(self, obs: Observation) -> Decision | None:
+        pol = policy_mod.current()
+        hand = self.hand_tuned
+        base_budget = (pol.deadline_budget_s
+                       if pol.deadline_budget_s is not None
+                       else hand.deadline_budget_s)
+        hot = obs.vapi_p99_s > self.slo_s or obs.shed > 0
+        if hot:
+            self._calm_slots = 0
+            # under a spike, shed deadline budget FIRST: the coalescer
+            # 503s excess work at the front door (bounded, retryable)
+            # instead of queueing it into everyone's p99
+            if base_budget is not None:
+                floor = max(0.5, self.slot_seconds / 4)
+                if base_budget > floor:
+                    new = round(max(floor, base_budget / 2), 3)
+                    return self._apply(obs.slot, "deadline_budget_s",
+                                       base_budget, new, "shed_under_spike")
+            # budget already at the floor: shrink the window so each
+            # fused dispatch clears faster (bucket-constrained)
+            if pol.flush_at > MIN_FLUSH:
+                new = max(MIN_FLUSH, pol.flush_at // 2)
+                return self._try_flush(obs.slot, pol.flush_at, new,
+                                       "shrink_window")
+            return None
+        # healthy slot: depth back to double buffering helps latency too
+        # (verify overlaps the next pack instead of serializing)
+        target_depth = min(MAX_DEPTH, hand.pipeline_depth or 2)
+        if pol.pipeline_depth < target_depth:
+            return self._apply(obs.slot, "pipeline_depth",
+                               pol.pipeline_depth, pol.pipeline_depth + 1,
+                               "restore_double_buffering")
+        self._calm_slots += 1
+        # two consecutive calm slots: restore shed budget toward the
+        # configured baseline (half the shed back per step — asymmetric
+        # shed-fast/restore-slow keeps a flapping spike from oscillating)
+        hand_budget = hand.deadline_budget_s
+        if (self._calm_slots >= 2 and hand_budget is not None
+                and base_budget is not None and base_budget < hand_budget):
+            new = round(min(hand_budget, base_budget * 1.5), 3)
+            return self._apply(obs.slot, "deadline_budget_s",
+                               base_budget, new, "restore_after_spike")
+        return None
+
+    # -- scheduler wiring --------------------------------------------------
+
+    def bind(self, observer: "RegistryObserver | None" = None,
+             coalescer=None) -> None:
+        """Attach the observation source for the on_slot adapter (one
+        RegistryObserver per run; the coalescer gives the live backlog
+        estimate instead of the exported gauge)."""
+        self._observer = observer or RegistryObserver(self.slot_seconds)
+        self._coalescer = coalescer
+
+    async def on_slot(self, slot_obj) -> None:
+        """Scheduler slot subscriber (app.assemble wires it when
+        Config.autotune_mode != "off"): build this slot's observation
+        from the registry and run one control step. Decisions land
+        BETWEEN slots by construction — this fires at the slot tick,
+        before the slot's duties dispatch."""
+        if getattr(self, "_observer", None) is None:
+            self.bind()
+        try:
+            obs = self._observer.observe(
+                getattr(slot_obj, "slot", 0),
+                coalescer=getattr(self, "_coalescer", None))
+            self.observe(obs)
+        except Exception as exc:  # noqa: BLE001 — tuning must never cost a duty
+            _log.warn("autotune slot step failed", err=exc)
+
+    # -- reporting ---------------------------------------------------------
+
+    def converged_slot(self) -> int | None:
+        """The slot of the LAST accepted decision (the policy has been
+        stable since), or None when nothing was ever applied."""
+        applied = [d for d in self.decisions if d.accepted]
+        return applied[-1].slot if applied else None
+
+    def report(self) -> dict:
+        """The JSON-tail summary bench_vapi records next to the route
+        stats: trajectory, final knobs, decision/rejection tallies."""
+        final = policy_mod.current()
+        return {
+            "objective": self.objective,
+            "frozen": self.frozen,
+            "decisions": sum(1 for d in self.decisions if d.accepted),
+            "rejections": dict(sorted(self.rejections.items())),
+            "converged_slot": self.converged_slot(),
+            "policy_epochs": list(self.policy_epochs),
+            "final": {"flush_at": final.flush_at,
+                      "pipeline_depth": final.pipeline_depth,
+                      "finish_workers": final.finish_workers,
+                      "deadline_budget_s": final.deadline_budget_s,
+                      "epoch": final.epoch},
+            "hand_tuned": {"flush_at": self.hand_tuned.flush_at,
+                           "pipeline_depth": self.hand_tuned.pipeline_depth,
+                           "finish_workers": self.hand_tuned.finish_workers,
+                           "deadline_budget_s":
+                               self.hand_tuned.deadline_budget_s},
+            "trajectory": [d.to_json() for d in self.decisions],
+        }
+
+
+class RegistryObserver:
+    """Builds per-slot :class:`Observation`\\ s from the live metrics
+    registry (counter deltas vs the previous call, point-in-time gauges
+    and quantiles) plus the coalescer's own admission estimate. One
+    instance per run — it carries the delta baseline."""
+
+    _COUNTERS = ("core_coalesce_overload_total", "ops_sigagg_fallback_total",
+                 "core_coalesce_flush_items")
+
+    def __init__(self, slot_seconds: float = 12.0):
+        self.slot_seconds = slot_seconds
+        self._prev: dict[str, float] = {}
+
+    @staticmethod
+    def _sum_series(snap: dict, name: str) -> float:
+        return sum(v for k, v in snap.items()
+                   if k == name or k.startswith(name + "{"))
+
+    def observe(self, slot: int, coalescer=None) -> Observation:
+        snap = metrics.default_registry.snapshot()
+        hists = metrics.snapshot_quantiles()
+
+        def delta(name: str) -> float:
+            cur = self._sum_series(snap, name)
+            prev = self._prev.get(name, 0.0)
+            self._prev[name] = cur
+            return max(0.0, cur - prev)
+
+        vapi_p99 = max(
+            (h.get("p99", 0.0) for k, h in hists.items()
+             if k.startswith("vapi_route_latency_seconds") and h.get("count")),
+            default=0.0)
+        phases = {}
+        for k, h in hists.items():
+            if k.startswith("ops_device_dispatch_seconds{") and h.get("count"):
+                phase = k.split('phase="')[-1].rstrip('"}')
+                phases[phase] = h.get("p50", 0.0)
+        shed = delta("core_coalesce_overload_total")
+        fallbacks = delta("ops_sigagg_fallback_total")
+        arrivals = delta("core_coalesce_flush_items")
+        from . import sentinel
+
+        return Observation(
+            slot=slot,
+            vapi_p99_s=vapi_p99,
+            arrival_rate=arrivals / max(self.slot_seconds, 1e-9),
+            backlog_seconds=(coalescer.backlog_seconds()
+                            if coalescer is not None else
+                            self._sum_series(
+                                snap, "core_coalesce_backlog_seconds")),
+            finish_backlog=self._sum_series(snap, "ops_sigagg_finish_backlog"),
+            verify_backlog=self._sum_series(snap, "ops_sigagg_verify_backlog"),
+            shed=shed,
+            fallbacks=fallbacks,
+            breaker_open=self._sum_series(snap, "ops_plane_breaker_state") > 0,
+            steady_compiles=sentinel.compiles_summary().get("steady", 0),
+            phase_p50_s=phases,
+        )

@@ -46,12 +46,15 @@ import threading
 import time
 
 from ..utils import expbackoff, faults, log, metrics
+from . import policy as policy_mod
 
 _log = log.with_topic("guard")
 
-BREAKER_THRESHOLD_ENV = "CHARON_TPU_BREAKER_THRESHOLD"
-BREAKER_COOLDOWN_ENV = "CHARON_TPU_BREAKER_COOLDOWN_S"
-SLOT_DEADLINE_ENV = "CHARON_TPU_SLOT_DEADLINE_S"
+# Knob env names live in ops/policy (the SlotPolicy seam); re-exported
+# here for the existing callers/tests that import them from guard.
+BREAKER_THRESHOLD_ENV = policy_mod.ENV_BREAKER_THRESHOLD
+BREAKER_COOLDOWN_ENV = policy_mod.ENV_BREAKER_COOLDOWN
+SLOT_DEADLINE_ENV = policy_mod.ENV_SLOT_DEADLINE
 
 # Ladder backoff: short and tightly capped — a duty slot has a ~12 s
 # budget and the ladder may try several rungs inside it.
@@ -130,25 +133,13 @@ def is_device_error(exc: BaseException) -> bool:
     return False
 
 
-def _env_int(name: str, default: int) -> int:
-    try:
-        return int(os.environ.get(name, str(default)))
-    except ValueError:
-        return default
-
-
-def _env_float(name: str, default: float) -> float:
-    try:
-        return float(os.environ.get(name, str(default)))
-    except ValueError:
-        return default
-
-
 def slot_deadline_default() -> float:
     """Watchdog deadline (seconds) for pipeline slot futures; 0 disables.
     Generous by default — a cold compile of the fused graph on CPU takes
-    minutes, and the watchdog exists for *hung* fences, not slow ones."""
-    return _env_float(SLOT_DEADLINE_ENV, 600.0)
+    minutes, and the watchdog exists for *hung* fences, not slow ones.
+    Resolved through the SlotPolicy seam (installed policy → env →
+    default)."""
+    return policy_mod.slot_deadline_default()
 
 
 class CircuitBreaker:
@@ -162,9 +153,9 @@ class CircuitBreaker:
     def __init__(self, threshold: int | None = None,
                  cooldown: float | None = None) -> None:
         self._threshold = max(1, threshold if threshold is not None
-                              else _env_int(BREAKER_THRESHOLD_ENV, 3))
+                              else policy_mod.breaker_threshold_default())
         self._cooldown = max(0.0, cooldown if cooldown is not None
-                             else _env_float(BREAKER_COOLDOWN_ENV, 30.0))
+                             else policy_mod.breaker_cooldown_default())
         self._lock = threading.Lock()
         self._state = CLOSED
         self._consecutive = 0

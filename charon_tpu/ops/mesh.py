@@ -31,7 +31,8 @@ from ..utils import faults, metrics
 # Shard-width override: >0 clamps the mesh to min(value, local devices);
 # 1 forces the single-device passthrough. Read at first resolve — set it
 # before any sigagg dispatch (app config wires Config.sigagg_devices
-# through here before the tbls backend is selected).
+# through here before the tbls backend is selected). Resolution routes
+# through the SlotPolicy seam (installed policy → this env var → auto).
 DEVICES_ENV = "CHARON_TPU_SIGAGG_DEVICES"
 
 _mesh_devices_g = metrics.gauge(
@@ -59,12 +60,11 @@ def _discover() -> list:
 
 def _resolve() -> tuple[int, object]:
     faults.check("mesh.resolve")
+    from . import policy as policy_mod
+
     devices = _discover()
     n = len(devices)
-    try:
-        override = int(os.environ.get(DEVICES_ENV, "0"))
-    except ValueError:
-        override = 0
+    override = policy_mod.sigagg_devices_override()
     if override > 0:
         n = min(n, override)
     elif devices and devices[0].platform == "cpu":

@@ -930,17 +930,20 @@ _FIELD_PLANES = ("xla", "pallas")
 
 
 def field_plane() -> str:
-    """The selected field plane: "xla" (default) or "pallas". Raises on an
-    unknown CHARON_TPU_FIELD_PLANE value so a typo fails loudly instead of
-    silently benchmarking the wrong plane."""
-    env = _os.environ.get("CHARON_TPU_FIELD_PLANE", "").strip().lower()
-    if env in ("", "xla"):
+    """The selected field plane: "xla" (default) or "pallas". Resolved
+    through the SlotPolicy seam (installed policy → CHARON_TPU_FIELD_PLANE
+    → default); validation stays HERE so a typo fails loudly instead of
+    silently benchmarking the wrong plane, whichever layer set it."""
+    from . import policy as policy_mod
+
+    raw = policy_mod.field_plane_default().strip().lower()
+    if raw in ("", "xla"):
         return "xla"
-    if env not in _FIELD_PLANES:
+    if raw not in _FIELD_PLANES:
         raise ValueError(
             f"CHARON_TPU_FIELD_PLANE must be one of {_FIELD_PLANES}, "
-            f"got {env!r}")
-    return env
+            f"got {raw!r}")
+    return raw
 
 
 def mont_mul_rows(a, b):

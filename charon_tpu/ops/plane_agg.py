@@ -36,7 +36,6 @@ from __future__ import annotations
 import contextvars
 import ctypes
 import functools
-import os
 import threading
 from collections import OrderedDict, deque
 from concurrent.futures import Future, InvalidStateError, ThreadPoolExecutor
@@ -55,6 +54,7 @@ from ..crypto.rlc import RLC_BITS, sample_randomizers
 from ..crypto.serialize import g1_to_bytes, g2_to_bytes
 from . import field as F
 from . import pallas_plane as PP
+from . import policy as policy_mod
 from . import sentinel
 
 _MONT_ONE = F.fq_from_int(1)
@@ -165,11 +165,9 @@ def _verify_device_path() -> bool:
     the breaker + native rung stay underneath as the safety net. CPU CI
     sets CHARON_TPU_DEVICE_VERIFY=0 in tests/conftest.py because the
     pairing graph costs minutes of XLA:CPU compile — the exact hazard
-    tests/test_device_pairing.py slow-gates."""
-    env = os.environ.get("CHARON_TPU_DEVICE_VERIFY")
-    if env is not None:
-        return env not in ("", "0", "false")
-    return True
+    tests/test_device_pairing.py slow-gates. Resolved through the
+    SlotPolicy seam (installed policy → env → on)."""
+    return policy_mod.device_verify_default()
 
 
 # ---------------------------------------------------------------------------
@@ -1011,14 +1009,14 @@ def _fused_host_emit(hstate, hash_fn=None):
     return out, lambda: _pairing_finish(S, pts, hash_fn)
 
 
-# Pipeline knobs (overridable per instance). Depth 2 = classic double
-# buffering on the device side: one slot executing, one packing — deeper
-# queues only add readback latency. FINISH_WORKERS sizes the stage-3 host
-# executor: the GIL-releasing parts (numpy emit, ctypes hash-to-curve +
-# pairing) scale with width, the _host_fold bigint adds do not, so small
-# widths capture almost all of the overlap.
-PIPELINE_DEPTH = int(os.environ.get("CHARON_TPU_PIPELINE_DEPTH", "2"))
-FINISH_WORKERS = int(os.environ.get("CHARON_TPU_FINISH_WORKERS", "2"))
+# Pipeline knobs (overridable per instance) resolve through the SlotPolicy
+# seam: installed policy → CHARON_TPU_{PIPELINE_DEPTH,FINISH_WORKERS} env →
+# defaults. Depth 2 = classic double buffering on the device side: one
+# slot executing, one packing — deeper queues only add readback latency.
+# finish_workers sizes the stage-3 host executor: the GIL-releasing parts
+# (numpy emit, ctypes hash-to-curve + pairing) scale with width, the
+# _host_fold bigint adds do not, so small widths capture almost all of
+# the overlap.
 
 
 def _run_emit(ctx, state, inputs, hash_fn):
@@ -1114,9 +1112,15 @@ class SigAggPipeline:
                  steady_after: int | None = None):
         from . import guard
 
-        self._depth = max(1, PIPELINE_DEPTH if depth is None else depth)
-        self._workers = max(1, FINISH_WORKERS if finish_workers is None
-                            else finish_workers)
+        # Constructor args PIN a knob (tests, explicit callers); None
+        # leaves it policy-managed — resolved now and re-resolvable
+        # between slots via apply_policy() when the tuner moves it.
+        self._depth_pinned = depth is not None
+        self._depth = max(1, policy_mod.pipeline_depth_default()
+                          if depth is None else depth)
+        self._workers_pinned = finish_workers is not None
+        self._workers = max(1, policy_mod.finish_workers_default()
+                            if finish_workers is None else finish_workers)
         # Watchdog: slot futures gain a deadline so a hung device fence
         # surfaces as a classified timeout riding the guard's fallback
         # ladder instead of blocking drain() forever. 0 disables.
@@ -1169,6 +1173,27 @@ class SigAggPipeline:
         gauge, as a direct accessor for the serving/backpressure layer)."""
         with self._lock:
             return len(self._pending)
+
+    def apply_policy(self, policy=None) -> None:
+        """Adopt the installed SlotPolicy's depth/worker knobs between
+        slots (registered as a policy_mod.subscribe listener by the tbls
+        facade's shared pipeline). Constructor-pinned knobs stay pinned.
+        The `policy` arg is the subscriber-callback signature — resolution
+        goes through the accessors so env fallbacks apply uniformly."""
+        del policy
+        with self._lock:
+            if not self._depth_pinned:
+                self._depth = max(1, policy_mod.pipeline_depth_default())
+            if not self._workers_pinned:
+                new_w = max(1, policy_mod.finish_workers_default())
+                self._workers = new_w
+                pool = self._pool
+                if pool is not None and new_w > pool._max_workers:
+                    # CPython's ThreadPoolExecutor spawns threads lazily
+                    # up to _max_workers — raising it widens the pool on
+                    # the next submit without rebuilding the executor
+                    # (rebuilding would orphan in-flight finish tasks).
+                    pool._max_workers = new_w
 
     def _schedule_finish(self, state, inputs, hash_fn) -> Future:
         # caller holds self._lock; scheduling only — no device sync here.
@@ -2048,7 +2073,7 @@ def _rlc_finish(state, hash_fn=None) -> bool:
 # are unbounded over time but only a handful are live per slot.
 # ---------------------------------------------------------------------------
 
-_H2C_CAP = int(os.environ.get("CHARON_TPU_H2C_CACHE_CAP", "4096"))
+_H2C_CAP = policy_mod.h2c_cache_cap_default()
 _h2c_lock = threading.Lock()
 # msg bytes -> [96-byte compressed, (hx, hy) affine limb planes | None].
 # The compressed form feeds the native fallback rung; the limb planes are

@@ -1,0 +1,320 @@
+"""SlotPolicy — the one seam for every slot-shaping knob.
+
+Before this module, the knobs that shape a sigagg slot were scattered:
+the coalescer's `flush_at`/`deadline_budget_s` were constructor args
+computed once, the pipeline depth and finish-worker width were module
+constants read from `CHARON_TPU_PIPELINE_DEPTH`/`_FINISH_WORKERS` at
+import, the mesh clamp / device-verify switch / field plane / h2c cache
+cap / breaker thresholds were `os.environ` probes buried in four
+different modules. Changing any of them meant a process restart, and no
+two readers could be shown the same configuration at the same instant.
+
+This module is the consolidation (ISSUE 19, ROADMAP item 3):
+
+  * :class:`SlotPolicy` — one frozen, versioned snapshot of every knob.
+    Fields are Optional: ``None`` means "unmanaged — fall back to the
+    env-var initial value, then the built-in default". Env vars thereby
+    remain initial-value overrides (through `app.Config` /
+    `app/config.py`), while an installed policy is the runtime truth.
+  * the ``*_default()`` accessors — THE sanctioned readers for the knob
+    env vars (machine-checked by LINT-TPU-023: `os.environ` reads of
+    these names outside this file and `app/config.py` are findings).
+    Each resolves installed-policy field → env var → built-in default,
+    reading env lazily so test monkeypatching keeps working.
+  * `install()`/`update()` — atomic replacement of the whole snapshot.
+    Readers take one reference (`installed()`/`current()`); a reader
+    can never observe half of an update. Every install bumps the policy
+    epoch (exported as the `ops_policy_epoch` gauge — the health
+    checker's staleness guard watches it move whenever the autotuner
+    claims to have decided something) and notifies subscribers (the
+    shared SigAggPipeline adopts depth/worker changes between slots).
+
+`ops/autotune.py` is the writer that closes the loop: it proposes
+between-slot moves on this seam under an explicit latency/throughput
+objective, with the PR-15 compile sentinel as a hard constraint.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from dataclasses import dataclass, fields, replace
+
+from ..utils import log, metrics
+
+_log = log.with_topic("policy")
+
+# The knob env vars (initial-value overrides). These names are the
+# single source of truth — ops/guard re-exports the breaker/deadline
+# ones for backward compatibility, and LINT-TPU-023's knob list mirrors
+# this block.
+ENV_PIPELINE_DEPTH = "CHARON_TPU_PIPELINE_DEPTH"
+ENV_FINISH_WORKERS = "CHARON_TPU_FINISH_WORKERS"
+ENV_SIGAGG_DEVICES = "CHARON_TPU_SIGAGG_DEVICES"
+ENV_DEVICE_VERIFY = "CHARON_TPU_DEVICE_VERIFY"
+ENV_FIELD_PLANE = "CHARON_TPU_FIELD_PLANE"
+ENV_H2C_CACHE_CAP = "CHARON_TPU_H2C_CACHE_CAP"
+ENV_BREAKER_THRESHOLD = "CHARON_TPU_BREAKER_THRESHOLD"
+ENV_BREAKER_COOLDOWN = "CHARON_TPU_BREAKER_COOLDOWN_S"
+ENV_SLOT_DEADLINE = "CHARON_TPU_SLOT_DEADLINE_S"
+
+#: Schema version of the SlotPolicy snapshot (bump on field changes).
+POLICY_VERSION = 1
+
+_epoch_g = metrics.gauge(
+    "ops_policy_epoch",
+    "Monotonic epoch of the installed SlotPolicy snapshot (0 = nothing "
+    "installed; every install/update bumps it — the policy_epoch_stale "
+    "health rule cross-checks it against autotune decision counts)")
+
+
+@dataclass(frozen=True)
+class SlotPolicy:
+    """One atomic snapshot of every slot-shaping knob.
+
+    ``None`` fields are UNMANAGED: consumers fall back to the env-var
+    initial value and then the built-in default via the accessors below,
+    so an empty policy is behavior-identical to no policy at all. The
+    autotuner only ever sets the fields it actively manages.
+    """
+
+    version: int = POLICY_VERSION
+    epoch: int = 0
+    # coalescer (core/coalesce): count-trigger of the batching window and
+    # the admission-control deadline budget behind the 503 shed
+    flush_at: int | None = None
+    deadline_budget_s: float | None = None
+    # sigagg pipeline (ops/plane_agg.SigAggPipeline)
+    pipeline_depth: int | None = None
+    finish_workers: int | None = None
+    # device plane shape/routing
+    sigagg_devices: int | None = None     # mesh clamp (0/None = auto)
+    device_verify: bool | None = None     # device pairing verify on/off
+    field_plane: str | None = None        # "xla" | "pallas"
+    h2c_cache_cap: int | None = None
+    # self-healing guard (ops/guard)
+    breaker_threshold: int | None = None
+    breaker_cooldown_s: float | None = None
+    slot_deadline_s: float | None = None
+
+    def knobs(self) -> dict:
+        """The knob fields as a plain dict (version/epoch excluded) —
+        what bench tails and the tuner trajectory serialize."""
+        return {f.name: getattr(self, f.name) for f in fields(self)
+                if f.name not in ("version", "epoch")}
+
+
+_lock = threading.Lock()
+_installed: SlotPolicy | None = None
+_epoch = 0
+_listeners: list = []
+
+
+def _env_int(name: str, default: int) -> int:
+    raw = os.environ.get(name)
+    if raw is None:
+        return default
+    try:
+        return int(raw)
+    except ValueError:
+        return default
+
+
+def _env_float(name: str, default: float) -> float:
+    raw = os.environ.get(name)
+    if raw is None:
+        return default
+    try:
+        return float(raw)
+    except ValueError:
+        return default
+
+
+# ---------------------------------------------------------------------------
+# snapshot lifecycle
+# ---------------------------------------------------------------------------
+
+
+def installed() -> SlotPolicy | None:
+    """The installed policy snapshot, or None. One reference read — a
+    caller holding the returned (frozen) object can never see a torn
+    update, whatever install/update does concurrently."""
+    return _installed
+
+
+def install(policy: SlotPolicy) -> SlotPolicy:
+    """Atomically install `policy` as the process snapshot, stamping the
+    next epoch. Returns the stamped snapshot. Subscribers (the shared
+    SigAggPipeline) are notified outside the lock."""
+    global _installed, _epoch
+    with _lock:
+        _epoch += 1
+        stamped = replace(policy, epoch=_epoch, version=POLICY_VERSION)
+        _installed = stamped
+        _epoch_g.set(float(_epoch))
+        listeners = list(_listeners)
+    for cb in listeners:
+        try:
+            cb(stamped)
+        except Exception as exc:  # noqa: BLE001 — a consumer must not wedge installs
+            _log.warn("policy listener failed", err=exc)
+    return stamped
+
+
+def update(**changes) -> SlotPolicy:
+    """Install a snapshot derived from the current one with `changes`
+    applied (creates one from scratch when nothing is installed)."""
+    base = _installed if _installed is not None else SlotPolicy()
+    return install(replace(base, **changes))
+
+
+def subscribe(callback) -> None:
+    """Register `callback(policy)` to run after every install. Consumers
+    that cache knob values (the shared pipeline's depth/worker pool) use
+    this to adopt changes between slots."""
+    with _lock:
+        if callback not in _listeners:
+            _listeners.append(callback)
+
+
+def reset_for_testing() -> None:
+    """Drop the installed policy (the epoch keeps counting so stale-gauge
+    assertions stay monotonic). Subscribers are kept — the shared
+    SigAggPipeline subscribes once per process — and notified so cached
+    knob values re-resolve to the env/default layer."""
+    global _installed
+    with _lock:
+        _installed = None
+        _epoch_g.set(float(_epoch))
+        listeners = list(_listeners)
+    for cb in listeners:
+        try:
+            cb(None)
+        except Exception as exc:  # noqa: BLE001 — see install()
+            _log.warn("policy listener failed on reset", err=exc)
+
+
+# ---------------------------------------------------------------------------
+# resolved accessors — installed field, then env, then built-in default.
+# These are the ONLY sanctioned env readers for these knobs (LINT-TPU-023).
+# ---------------------------------------------------------------------------
+
+
+def pipeline_depth_default() -> int:
+    pol = _installed
+    if pol is not None and pol.pipeline_depth is not None:
+        return max(1, pol.pipeline_depth)
+    return max(1, _env_int(ENV_PIPELINE_DEPTH, 2))
+
+
+def finish_workers_default() -> int:
+    pol = _installed
+    if pol is not None and pol.finish_workers is not None:
+        return max(1, pol.finish_workers)
+    return max(1, _env_int(ENV_FINISH_WORKERS, 2))
+
+
+def sigagg_devices_override() -> int:
+    """The mesh shard-width clamp: >0 clamps, 0 = no override (auto)."""
+    pol = _installed
+    if pol is not None and pol.sigagg_devices is not None:
+        return max(0, pol.sigagg_devices)
+    return max(0, _env_int(ENV_SIGAGG_DEVICES, 0))
+
+
+def device_verify_default() -> bool:
+    """Whether slot verification runs on device (default ON; the env
+    carries CPU-CI's opt-out — tests/conftest.py sets it to 0)."""
+    pol = _installed
+    if pol is not None and pol.device_verify is not None:
+        return pol.device_verify
+    env = os.environ.get(ENV_DEVICE_VERIFY)
+    if env is not None:
+        return env not in ("", "0", "false")
+    return True
+
+
+def field_plane_default() -> str:
+    """The RAW configured field plane ("" = backend default); validation
+    stays with ops/pallas_plane.field_plane (unknown values must raise
+    there, where the error message owns the plane list)."""
+    pol = _installed
+    if pol is not None and pol.field_plane is not None:
+        return pol.field_plane
+    return os.environ.get(ENV_FIELD_PLANE, "")
+
+
+def h2c_cache_cap_default() -> int:
+    pol = _installed
+    if pol is not None and pol.h2c_cache_cap is not None:
+        return pol.h2c_cache_cap
+    return _env_int(ENV_H2C_CACHE_CAP, 4096)
+
+
+def breaker_threshold_default() -> int:
+    pol = _installed
+    if pol is not None and pol.breaker_threshold is not None:
+        return max(1, pol.breaker_threshold)
+    return max(1, _env_int(ENV_BREAKER_THRESHOLD, 3))
+
+
+def breaker_cooldown_default() -> float:
+    pol = _installed
+    if pol is not None and pol.breaker_cooldown_s is not None:
+        return pol.breaker_cooldown_s
+    return _env_float(ENV_BREAKER_COOLDOWN, 30.0)
+
+
+def slot_deadline_default() -> float:
+    pol = _installed
+    if pol is not None and pol.slot_deadline_s is not None:
+        return pol.slot_deadline_s
+    return _env_float(ENV_SLOT_DEADLINE, 600.0)
+
+
+def deadline_budget_override() -> float | None:
+    """The coalescer admission budget when the policy manages it, else
+    None (the coalescer keeps its constructor/Config value). There is no
+    env var for this knob — it always arrives via Config or the tuner."""
+    pol = _installed
+    if pol is not None:
+        return pol.deadline_budget_s
+    return None
+
+
+def flush_at_default() -> int:
+    """The coalescer count trigger: managed policy value, else one plane
+    TILE per resolved mesh device — recomputed on every call, so a mesh
+    clamp change or a policy install is reflected by the NEXT submission
+    without a process restart (the ISSUE-19 bugfix: this used to be
+    computed once at coalescer construction)."""
+    pol = _installed
+    if pol is not None and pol.flush_at is not None:
+        return max(1, pol.flush_at)
+    from . import mesh as mesh_mod
+    from .pallas_plane import TILE
+
+    return TILE * max(1, mesh_mod.device_count())
+
+
+def current() -> SlotPolicy:
+    """A FULLY-RESOLVED snapshot: every field concrete via the accessors
+    above (flush_at included). For display, trajectory recording, and
+    tuner baselines — consumers on hot paths read the single accessor
+    they need instead."""
+    pol = _installed
+    return SlotPolicy(
+        version=POLICY_VERSION,
+        epoch=pol.epoch if pol is not None else 0,
+        flush_at=flush_at_default(),
+        deadline_budget_s=deadline_budget_override(),
+        pipeline_depth=pipeline_depth_default(),
+        finish_workers=finish_workers_default(),
+        sigagg_devices=sigagg_devices_override(),
+        device_verify=device_verify_default(),
+        field_plane=field_plane_default(),
+        h2c_cache_cap=h2c_cache_cap_default(),
+        breaker_threshold=breaker_threshold_default(),
+        breaker_cooldown_s=breaker_cooldown_default(),
+        slot_deadline_s=slot_deadline_default(),
+    )

@@ -100,15 +100,18 @@ _PIPELINE_LOCK = threading.Lock()
 def _shared_pipeline():
     """Process-wide SigAggPipeline: one device, one dispatch queue — every
     TPUImpl instance overlaps through the same three-stage pipeline (depth
-    and stage-3 executor width from plane_agg.PIPELINE_DEPTH /
-    FINISH_WORKERS, env-overridable via CHARON_TPU_PIPELINE_DEPTH /
-    CHARON_TPU_FINISH_WORKERS)."""
+    and stage-3 executor width resolved through the SlotPolicy seam:
+    installed policy → CHARON_TPU_PIPELINE_DEPTH / CHARON_TPU_FINISH_WORKERS
+    env → defaults). The pipeline subscribes to policy installs so a tuner
+    move to either knob is adopted between slots without a rebuild."""
     global _PIPELINE
     with _PIPELINE_LOCK:
         if _PIPELINE is None:
             from ..ops import plane_agg
+            from ..ops import policy as policy_mod
 
             _PIPELINE = plane_agg.SigAggPipeline()
+            policy_mod.subscribe(_PIPELINE.apply_policy)
         return _PIPELINE
 
 

@@ -350,6 +350,19 @@ def route_stats() -> dict[str, dict[str, float]]:
 
 # -- serving harness ----------------------------------------------------------
 
+#: Deterministic arrival-shaping profiles (bench_vapi --profile): how the
+#: per-slot parsigex storm size — the device-plane load lever — evolves
+#: over the run. Purely a function of (profile, slot, config): same
+#: config ⇒ bit-identical arrival series (the duty mix itself is already
+#: seeded via TrafficConfig.seed), no extra RNG anywhere.
+#:   steady — storm_validators every slot (the legacy shape);
+#:   ramp   — linear climb from storm_validators/slots to the full storm
+#:            by the last slot (the autotuner's convergence runway);
+#:   spike  — the full storm every slot with a 3x burst at the midpoint
+#:            slot (the latency objective's shed trigger).
+PROFILES = ("steady", "ramp", "spike")
+
+
 @dataclass
 class TrafficConfig:
     """Knobs for one ServingHarness run (docs/serving.md)."""
@@ -369,6 +382,12 @@ class TrafficConfig:
     vc_timeout: float = 30.0
     coalesce_budget_s: float = 12.0
     max_body_bytes: int = 2 * 1024 * 1024
+    profile: str = "steady"        # arrival shaping, one of PROFILES
+    autotune: str = "off"          # off | latency | throughput
+    # SlotPolicy field overrides installed before the run when autotuning
+    # (bench_vapi's deliberately-bad start: {"flush_at": 8,
+    # "pipeline_depth": 1}); None installs an empty (all-unmanaged) policy
+    initial_policy: dict | None = None
 
 
 @dataclass
@@ -385,9 +404,13 @@ class ServingReport:
     client_tallies: dict[str, int]
     bn_connections_used: int
     bn_requests_served: int
+    # the autotuner's trajectory (AutoTuner.report(): objective,
+    # policy_epochs, final knobs, decisions/rejections); None when the
+    # run had autotune off
+    autotune: dict | None = None
 
     def to_json(self) -> dict:
-        return {
+        out = {
             "elapsed_s": round(self.elapsed_s, 3),
             "slots_run": self.slots_run,
             "num_vcs": self.num_vcs,
@@ -401,6 +424,9 @@ class ServingReport:
             "bn_connections_used": self.bn_connections_used,
             "bn_requests_served": self.bn_requests_served,
         }
+        if self.autotune is not None:
+            out["autotune"] = self.autotune
+        return out
 
 
 class ServingHarness:
@@ -411,8 +437,13 @@ class ServingHarness:
     complete, and contribute the epoch-boundary selection partials."""
 
     def __init__(self, cfg: TrafficConfig):
+        if cfg.profile not in PROFILES:
+            raise ValueError(
+                f"profile must be one of {PROFILES}, got {cfg.profile!r}")
         self.cfg = cfg
         self.stats: TallyCounter = TallyCounter()
+        self.autotuner = None          # ops/autotune.AutoTuner when enabled
+        self._policy_installed = False
         self.mix = DutyMix(cfg.num_validators, cfg.slots_per_epoch,
                            seed=cfg.seed, sync_fraction=cfg.sync_fraction,
                            selection_storm=cfg.selection_storm)
@@ -446,6 +477,32 @@ class ServingHarness:
         node0 = self.cluster.nodes[0]
         if node0.coalescer is not None:
             node0.coalescer.deadline_budget_s = cfg.coalesce_budget_s
+        if cfg.autotune != "off":
+            # Capture the hand-tuned baseline (the policy resolution as
+            # configured, BEFORE any override) — the throughput
+            # objective's convergence target — then install the run's
+            # starting policy (bench_vapi's deliberately-bad knobs, or an
+            # empty all-unmanaged snapshot). The coalescer's admission
+            # budget enters the policy here: with a tuner armed it is a
+            # MANAGED knob (the latency objective's shed rung), baselined
+            # at the configured budget. stop() resets the seam.
+            from dataclasses import replace as _dc_replace
+
+            from ..ops import autotune as autotune_mod
+            from ..ops import policy as policy_mod
+
+            hand = _dc_replace(policy_mod.current(),
+                               deadline_budget_s=cfg.coalesce_budget_s)
+            start = {"deadline_budget_s": cfg.coalesce_budget_s}
+            start.update(cfg.initial_policy or {})
+            policy_mod.update(**start)
+            self._policy_installed = True
+            self.autotuner = autotune_mod.AutoTuner(
+                cfg.autotune, slot_seconds=cfg.seconds_per_slot,
+                hand_tuned=hand)
+            self.autotuner.bind(coalescer=node0.coalescer)
+            _log.info("loadgen autotuner armed", objective=cfg.autotune,
+                      initial=cfg.initial_policy or {})
         self.router = VapiRouter(node0.vapi,
                                  bn_base_url=self.http_mock.base_url,
                                  coalescer=node0.coalescer,
@@ -548,15 +605,29 @@ class ServingHarness:
                   timeout=cfg.vc_timeout)
             for i in range(cfg.num_vcs) if per_vc_secrets[i]]
 
+    def _storm_size(self, slot: int) -> int:
+        """This slot's parsigex storm size under the arrival profile (see
+        PROFILES — deterministic, no RNG)."""
+        cfg = self.cfg
+        base = cfg.storm_validators
+        if base <= 0:
+            return 0
+        if cfg.profile == "ramp":
+            return max(1, round(base * (slot + 1) / max(1, cfg.slots)))
+        if cfg.profile == "spike" and slot == cfg.slots // 2:
+            return min(3 * base, len(self._ordinal_roots))
+        return base
+
     async def _fire_storm(self, slot: int) -> None:
         """Broadcast the synthetic peer partial-sig storm for this slot.
         Targets slot + one epoch so storm roots never collide with live
         duty roots (equivocation guard in parsigdb)."""
         cfg = self.cfg
-        if cfg.storm_validators <= 0 or self.cluster.parsig_transport is None:
+        size = self._storm_size(slot)
+        if size <= 0 or self.cluster.parsig_transport is None:
             return
         storm_slot = slot + cfg.slots_per_epoch
-        roots = self._ordinal_roots[:cfg.storm_validators]
+        roots = self._ordinal_roots[:size]
         batches = await asyncio.to_thread(
             make_parsig_storm, self.cluster, self.chain, storm_slot, roots)
         for from_idx, duty, parsigs in batches:
@@ -578,6 +649,12 @@ class ServingHarness:
             plan = self.mix.plan(slot)
             _log.debug("loadgen slot", slot=slot, attesters=len(plan.attesters),
                        selections=len(plan.selections))
+            if self.autotuner is not None:
+                # one observation + at most one policy move per slot,
+                # BEFORE the slot's traffic fires (between-slots control)
+                from types import SimpleNamespace
+
+                await self.autotuner.on_slot(SimpleNamespace(slot=slot))
             # Slot work overlaps slot boundaries like a real VC's — duties
             # that need the next slot's peer partials (selections, block
             # await) keep running while the next slot's traffic starts.
@@ -608,7 +685,9 @@ class ServingHarness:
             achieved_rps=client_requests / elapsed if elapsed > 0 else 0.0,
             routes=route_stats(), client_tallies=dict(self.stats),
             bn_connections_used=self.http_mock.connections_used,
-            bn_requests_served=self.http_mock.requests_served)
+            bn_requests_served=self.http_mock.requests_served,
+            autotune=(self.autotuner.report()
+                      if self.autotuner is not None else None))
 
     async def _stop_step(self, name: str, coro, timeout: float) -> None:
         try:
@@ -634,3 +713,10 @@ class ServingHarness:
             await self._stop_step("beaconmock", self.http_mock.stop(), 10.0)
         if self.bn_client is not None:
             await self._stop_step("bn_client", self.bn_client.close(), 5.0)
+        if self._policy_installed:
+            # drop the run's installed SlotPolicy so the process-global
+            # seam never leaks tuned knobs into the next harness/test
+            from ..ops import policy as policy_mod
+
+            policy_mod.reset_for_testing()
+            self._policy_installed = False

@@ -46,6 +46,10 @@ def main(argv: list[str]) -> None:
     from ..tbls.native_impl import NativeImpl
     from ..tbls.types import Signature
 
+    # this asserts the RUNNER propagated the env var into this subprocess
+    # (the initial-value layer itself), not a knob read the policy seam
+    # should mediate:
+    # lint: disable=LINT-TPU-023
     assert os.environ.get(mesh_mod.DEVICES_ENV) == str(D), \
         "runner must pin CHARON_TPU_SIGAGG_DEVICES (CPU meshes are opt-in)"
     # topology via the seam (LINT-TPU-008): with the override pinned to D,

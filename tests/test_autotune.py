@@ -1,0 +1,444 @@
+"""Tier-1 tests for the slot-policy seam (ops/policy) and the
+closed-loop autotuner (ops/autotune): deterministic observation streams
+under both objectives, the compile-sentinel bucket constraint,
+freeze-on-strike, env-override precedence through the accessors and
+app/config.initial_policy, snapshot atomicity under the seeded
+interleaver, the coalescer's live flush_at/deadline-budget resolution
+(the ISSUE-19 bugfix regression), and the autotune health rules. No
+wall clock, no randomness — trajectories are asserted exactly."""
+
+from __future__ import annotations
+
+import threading
+from types import SimpleNamespace
+
+import pytest
+
+from charon_tpu.ops import autotune, policy
+from charon_tpu.testutil import interleave
+
+# Production bucket constants injected everywhere so the bucket math is
+# exercised without touching a jax backend.
+PAIR_TILE, H2C_MAX = 512, 1024
+
+# A hand-tuned operating point small enough that the pow2 climb from the
+# deliberately-bad start takes a handful of slots.
+HAND = policy.SlotPolicy(flush_at=64, pipeline_depth=2, finish_workers=2,
+                         deadline_budget_s=12.0)
+BAD = dict(flush_at=8, pipeline_depth=1, finish_workers=1,
+           deadline_budget_s=12.0)
+
+
+@pytest.fixture(autouse=True)
+def _fresh_policy():
+    policy.reset_for_testing()
+    yield
+    policy.reset_for_testing()
+
+
+def _tuner(objective: str, armed=False, compiles=None, **kw) -> autotune.AutoTuner:
+    return autotune.AutoTuner(
+        objective, slot_seconds=12.0, hand_tuned=HAND,
+        steady_armed=(armed if callable(armed) else lambda: armed),
+        steady_compiles=(compiles if compiles is not None else lambda: 0),
+        pair_tile=PAIR_TILE, h2c_max=H2C_MAX, **kw)
+
+
+def _obs(slot: int, **kw) -> autotune.Observation:
+    return autotune.Observation(slot=slot, **kw)
+
+
+# ---------------------------------------------------------------------------
+# the policy seam
+# ---------------------------------------------------------------------------
+
+
+class TestSlotPolicySeam:
+    def test_install_stamps_monotone_epochs_and_is_atomic_per_reader(self):
+        e0 = policy.install(policy.SlotPolicy(flush_at=16)).epoch
+        snap = policy.installed()
+        assert snap.flush_at == 16 and snap.epoch == e0
+        e1 = policy.update(pipeline_depth=3).epoch
+        assert e1 == e0 + 1
+        # the reference taken before the update is immutable history
+        assert snap.pipeline_depth is None and snap.epoch == e0
+        now = policy.installed()
+        assert now.flush_at == 16 and now.pipeline_depth == 3
+
+    def test_subscribers_see_installs_and_reset(self):
+        seen = []
+        policy.subscribe(seen.append)
+        try:
+            installed = policy.install(policy.SlotPolicy(finish_workers=4))
+            assert seen[-1] is installed
+            policy.reset_for_testing()
+            assert seen[-1] is None  # consumers re-resolve env defaults
+        finally:
+            policy._listeners.remove(seen.append)
+
+    def test_env_is_initial_value_override_policy_wins(self, monkeypatch):
+        monkeypatch.setenv(policy.ENV_PIPELINE_DEPTH, "5")
+        monkeypatch.setenv(policy.ENV_BREAKER_THRESHOLD, "7")
+        assert policy.pipeline_depth_default() == 5
+        assert policy.breaker_threshold_default() == 7
+        policy.install(policy.SlotPolicy(pipeline_depth=3))
+        assert policy.pipeline_depth_default() == 3
+        # unmanaged fields still fall through to the env layer
+        assert policy.breaker_threshold_default() == 7
+        policy.reset_for_testing()
+        assert policy.pipeline_depth_default() == 5
+
+    def test_device_verify_resolution(self, monkeypatch):
+        # tests/conftest.py pins the CPU-CI opt-out; policy overrides it
+        monkeypatch.setenv(policy.ENV_DEVICE_VERIFY, "0")
+        assert policy.device_verify_default() is False
+        policy.install(policy.SlotPolicy(device_verify=True))
+        assert policy.device_verify_default() is True
+        policy.reset_for_testing()
+        monkeypatch.delenv(policy.ENV_DEVICE_VERIFY)
+        assert policy.device_verify_default() is True  # built-in default
+
+    def test_initial_policy_precedence_config_then_overrides(self):
+        from charon_tpu.app import config as appconfig
+
+        cfg = SimpleNamespace(sigagg_devices=2, breaker_threshold=5,
+                              breaker_cooldown_s=10.0, slot_deadline_s=300.0,
+                              coalesce_budget_s=6.0)
+        pol = appconfig.initial_policy(cfg)
+        assert (pol.sigagg_devices, pol.breaker_threshold) == (2, 5)
+        # the admission budget is managed whenever a tuner is armed —
+        # initial_policy is only called on that path
+        assert pol.deadline_budget_s == 6.0
+        assert pol.flush_at is None  # Config doesn't carry it: unmanaged
+        pol = appconfig.initial_policy(cfg, flush_at=8, breaker_threshold=9)
+        assert pol.flush_at == 8 and pol.breaker_threshold == 9
+
+    def test_env_overrides_reports_only_set_vars(self, monkeypatch):
+        from charon_tpu.app import config as appconfig
+
+        monkeypatch.delenv(policy.ENV_FINISH_WORKERS, raising=False)
+        monkeypatch.setenv(policy.ENV_H2C_CACHE_CAP, "2048")
+        out = appconfig.env_overrides()
+        assert out.get("h2c_cache_cap") == "2048"
+        assert "finish_workers" not in out
+
+
+class TestCoalescerPolicyResolution:
+    def test_window_flush_at_recomputes_through_the_seam(self):
+        """The ISSUE-19 bugfix: flush_at used to be frozen at coalescer
+        construction; the window must re-resolve it on every trigger
+        check so a policy install lands without a rebuild."""
+        from charon_tpu.core import coalesce
+
+        policy.install(policy.SlotPolicy(flush_at=8))
+        w = coalesce._Window("attest", 0.05, None, dispatch=None)
+        assert w.flush_at == 8
+        policy.update(flush_at=32)
+        assert w.flush_at == 32  # same window object, new resolution
+        # an EXPLICIT constructor value still pins the window
+        pinned = coalesce._Window("attest", 0.05, 16, dispatch=None)
+        policy.update(flush_at=64)
+        assert pinned.flush_at == 16
+
+    def test_deadline_budget_policy_overrides_local_value(self):
+        from charon_tpu.core import coalesce
+
+        co = coalesce.TblsCoalescer(deadline_budget_s=6.0)
+        assert co.deadline_budget_s == 6.0
+        policy.install(policy.SlotPolicy(deadline_budget_s=3.0))
+        assert co.deadline_budget_s == 3.0  # managed: policy wins
+        policy.reset_for_testing()
+        assert co.deadline_budget_s == 6.0  # back to the local value
+        co.deadline_budget_s = 9.0          # harness-style assignment
+        assert co.deadline_budget_s == 9.0
+
+
+# ---------------------------------------------------------------------------
+# bucket signatures — the sentinel constraint's shape math
+# ---------------------------------------------------------------------------
+
+
+def test_bucket_signature_families():
+    sig = lambda f: autotune.bucket_signature(f, PAIR_TILE, H2C_MAX)  # noqa: E731
+    assert sig(8) == (16, False, 8)
+    # at the tile boundary flush_at+1 pairs spill into the chunked
+    # family, whose pair bucket is pinned at the tile
+    assert sig(512) == (512, True, 512)
+    assert sig(1024) == (512, True, 1024)
+    # equal signatures == bit-identical graph shapes (free to move)
+    assert sig(40) == sig(48)
+    assert sig(8) != sig(16)
+
+
+# ---------------------------------------------------------------------------
+# throughput objective
+# ---------------------------------------------------------------------------
+
+
+class TestThroughputObjective:
+    def test_converges_from_bad_start_to_hand_tuned(self):
+        policy.install(policy.SlotPolicy(**BAD))
+        t = _tuner("throughput")
+        # slot 0: stage-3 pool is the bound -> widen workers first
+        d = t.observe(_obs(0, finish_backlog=3.0))
+        assert (d.knob, d.old, d.new) == ("finish_workers", 1, 2)
+        # slot 1: restore double buffering
+        d = t.observe(_obs(1))
+        assert (d.knob, d.old, d.new) == ("pipeline_depth", 1, 2)
+        # slots 2-4: pow2 climb of the window toward TILExdevices
+        for slot, (old, new) in enumerate([(8, 16), (16, 32), (32, 64)],
+                                          start=2):
+            d = t.observe(_obs(slot))
+            assert (d.knob, d.old, d.new) == ("flush_at", old, new)
+        # slot 5: converged — nothing left to move
+        assert t.observe(_obs(5)) is None
+        final = policy.current()
+        assert (final.flush_at, final.pipeline_depth,
+                final.finish_workers) == (64, 2, 2)
+        assert t.converged_slot() == 4
+        # epochs are strictly monotone across the applied trajectory
+        epochs = [d.epoch for d in t.decisions if d.accepted]
+        assert epochs == sorted(epochs) and len(set(epochs)) == len(epochs)
+        rep = t.report()
+        assert rep["decisions"] == 5 and rep["rejections"] == {}
+        assert rep["final"]["flush_at"] == 64
+        assert [p["epoch"] for p in rep["policy_epochs"]] == \
+            sorted(p["epoch"] for p in rep["policy_epochs"])
+
+    def test_flush_growth_waits_for_headroom(self):
+        policy.install(policy.SlotPolicy(flush_at=8, pipeline_depth=2,
+                                         finish_workers=2))
+        t = _tuner("throughput")
+        # shedding or a deep backlog means the shape isn't the bound yet
+        assert t.observe(_obs(0, shed=3.0)) is None
+        assert t.observe(_obs(1, backlog_seconds=7.0)) is None
+        d = t.observe(_obs(2))
+        assert d.knob == "flush_at" and d.new == 16
+
+    def test_restores_budget_a_latency_shed_left_behind(self):
+        policy.install(policy.SlotPolicy(flush_at=64, pipeline_depth=2,
+                                         finish_workers=2,
+                                         deadline_budget_s=3.0))
+        t = _tuner("throughput")
+        d = t.observe(_obs(0))
+        assert (d.knob, d.old, d.new) == ("deadline_budget_s", 3.0, 6.0)
+        d = t.observe(_obs(1))
+        assert (d.knob, d.new) == ("deadline_budget_s", 12.0)
+        assert t.observe(_obs(2)) is None
+
+
+# ---------------------------------------------------------------------------
+# latency objective
+# ---------------------------------------------------------------------------
+
+
+class TestLatencyObjective:
+    def test_sheds_budget_under_spike_then_restores_after_calm(self):
+        policy.install(policy.SlotPolicy(flush_at=64, pipeline_depth=2,
+                                         finish_workers=2,
+                                         deadline_budget_s=12.0))
+        t = _tuner("latency")
+        assert t.slo_s == pytest.approx(4.0)  # slot_seconds / 3
+        # hot slots: shed the admission budget, halving toward the floor
+        d = t.observe(_obs(0, vapi_p99_s=6.0))
+        assert (d.knob, d.old, d.new) == ("deadline_budget_s", 12.0, 6.0)
+        d = t.observe(_obs(1, shed=5.0))   # shed counts as hot too
+        assert (d.knob, d.new) == ("deadline_budget_s", 3.0)  # the floor
+        # still hot at the floor: shrink the window instead
+        d = t.observe(_obs(2, vapi_p99_s=9.0))
+        assert (d.knob, d.old, d.new) == ("flush_at", 64, 32)
+        # calm: restore is deliberately slower than the shed (x1.5 after
+        # two consecutive calm slots) so a flapping spike can't oscillate
+        assert t.observe(_obs(3)) is None
+        restored = [t.observe(_obs(s)).new for s in (4, 5, 6, 7)]
+        assert restored == [4.5, 6.75, 10.125, 12.0]  # capped at hand
+        assert t.observe(_obs(8)) is None  # fully restored: stable
+
+    def test_healthy_slots_restore_double_buffering_first(self):
+        policy.install(policy.SlotPolicy(flush_at=64, pipeline_depth=1,
+                                         finish_workers=2,
+                                         deadline_budget_s=12.0))
+        t = _tuner("latency")
+        d = t.observe(_obs(0))
+        assert (d.knob, d.old, d.new) == ("pipeline_depth", 1, 2)
+        assert t.observe(_obs(1)) is None
+
+
+# ---------------------------------------------------------------------------
+# the sentinel as a hard constraint
+# ---------------------------------------------------------------------------
+
+
+class TestSentinelConstraint:
+    def test_armed_window_rejects_uncompiled_bucket_families(self):
+        policy.install(policy.SlotPolicy(flush_at=8, pipeline_depth=2,
+                                         finish_workers=2))
+        t = _tuner("throughput", armed=True)
+        d = t.observe(_obs(0))
+        assert d is None
+        rej = [x for x in t.decisions if not x.accepted]
+        assert [(x.knob, x.old, x.new, x.reason) for x in rej] == \
+            [("flush_at", 8, 16, "bucket")]
+        assert t.rejections == {"bucket": 1}
+        assert policy.current().flush_at == 8  # nothing moved
+        assert not t.frozen  # a rejection is not a strike
+
+    def test_armed_window_allows_moves_inside_the_warmed_set(self):
+        # the hand-tuned flush is warmed by construction: 32 -> 64 lands
+        # even while armed because sig(64) is already in the visited set
+        policy.install(policy.SlotPolicy(flush_at=32, pipeline_depth=2,
+                                         finish_workers=2))
+        t = _tuner("throughput", armed=True)
+        d = t.observe(_obs(0))
+        assert (d.knob, d.old, d.new) == ("flush_at", 32, 64)
+        assert t.rejections == {}
+
+    def test_warmup_moves_extend_the_visited_set(self):
+        policy.install(policy.SlotPolicy(flush_at=8, pipeline_depth=2,
+                                         finish_workers=2))
+        armed = [False]
+        t = _tuner("throughput", armed=lambda: armed[0])
+        # warmup: 8 -> 16 is a new family, but it compiles NOW (cheap)
+        # and joins the visited set
+        assert t.observe(_obs(0)).new == 16
+        armed[0] = True  # steady window arms mid-run
+        # 16 -> 32 would now be a fresh family: rejected, policy holds
+        assert t.observe(_obs(1)) is None
+        assert t.rejections == {"bucket": 1}
+        assert policy.current().flush_at == 16
+
+    def test_sentinel_strike_freezes_the_policy(self):
+        policy.install(policy.SlotPolicy(**BAD))
+        compiles = [0]
+        t = _tuner("throughput", compiles=lambda: compiles[0])
+        assert t.observe(_obs(0, finish_backlog=3.0)) is not None
+        epoch_before = policy.current().epoch
+        compiles[0] = 1  # a steady-state recompile landed while tuning
+        assert t.observe(_obs(1)) is None
+        assert t.frozen and t.rejections.get("sentinel_strike") == 1
+        # every later slot is a frozen no-op; the policy never moves again
+        assert t.observe(_obs(2)) is None
+        assert t.rejections.get("frozen") == 2
+        assert policy.current().epoch == epoch_before
+        assert t.report()["frozen"] is True
+
+    def test_degraded_plane_holds_tuning(self):
+        policy.install(policy.SlotPolicy(**BAD))
+        t = _tuner("throughput")
+        assert t.observe(_obs(0, breaker_open=True)) is None
+        assert t.observe(_obs(1, fallbacks=2.0)) is None
+        assert t.rejections == {"degraded": 2}
+        assert policy.current().flush_at == 8
+        d = t.observe(_obs(2, finish_backlog=3.0))  # healed: tuning resumes
+        assert d is not None and d.accepted
+
+
+def test_objective_validated():
+    with pytest.raises(ValueError):
+        autotune.AutoTuner("fastest")
+
+
+# ---------------------------------------------------------------------------
+# atomicity under the seeded interleaver (PR-16 harness)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.race
+def test_race_policy_updates_never_tear(monkeypatch):
+    """Concurrent writers install snapshots whose fields are internally
+    consistent (flush_at == 100 * pipeline_depth); readers must never
+    observe a mixed pair, and per-reader epochs must be monotone."""
+    monkeypatch.setattr(policy, "_listeners", [])
+
+    def scenario(rng):
+        policy.reset_for_testing()
+        errors: list[str] = []
+        stop = threading.Event()
+
+        def writer(depth: int):
+            for _ in range(8):
+                interleave.yield_point("pre-install")
+                policy.install(policy.SlotPolicy(
+                    flush_at=100 * depth, pipeline_depth=depth))
+
+        def reader():
+            last_epoch = -1
+            while not stop.is_set():
+                snap = policy.installed()
+                interleave.yield_point("post-read")
+                if snap is None:
+                    continue
+                if snap.flush_at != 100 * snap.pipeline_depth:
+                    errors.append(f"torn snapshot: {snap.flush_at} vs "
+                                  f"{snap.pipeline_depth}")
+                if snap.epoch < last_epoch:
+                    errors.append(f"epoch went backwards: {snap.epoch} < "
+                                  f"{last_epoch}")
+                last_epoch = snap.epoch
+
+        orig_lock = policy._lock
+        interleave.wrap_lock(policy)
+        try:
+            threads = [threading.Thread(target=writer, args=(d,))
+                       for d in (1, 2, 3)]
+            readers = [threading.Thread(target=reader) for _ in range(2)]
+            for th in threads + readers:
+                th.start()
+            for th in threads:
+                th.join(timeout=15)
+            stop.set()
+            for th in readers:
+                th.join(timeout=15)
+        finally:
+            policy._lock = orig_lock
+        assert not errors, errors[:5]
+
+    interleave.race_stress(scenario, seeds=20)
+
+
+# ---------------------------------------------------------------------------
+# the autotune health rules
+# ---------------------------------------------------------------------------
+
+
+class TestAutotuneHealthRules:
+    @staticmethod
+    def _checks():
+        from charon_tpu.app import health
+
+        return ({c.name: c for c in health.default_checks(
+            quorum_peers=0, slot_seconds=12.0)}, health.MetricWindow())
+
+    @staticmethod
+    def _snap(decisions: float, epoch: float, p99: float) -> tuple:
+        return ({("ops_autotune_decisions_total", ("flush_at",)): decisions},
+                {("ops_policy_epoch", ()): epoch},
+                {("vapi_route_latency_seconds", ("/x", "POST")):
+                 {"count": 10.0, "p50": p99 / 2, "p99": p99}})
+
+    def test_oscillating_fires_on_churn_without_improvement(self):
+        checks, w = self._checks()
+        w._snaps.append(self._snap(0.0, 1.0, 5.0))
+        w._snaps.append(self._snap(7.0, 8.0, 5.0))  # 7 moves, p99 flat
+        assert checks["autotune_oscillating"].func(w) is True
+        assert checks["policy_epoch_stale"].func(w) is False
+
+    def test_oscillating_quiet_when_latency_improves_or_few_moves(self):
+        checks, w = self._checks()
+        w._snaps.append(self._snap(0.0, 1.0, 5.0))
+        w._snaps.append(self._snap(7.0, 8.0, 2.0))  # converging: p99 down
+        assert checks["autotune_oscillating"].func(w) is False
+        w._snaps.clear()
+        w._snaps.append(self._snap(0.0, 1.0, 5.0))
+        w._snaps.append(self._snap(3.0, 4.0, 5.0))  # few moves
+        assert checks["autotune_oscillating"].func(w) is False
+
+    def test_epoch_stale_fires_when_decisions_outrun_the_gauge(self):
+        checks, w = self._checks()
+        w._snaps.append(self._snap(0.0, 3.0, 1.0))
+        w._snaps.append(self._snap(2.0, 3.0, 1.0))  # decisions, flat epoch
+        assert checks["policy_epoch_stale"].func(w) is True
+        w._snaps.clear()
+        w._snaps.append(self._snap(0.0, 3.0, 1.0))
+        w._snaps.append(self._snap(2.0, 5.0, 1.0))  # epoch advanced: fine
+        assert checks["policy_epoch_stale"].func(w) is False

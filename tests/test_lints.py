@@ -1031,7 +1031,7 @@ def test_self_check_whole_tree_against_baseline():
         cwd=REPO_ROOT, capture_output=True, text=True, timeout=300)
     report = json.loads(proc.stdout)
     assert report["version"] == 2
-    assert report["rules_version"] == 12
+    assert report["rules_version"] == 13
     # the concurrency-discipline rules must actually have run: the report's
     # per-rule counters enumerate every registered rule id
     assert "counts_by_rule" in report
@@ -1256,5 +1256,76 @@ def test_field_plane_rule_ignores_pallas_plane_and_other_dirs(tmp_path):
     findings = lint_source(tmp_path, "bench/x.py", """\
         def probe(PP, a, b):
             return PP.mont_mul_rows(a, b)
+    """)
+    assert findings == []
+
+
+# ---------------------------------------------------------------------------
+# LINT-TPU-023 — slot-shaping knob env reads stay behind the policy seam
+# ---------------------------------------------------------------------------
+
+
+def test_knob_env_rule_flags_reads_in_every_form(tmp_path):
+    findings = lint_source(tmp_path, "ops/plane_agg.py", """\
+        import os
+        from os import getenv
+
+        DEPTH = int(os.environ.get("CHARON_TPU_PIPELINE_DEPTH", "2"))
+        WORKERS = int(getenv("CHARON_TPU_FINISH_WORKERS", "2"))
+        CAP = os.environ["CHARON_TPU_H2C_CACHE_CAP"]
+    """)
+    assert rules_of(findings) == ["LINT-TPU-023"] * 3
+
+
+def test_knob_env_rule_resolves_constant_indirection(tmp_path):
+    # guard's re-export shape: the env name travels through a module-level
+    # constant (literal or knob-carrying attribute) before reaching the read
+    findings = lint_source(tmp_path, "ops/guard.py", """\
+        import os
+        from . import policy as policy_mod
+
+        SLOT_DEADLINE_ENV = policy_mod.ENV_SLOT_DEADLINE
+        LOCAL = "CHARON_TPU_BREAKER_THRESHOLD"
+
+        def slot_deadline_default():
+            return float(os.environ.get(SLOT_DEADLINE_ENV, "600"))
+
+        def threshold():
+            return int(os.environ.get(LOCAL, "3"))
+
+        def direct_attr():
+            return os.environ.get(policy_mod.ENV_BREAKER_COOLDOWN)
+    """)
+    assert rules_of(findings) == ["LINT-TPU-023"] * 3
+
+
+def test_knob_env_rule_exempts_the_seam_and_config(tmp_path):
+    seam = """\
+        import os
+        DEPTH = os.environ.get("CHARON_TPU_PIPELINE_DEPTH")
+    """
+    assert lint_source(tmp_path, "ops/policy.py", seam) == []
+    assert lint_source(tmp_path, "app/config.py", seam) == []
+    # same read anywhere else is the finding
+    assert rules_of(lint_source(tmp_path, "core/coalesce.py", seam)) == \
+        ["LINT-TPU-023"]
+
+
+def test_knob_env_rule_ignores_writes_and_other_vars(tmp_path):
+    findings = lint_source(tmp_path, "ops/mesh.py", """\
+        import os
+
+        DEVICES_ENV = "CHARON_TPU_SIGAGG_DEVICES"
+
+        def set_override(n):
+            # env WRITES feed the initial-value layer: legal everywhere
+            if n is None:
+                os.environ.pop(DEVICES_ENV, None)
+            else:
+                os.environ[DEVICES_ENV] = str(int(n))
+
+        def steady_after():
+            # non-knob env var: out of scope
+            return os.environ.get("CHARON_TPU_STEADY_AFTER", "")
     """)
     assert findings == []
