@@ -22,12 +22,19 @@ import time
 
 
 def _emit(name, value, unit, **extra):
-    # every record carries the resolved sigagg mesh width so BASELINE.md
-    # rows are attributable to a device topology (1 = single-device path)
+    # every record carries the resolved sigagg mesh topology so BASELINE.md
+    # rows are attributable to a device layout (n_devices is PER-HOST;
+    # n_hosts = 1, host_shard_width = {} on a single-process run)
     from charon_tpu.ops import mesh as mesh_mod
+    from charon_tpu.ops import plane_agg
 
+    with plane_agg._host_shard_width._lock:
+        host_widths = {k[0]: v for k, v
+                       in plane_agg._host_shard_width._children.items()}
     print(json.dumps({"config": name, "value": round(value, 2), "unit": unit,
                       "n_devices": mesh_mod.device_count(),
+                      "n_hosts": mesh_mod.host_count(),
+                      "host_shard_width": host_widths,
                       **extra}), flush=True)
 
 

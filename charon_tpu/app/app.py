@@ -82,9 +82,19 @@ class Config:
     jax_cache_dir: str | None = None
     # shard-width clamp for the multi-device sigagg plane (ops/mesh.py):
     # None leaves CHARON_TPU_SIGAGG_DEVICES / auto-discovery in charge,
-    # 1 forces the single-device path, N>1 caps the mesh at N devices
-    # (multi-tenant hosts pin it below the chip count)
+    # 1 forces the single-device path, N>1 caps the mesh at N PER-HOST
+    # devices (multi-tenant hosts pin it below the chip count)
     sigagg_devices: int | None = None
+    # multi-host crypto plane (ops/mesh.py jax.distributed seam): all
+    # three set -> assemble initializes the process into a
+    # coordinator-rooted multi-process mesh; all None leaves the
+    # CHARON_TPU_COORDINATOR / _PROCESS_ID / _PROCESS_COUNT env (or pure
+    # single-host discovery) in charge. process_count <= 1 is the
+    # explicit single-process passthrough: no jax.distributed call ever
+    # happens and the node is bit-identical to a local mesh.
+    coordinator: str | None = None       # "host:port" of process 0
+    process_id: int | None = None        # this process's index [0, count)
+    process_count: int | None = None     # cluster process count
     # self-healing device plane (ops/guard.py, docs/robustness.md); None
     # leaves the CHARON_TPU_BREAKER_* / _SLOT_DEADLINE_S env defaults:
     # consecutive slot failures before the breaker trips the plane native,
@@ -242,6 +252,25 @@ async def assemble(config: Config) -> App:
     from ..utils import jaxcache
 
     jaxcache.enable(config.jax_cache_dir or None)
+    if (config.coordinator is not None or config.process_id is not None
+            or config.process_count is not None):
+        # Multi-host coordinates BEFORE anything probes a jax backend:
+        # jax.distributed.initialize must run before the first device
+        # query or the process comes up single-host. configure_distributed
+        # only stages the env + validates — the actual initialize happens
+        # inside the mesh seam's first resolve, which the sigagg clamp or
+        # the tbls backend selection below triggers.
+        from ..ops import mesh as mesh_mod
+
+        spec = mesh_mod.configure_distributed(
+            coordinator=config.coordinator,
+            process_id=config.process_id,
+            process_count=config.process_count)
+        if spec is not None:
+            _log.info("multi-host mesh configured",
+                      coordinator=spec.coordinator,
+                      process_id=spec.process_id,
+                      process_count=spec.process_count)
     if config.sigagg_devices is not None:
         # Clamp the sigagg mesh BEFORE the tbls backend is selected: the
         # mesh seam caches its first resolve, and coalesce/flush sizing

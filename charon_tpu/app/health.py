@@ -159,6 +159,15 @@ def default_checks(quorum_peers: int,
               "override)",
               lambda w: (0 < w.gauge_sum("ops_sigagg_shard_width")
                          < w.gauge_sum("ops_mesh_devices"))),
+        Check("mesh_host_degraded",
+              "the multi-host mesh is running with fewer hosts than "
+              "configured (ops_mesh_hosts below ops_mesh_procs_configured "
+              "— a peer process dropped out at a membership rejoin and "
+              "this node degraded to standalone/narrower topology; "
+              "re-dispatches are placement-safe but cluster width is "
+              "reduced; see docs/perf.md multi-host scaling)",
+              lambda w: (0 < w.gauge_sum("ops_mesh_hosts")
+                         < w.gauge_sum("ops_mesh_procs_configured"))),
         Check("sigagg_plane_degraded",
               "sigagg slots fell back down the recovery ladder or the "
               "plane circuit breaker is open/half-open "

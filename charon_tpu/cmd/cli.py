@@ -130,6 +130,16 @@ def _bind_run_flags(run_p) -> None:
     run_p.add_argument("--otlp-address", dest="otlp_address", default=None,
                        help="OTLP/HTTP collector endpoint for trace export "
                             "(reference app/tracer Jaeger seam)")
+    run_p.add_argument("--coordinator", dest="coordinator", default=None,
+                       help="host:port of mesh process 0 — joins this node "
+                            "into a multi-host jax.distributed crypto plane "
+                            "(requires --process-id and --process-count)")
+    run_p.add_argument("--process-id", dest="process_id", default=None,
+                       help="this process's index [0, process-count) in the "
+                            "multi-host mesh")
+    run_p.add_argument("--process-count", dest="process_count", default=None,
+                       help="total processes in the multi-host mesh; 1 (or "
+                            "unset) keeps single-host local discovery")
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -275,6 +285,17 @@ def _cmd_alpha(args: argparse.Namespace) -> int:
     raise AssertionError(f"unhandled alpha command {args.alpha_command}")
 
 
+def _opt_int(value, flag: str) -> int | None:
+    """Optional integer flag value: None/"" passes through as None
+    (unset), anything else must parse."""
+    if value is None or value == "":
+        return None
+    try:
+        return int(value)
+    except (TypeError, ValueError):
+        raise SystemExit(f"{flag} must be an integer, got {value!r}")
+
+
 def _split_addr(addr: str, default_port: int) -> tuple[str, int]:
     if ":" in addr:
         host, port = addr.rsplit(":", 1)
@@ -322,6 +343,10 @@ def _cmd_run(args: argparse.Namespace) -> int:
         builder_api=bool(resolve_bool(args, "builder_api")),
         loki_endpoint=resolve(args, "loki_addresses", "") or "",
         otlp_endpoint=resolve(args, "otlp_address", "") or "",
+        coordinator=resolve(args, "coordinator"),
+        process_id=_opt_int(resolve(args, "process_id"), "--process-id"),
+        process_count=_opt_int(resolve(args, "process_count"),
+                               "--process-count"),
         test=test,
     )
     asyncio.run(app_run(config))

@@ -50,7 +50,7 @@ from pathlib import Path
 from typing import Iterable, Protocol, runtime_checkable
 
 # Bump when rule semantics change: invalidates persisted caches.
-RULES_VERSION = 13
+RULES_VERSION = 14
 
 PARSE_RULE = "LINT-PARSE-000"
 

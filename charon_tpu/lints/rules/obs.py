@@ -122,7 +122,7 @@ _DOC_PREFIXES = ("ops_", "core_", "vapi_", "dkg_", "p2p_", "app_",
                  "tracer_", "log_", "eth2_")
 _DOC_SUFFIXES = ("_total", "_seconds", "_state", "_backlog", "_width",
                  "_devices", "_requests", "_success", "_syncing", "_bytes",
-                 "_count", "_epoch")
+                 "_count", "_epoch", "_hosts", "_configured")
 _BACKTICK = re.compile(r"`([^`\n]+)`")
 
 

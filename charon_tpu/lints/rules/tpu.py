@@ -378,15 +378,20 @@ class PipelineLockSyncRule:
 
 
 _TOPOLOGY_PROBES = ("devices", "local_devices", "device_count",
-                    "local_device_count")
+                    "local_device_count", "process_index", "process_count")
+# jax.distributed.<attr> calls that establish or probe the multi-process
+# runtime — sanctioned only inside ops/mesh.py (the one init/epoch owner)
+_DISTRIBUTED_CALLS = ("initialize", "shutdown")
 
 
 class MeshTopologyRule:
     id = "LINT-TPU-008"
-    description = ("device topology must come from ops.mesh "
-                   "(sigagg_mesh/device_count) — bare jax.devices()/"
-                   "jax.local_device_count() bypasses the "
-                   "CHARON_TPU_SIGAGG_DEVICES clamp and the cached mesh")
+    description = ("device/process topology must come from ops.mesh "
+                   "(sigagg_mesh/device_count/host_count) — bare "
+                   "jax.devices()/jax.process_index()/"
+                   "jax.distributed.initialize() bypasses the "
+                   "CHARON_TPU_SIGAGG_DEVICES clamp, the cached mesh, and "
+                   "the multi-host membership epoch")
 
     def check(self, src: SourceFile) -> Iterable[Finding]:
         # whole-package scope; ops/mesh.py IS the sanctioned probe
@@ -397,17 +402,35 @@ class MeshTopologyRule:
             return
         for node in ast.walk(src.tree):
             if not (isinstance(node, ast.Call)
-                    and isinstance(node.func, ast.Attribute)
-                    and node.func.attr in _TOPOLOGY_PROBES
-                    and isinstance(node.func.value, ast.Name)
-                    and node.func.value.id in jax_al):
+                    and isinstance(node.func, ast.Attribute)):
                 continue
-            yield Finding(
-                src.rel, node.lineno, self.id,
-                f"`jax.{node.func.attr}()` probes device topology directly;"
-                " route through ops.mesh (sigagg_mesh/device_count) so the "
-                "CHARON_TPU_SIGAGG_DEVICES clamp applies and every slot "
-                "shares the one cached Mesh")
+            fn = node.func
+            if (fn.attr in _TOPOLOGY_PROBES
+                    and isinstance(fn.value, ast.Name)
+                    and fn.value.id in jax_al):
+                yield Finding(
+                    src.rel, node.lineno, self.id,
+                    f"`jax.{fn.attr}()` probes device/process topology "
+                    "directly; route through ops.mesh (sigagg_mesh/"
+                    "device_count/host_count) so the "
+                    "CHARON_TPU_SIGAGG_DEVICES clamp applies and every slot "
+                    "shares the one cached Mesh")
+                continue
+            # jax.distributed.initialize()/shutdown(): only ops/mesh.py may
+            # manage the multi-process runtime — a second initialize site
+            # races the coordinator handshake and skips the membership epoch
+            if (fn.attr in _DISTRIBUTED_CALLS
+                    and isinstance(fn.value, ast.Attribute)
+                    and fn.value.attr == "distributed"
+                    and isinstance(fn.value.value, ast.Name)
+                    and fn.value.value.id in jax_al):
+                yield Finding(
+                    src.rel, node.lineno, self.id,
+                    f"`jax.distributed.{fn.attr}()` manages the "
+                    "multi-process runtime outside ops/mesh.py; route "
+                    "through ops.mesh (configure_distributed/invalidate) so "
+                    "initialization is idempotent and membership epochs "
+                    "stay coherent")
 
 
 _NATIVE_PAIRING_CALLS = ("ct_pairing_check", "ct_hash_to_g2")

@@ -21,7 +21,17 @@ fused path) — under `utils.expbackoff`, landing on the bit-identical
 Every landing increments `ops_sigagg_fallback_total{reason,target}`.
 The ladder runs OFF the pipeline lock (stage-3 workers / the consuming
 thread), so concurrent packs never serialize behind a retry
-(LINT-TPU-007 still holds).
+(LINT-TPU-007 still holds). Widths are PER-HOST on a multi-host
+cluster: D is this host's device count, the narrowed rungs are
+host-LOCAL meshes (bridged over the HostLink, never a fresh global
+mesh mid-slot), and `mesh.invalidate()` also advances the host-
+membership epoch — the re-resolve rejoins surviving peers at the new
+epoch on a short liveness deadline or degrades this host to standalone
+width-D operation, so a re-dispatch never pins shards to a dead
+process. A peer that did NOT fail descends too: its next cross-host
+fence/exchange times out, classifies as device-class, and rides the
+same ladder — the cluster converges on the new epoch or on
+independent native operation, verdicts identical either way.
 
 **The circuit breaker** (`CircuitBreaker`): consecutive device-plane
 failures trip the whole plane to native for a cooldown —
@@ -403,7 +413,12 @@ def _state_width(state) -> int:
 def _run_ladder(inputs, hash_fn, start_width, reason, first_exc):
     """Re-pack and re-dispatch one slot at start_width, start_width/2, …,
     1, then the native rung. Input errors raise immediately at any rung;
-    the topology cache is invalidated first so retries see fresh devices."""
+    the topology cache is invalidated first so retries see fresh devices.
+    Widths are PER-HOST: on a multi-host cluster the invalidate bumps the
+    membership epoch (dead peers drop out at the rejoin barrier) and each
+    rung dispatches over a host-local mesh whose HostPlan bridges the
+    cluster combine over the surviving HostLink — or runs standalone when
+    this host degraded to local topology."""
     from . import mesh as mesh_mod
     from . import plane_agg as PA
 

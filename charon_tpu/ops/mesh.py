@@ -1,68 +1,415 @@
-"""Device-topology seam for the multi-device sigagg plane.
+"""Device- AND host-topology seam for the multi-device sigagg plane.
 
-Every production decision about HOW MANY devices the fused sigagg slot
-shards over flows through this module — nothing else in charon_tpu may
-probe `jax.devices()` / `jax.local_device_count()` directly (machine-
-checked by LINT-TPU-008). Centralizing the probe buys three things:
+Every production decision about HOW MANY devices (and, since the
+multi-host promotion, how many HOSTS) the fused sigagg slot shards over
+flows through this module — nothing else in charon_tpu may probe
+`jax.devices()` / `jax.local_device_count()` / `jax.process_index()` or
+call `jax.distributed.initialize` directly (machine-checked by
+LINT-TPU-008). Centralizing the probe buys three things:
 
-  * one override knob: `CHARON_TPU_SIGAGG_DEVICES` clamps the shard
-    width (ops deployments pin it below the host's device count to leave
-    chips for other tenants, or to 1 to force the single-device path);
+  * one override knob: `CHARON_TPU_SIGAGG_DEVICES` clamps the PER-HOST
+    shard width (ops deployments pin it below the host's device count to
+    leave chips for other tenants, or to 1 to force the single-device
+    path); the cluster knobs (`CHARON_TPU_COORDINATOR` / `_PROCESS_ID` /
+    `_PROCESS_COUNT`) bring additional hosts into the same plane;
   * one cached Mesh object: `sharded_plane._build_steps` is lru_cached
     on the mesh, so every slot must see the SAME Mesh instance or the
     compiled sharded executables are rebuilt per call;
   * a robust single-device passthrough: hosts with one device (or no
-    usable jax backend at all) get `sigagg_mesh() is None`, and callers
-    keep the exact single-device `_fused_dispatch` path, bit-identical
-    to a build without this module.
+    usable jax backend at all) get `sigagg_mesh() is None`, and an
+    unset/`1` process count takes the exact pre-multi-host code path —
+    zero `jax.distributed` calls, bit-identical behaviour.
 
-The `ops_mesh_devices` gauge exports the resolved width (0 = no backend)
-so the health checker can cross-check it against the width slots actually
-dispatch with (`ops_sigagg_shard_width`).
+Multi-host operation has two modes, chosen per resolve from the local
+platform:
+
+  * ``"global"`` (real accelerators): `jax.distributed.initialize`
+    connects the processes and ONE 1-D "data" Mesh is built over
+    hosts x width devices, ordered host-major by `process_index`. The
+    sharded stages' collectives (the EC-add ppermute butterfly, the
+    verify all_gather) then span hosts natively over ICI/DCN; each host
+    packs and reads back only its addressable shards.
+  * ``"bridged"`` (XLA:CPU — multiprocess computations are not
+    implemented by the CPU backend): each host keeps a LOCAL "data"
+    Mesh (built even at width 1 so host-level chunking still routes
+    through the sharded plane) and the cross-host combines ride the
+    coordinator's key-value store through :class:`HostLink` — the same
+    wire the CI compose cluster uses, so the 2-process dryrun exercises
+    the identical control flow the TPU pod takes.
+
+The `ops_mesh_devices` gauge exports the resolved PER-HOST width (0 = no
+backend) so the health checker can cross-check it against the width
+slots actually dispatch with (`ops_sigagg_shard_width`); `ops_mesh_hosts`
+vs `ops_mesh_procs_configured` is the cluster-membership analogue (the
+`mesh_host_degraded` health rule fires when a configured peer is gone
+and the node is running host-degraded).
+
+Degradation contract (the guard ladder's `invalidate()` hook): dropping
+the cached meshes ALSO advances the host epoch, so the next resolve
+re-negotiates cluster membership at a fresh barrier instead of pinning
+shards to a dead process. Peers that invalidate together rejoin at the
+matching epoch and rebuild the multi-host plane; a host whose peers
+never show up (liveness timeout) degrades to a correct standalone
+single-host topology and keeps serving.
 """
 
 from __future__ import annotations
 
+import dataclasses
+import io
 import os
 import threading
 
-from ..utils import faults, metrics
+import numpy as np
+
+from ..utils import errors, faults, metrics
 
 # Shard-width override: >0 clamps the mesh to min(value, local devices);
 # 1 forces the single-device passthrough. Read at first resolve — set it
 # before any sigagg dispatch (app config wires Config.sigagg_devices
 # through here before the tbls backend is selected). Resolution routes
 # through the SlotPolicy seam (installed policy → this env var → auto).
+# On a multi-host mesh this clamps the PER-HOST width; the cluster width
+# is hosts × this value.
 DEVICES_ENV = "CHARON_TPU_SIGAGG_DEVICES"
+
+# Multi-process cluster knobs (app Config / CLI write these through
+# configure_distributed): coordinator "host:port", this process's id in
+# [0, count), and the total process count. Count unset or <= 1 is THE
+# single-host passthrough — nothing below touches jax.distributed.
+COORDINATOR_ENV = "CHARON_TPU_COORDINATOR"
+PROCESS_ID_ENV = "CHARON_TPU_PROCESS_ID"
+PROCESS_COUNT_ENV = "CHARON_TPU_PROCESS_COUNT"
+
+# Cross-host wait budgets (seconds). The exchange timeout bounds every
+# HostLink barrier/exchange — generous by default because a peer may be
+# cold-compiling its half of a slot. The liveness timeout is the short
+# one: how long a post-invalidate rebuild waits for peers to show up at
+# the new epoch barrier before concluding they are dead and degrading to
+# a standalone single-host topology.
+HOST_TIMEOUT_ENV = "CHARON_TPU_HOST_TIMEOUT_S"
+HOST_LIVENESS_ENV = "CHARON_TPU_HOST_LIVENESS_S"
 
 _mesh_devices_g = metrics.gauge(
     "ops_mesh_devices",
-    "Resolved sigagg mesh width: local devices clamped by "
+    "Resolved per-host sigagg mesh width: local devices clamped by "
     "CHARON_TPU_SIGAGG_DEVICES (0 = no usable jax backend)")
+_mesh_hosts_g = metrics.gauge(
+    "ops_mesh_hosts",
+    "Hosts participating in the resolved sigagg mesh (1 = single-host "
+    "or degraded-standalone; 0 = not yet resolved)")
+_mesh_procs_g = metrics.gauge(
+    "ops_mesh_procs_configured",
+    "Configured jax.distributed process count (0 = multi-host not "
+    "configured)")
 
 _lock = threading.Lock()
-_resolved: list = []  # [(width, mesh_or_none)] — cached after first probe
+_dist_lock = threading.Lock()  # guards _dist_client (nested inside _lock)
+_resolved: list = []  # [(width, mesh, topology, link)] — cached resolve
 _narrowed: dict = {}  # width -> Mesh, the guard ladder's D/2... rungs
+_host_epoch = 0       # advanced by invalidate(): membership generation
+_dist_client = None   # the jax.distributed coordination-service client
+_test_topology: list = []  # [(HostTopology, link)] test override
 
 
-def _discover() -> list:
+@dataclasses.dataclass(frozen=True)
+class DistributedSpec:
+    """Validated multi-process configuration (None-spec == single host)."""
+
+    coordinator: str
+    process_id: int
+    process_count: int
+
+
+@dataclasses.dataclass(frozen=True)
+class HostTopology:
+    """The resolved cluster shape a mesh was built under.
+
+    ``hosts``/``host_index`` are the EFFECTIVE values slots shard with
+    (1/0 when single-host or degraded-standalone); ``configured`` keeps
+    the configured process count so health can tell "never configured"
+    from "configured but running degraded"."""
+
+    hosts: int
+    host_index: int
+    mode: str        # "local" | "bridged" | "global"
+    configured: int
+
+
+_LOCAL_TOPOLOGY = HostTopology(1, 0, "local", 0)
+
+
+def distributed_spec():
+    """The validated multi-process spec from the env knobs, or None when
+    the process count is unset/1 (the single-host passthrough — this
+    function is the ONLY gate, and it returns None without touching
+    `jax.distributed` or even the coordinator knobs). Malformed knobs
+    raise a clear CharonError naming the offending value."""
+    raw_count = os.environ.get(PROCESS_COUNT_ENV)
+    if raw_count is None or not raw_count.strip():
+        return None
+    try:
+        count = int(raw_count)
+    except ValueError:
+        raise errors.new("invalid process count (not an integer)",
+                         env=PROCESS_COUNT_ENV, value=raw_count) from None
+    if count <= 1:
+        return None
+    coordinator = (os.environ.get(COORDINATOR_ENV) or "").strip()
+    host, sep, port_s = coordinator.rpartition(":")
+    if not coordinator or not sep or not host:
+        raise errors.new(
+            "coordinator address must be host:port",
+            env=COORDINATOR_ENV, value=coordinator)
+    try:
+        port = int(port_s)
+    except ValueError:
+        raise errors.new("coordinator port is not an integer",
+                         env=COORDINATOR_ENV, value=coordinator) from None
+    if not 1 <= port <= 65535:
+        raise errors.new("coordinator port out of range",
+                         env=COORDINATOR_ENV, value=coordinator, port=port)
+    raw_id = os.environ.get(PROCESS_ID_ENV)
+    if raw_id is None or not raw_id.strip():
+        raise errors.new("process id required when process count > 1",
+                         env=PROCESS_ID_ENV, process_count=count)
+    try:
+        pid = int(raw_id)
+    except ValueError:
+        raise errors.new("invalid process id (not an integer)",
+                         env=PROCESS_ID_ENV, value=raw_id) from None
+    if not 0 <= pid < count:
+        raise errors.new("process id out of range",
+                         env=PROCESS_ID_ENV, process_id=pid,
+                         process_count=count)
+    return DistributedSpec(coordinator, pid, count)
+
+
+def configure_distributed(coordinator=None, process_id=None,
+                          process_count=None):
+    """Apply the app Config's cluster knobs (None fields stay unmanaged —
+    a direct env setting survives, mirroring set_override) and validate:
+    returns the resulting DistributedSpec or None, raising CharonError on
+    malformed values so assembly fails fast instead of at first slot."""
+    if coordinator is not None:
+        os.environ[COORDINATOR_ENV] = str(coordinator)
+    if process_id is not None:
+        os.environ[PROCESS_ID_ENV] = str(int(process_id))
+    if process_count is not None:
+        os.environ[PROCESS_COUNT_ENV] = str(int(process_count))
+    with _lock:
+        _resolved.clear()
+        _narrowed.clear()
+    return distributed_spec()
+
+
+def _exchange_timeout_s() -> float:
+    try:
+        return float(os.environ.get(HOST_TIMEOUT_ENV, "") or 600.0)
+    except ValueError:
+        return 600.0
+
+
+def _liveness_timeout_s() -> float:
+    try:
+        return float(os.environ.get(HOST_LIVENESS_ENV, "") or 15.0)
+    except ValueError:
+        return 15.0
+
+
+def _ensure_distributed(spec):
+    """Connect this process to the jax.distributed coordination service
+    (idempotent — the service cannot be re-initialized in-process, so the
+    client survives invalidate(); membership generations are expressed
+    with epoch-scoped barriers instead). MUST run before the first jax
+    backend probe: `jax.distributed.initialize` has to precede backend
+    initialization for the global device view to form."""
+    global _dist_client
+    with _dist_lock:
+        if _dist_client is not None:
+            return _dist_client
+        try:
+            import jax
+            from jax._src import distributed as _jdist
+
+            if _jdist.global_state.client is None:
+                jax.distributed.initialize(
+                    coordinator_address=spec.coordinator,
+                    num_processes=spec.process_count,
+                    process_id=spec.process_id)
+            client = _jdist.global_state.client
+        except Exception as exc:  # noqa: BLE001 — surface one clear error
+            raise errors.wrap(
+                exc, "jax.distributed initialization failed",
+                coordinator=spec.coordinator, process_id=spec.process_id,
+                process_count=spec.process_count)
+        if client is None:
+            raise errors.new(
+                "jax.distributed initialized without a coordination client",
+                coordinator=spec.coordinator)
+        _dist_client = client
+        return client
+
+
+class HostLink:
+    """Cross-host control/data exchange over the jax.distributed
+    coordination service — the non-collective wire of the multi-host
+    plane. Every key and barrier id is namespaced by the membership
+    epoch, so traffic from before an invalidate() can never be confused
+    with the rebuilt cluster's.
+
+    The exchange protocol is SPMD: all hosts must call `exchange` with
+    the SAME tag in the same slot order (the sharded plane derives tags
+    from the dispatch-assigned slot sequence number, not call order, so
+    racing stage-3 worker threads cannot skew them). Keys are deleted
+    after a completion barrier, so the coordinator's store stays bounded
+    by in-flight slots."""
+
+    def __init__(self, client, hosts: int, host_index: int, epoch: int):
+        self._client = client
+        self.hosts = int(hosts)
+        self.host_index = int(host_index)
+        self.epoch = int(epoch)
+
+    def _ms(self, timeout_s) -> int:
+        if timeout_s is None:
+            timeout_s = _exchange_timeout_s()
+        return max(1, int(float(timeout_s) * 1000))
+
+    def barrier(self, name: str, timeout_s=None) -> None:
+        """Block until every host reaches `name` (epoch-scoped, one-shot
+        per name). A timeout raises the coordination service's runtime
+        error, which guard.classify maps to "device_lost" — the ladder
+        rides it like any other device-class failure."""
+        self._client.wait_at_barrier(
+            f"charon/{self.epoch}/b/{name}", self._ms(timeout_s))
+
+    def exchange(self, tag: str, payload: bytes,
+                 timeout_s=None) -> list[bytes]:
+        """All-to-all byte exchange: publish this host's payload under
+        `tag`, collect every host's (ordered by host index), then meet a
+        completion barrier and delete our key. Returns the host-ordered
+        payload list (our own included, by identity)."""
+        base = f"charon/{self.epoch}/x/{tag}"
+        payload = bytes(payload)
+        self._client.key_value_set_bytes(f"{base}/{self.host_index}",
+                                         payload)
+        out = []
+        for h in range(self.hosts):
+            if h == self.host_index:
+                out.append(payload)
+            else:
+                out.append(bytes(self._client.blocking_key_value_get_bytes(
+                    f"{base}/{h}", self._ms(timeout_s))))
+        self._client.wait_at_barrier(f"{base}/done", self._ms(timeout_s))
+        self._client.key_value_delete(f"{base}/{self.host_index}")
+        return out
+
+
+def pack_arrays(**arrays) -> bytes:
+    """Serialize named numpy arrays for a HostLink exchange (npz, no
+    pickle — payloads cross a trust boundary only in the sense that a
+    peer bug must not become an arbitrary-object load)."""
+    buf = io.BytesIO()
+    np.savez(buf, **{k: np.asarray(v) for k, v in arrays.items()})
+    return buf.getvalue()
+
+
+def unpack_arrays(blob: bytes) -> dict:
+    """Inverse of pack_arrays (allow_pickle stays False)."""
+    with np.load(io.BytesIO(blob), allow_pickle=False) as z:
+        return {k: z[k] for k in z.files}
+
+
+def _discover(local: bool = False) -> list:
     """THE sanctioned topology probe (everything else routes through this
     module, LINT-TPU-008). Returns [] when jax or its backend is missing/
     broken — callers degrade to the single-device (native-fallback) path
-    instead of raising at import or assembly time."""
+    instead of raising at import or assembly time. With a distributed
+    cluster up, `local=True` scopes the probe to THIS host's devices
+    (the global view is assembled separately by _multi_host_mesh)."""
     try:
         import jax
 
-        return list(jax.devices())
+        return list(jax.local_devices() if local else jax.devices())
     except Exception:  # noqa: BLE001 — no backend == single-device host
         return []
 
 
-def _resolve() -> tuple[int, object]:
+def _resolve_topology(spec, devices):
+    """Cluster membership for this resolve: meet the peers at the current
+    epoch's join barrier, or degrade to a correct standalone topology
+    when they don't show up. Epoch 0 (process start) waits the full
+    exchange budget — peers may still be booting; later epochs (post-
+    invalidate rebuilds) wait only the liveness budget, because a peer
+    that invalidated with us is already running and merely re-resolving.
+    """
+    if _test_topology:
+        topo, link = _test_topology[0]
+        _mesh_procs_g.set(float(topo.configured))
+        _mesh_hosts_g.set(float(topo.hosts))
+        return topo, link
+    if spec is None or not devices:
+        _mesh_procs_g.set(0.0 if spec is None else float(spec.process_count))
+        _mesh_hosts_g.set(1.0)
+        if spec is None:
+            return _LOCAL_TOPOLOGY, None
+        return HostTopology(1, 0, "local", spec.process_count), None
+    _mesh_procs_g.set(float(spec.process_count))
+    client = _ensure_distributed(spec)
+    mode = "bridged" if devices[0].platform == "cpu" else "global"
+    link = HostLink(client, spec.process_count, spec.process_id,
+                    _host_epoch)
+    timeout = (_exchange_timeout_s() if _host_epoch == 0
+               else _liveness_timeout_s())
+    try:
+        link.barrier("join", timeout_s=timeout)
+    except Exception:  # noqa: BLE001 — peers gone: standalone, not down
+        _mesh_hosts_g.set(1.0)
+        return HostTopology(1, 0, "local", spec.process_count), None
+    _mesh_hosts_g.set(float(spec.process_count))
+    return (HostTopology(spec.process_count, spec.process_id, mode,
+                         spec.process_count), link)
+
+
+def _multi_host_mesh(devices, n: int, topo):
+    """The Mesh for a hosts>1 topology. Global mode: ONE 1-D "data" mesh
+    over hosts x n devices, host-major by process_index, so contiguous
+    validator chunks land host-by-host and each host's pack touches only
+    its addressable shards. Bridged mode: this host's LOCAL mesh (built
+    even at n == 1 — the cluster still chunks over hosts x 1). Returns
+    None when the global view doesn't have n devices per host (callers
+    degrade to single-host)."""
+    if not devices:
+        return None
+    from jax.sharding import Mesh
+
+    if topo.mode == "global":
+        try:
+            import jax
+
+            alld = list(jax.devices())
+        except Exception:  # noqa: BLE001 — backend gone mid-resolve
+            return None
+        rows = []
+        for p in range(topo.hosts):
+            mine = [d for d in alld if d.process_index == p][:n]
+            if len(mine) < n:
+                return None
+            rows.extend(mine)
+        return Mesh(np.asarray(rows), axis_names=("data",))
+    return Mesh(np.asarray(devices[:n]), axis_names=("data",))
+
+
+def _resolve():
     faults.check("mesh.resolve")
     from . import policy as policy_mod
 
-    devices = _discover()
+    spec = None if _test_topology else distributed_spec()
+    if spec is not None:
+        # distributed init MUST precede the first backend probe below
+        _ensure_distributed(spec)
+    devices = _discover(local=spec is not None)
     n = len(devices)
     override = policy_mod.sigagg_devices_override()
     if override > 0:
@@ -74,42 +421,96 @@ def _resolve() -> tuple[int, object]:
         # opt-in via CHARON_TPU_SIGAGG_DEVICES (the dryrun and the tier-1
         # sharded tests set it); real accelerators auto-promote.
         n = 1
+    topo, link = _resolve_topology(spec, devices)
     mesh = None
-    if n > 1:
-        import numpy as np
+    if topo.hosts > 1:
+        mesh = _multi_host_mesh(devices, max(1, n), topo)
+        if mesh is None:
+            # cannot honour the multi-host shape: correct standalone
+            topo, link = (HostTopology(1, 0, "local", topo.configured),
+                          None)
+            _mesh_hosts_g.set(1.0)
+    if mesh is None and n > 1:
         from jax.sharding import Mesh
 
         mesh = Mesh(np.asarray(devices[:n]), axis_names=("data",))
     _mesh_devices_g.set(float(n))
-    return (max(1, n) if devices else 1, mesh)
+    return (max(1, n) if devices else 1, mesh, topo, link)
+
+
+def _resolved_state():
+    with _lock:
+        if not _resolved:
+            _resolved.append(_resolve())
+        return _resolved[0]
 
 
 def device_count() -> int:
-    """Devices the sigagg plane shards over (cached; never < 1). This is
-    the scaling factor for batching knobs (core/coalesce sizes its flush
-    threshold off it) — NOT the raw host inventory."""
-    with _lock:
-        if not _resolved:
-            _resolved.append(_resolve())
-        return _resolved[0][0]
+    """Devices the sigagg plane shards over PER HOST (cached; never < 1).
+    This is the scaling factor for host-local batching knobs (core/
+    coalesce sizes its flush threshold off it) — NOT the raw host
+    inventory and NOT the cluster width (host_count() x this)."""
+    return _resolved_state()[0]
 
 
 def sigagg_mesh():
-    """The cached 1-D "data" `jax.sharding.Mesh` over the first
-    device_count() local devices, or None when only one device is usable
-    (the single-device passthrough: callers must keep the exact
-    single-device dispatch path)."""
-    with _lock:
-        if not _resolved:
-            _resolved.append(_resolve())
-        return _resolved[0][1]
+    """The cached 1-D "data" `jax.sharding.Mesh` the sharded plane
+    dispatches over, or None for the single-device passthrough (callers
+    must keep the exact single-device dispatch path). Single host: the
+    first device_count() local devices. Multi-host global mode: ONE mesh
+    over hosts x width devices. Multi-host bridged mode: this host's
+    local mesh (present even at width 1 — host-level chunking still
+    routes through the sharded plane)."""
+    return _resolved_state()[1]
+
+
+def host_count() -> int:
+    """Hosts participating in the resolved mesh (1 = single-host or
+    degraded-standalone)."""
+    return _resolved_state()[2].hosts
+
+
+def host_index() -> int:
+    """This process's index among host_count() hosts (0 when single)."""
+    return _resolved_state()[2].host_index
+
+
+def host_mode() -> str:
+    """"local" | "bridged" | "global" (module docstring)."""
+    return _resolved_state()[2].mode
+
+
+def host_link():
+    """The HostLink for cross-host exchanges, or None when hosts == 1."""
+    return _resolved_state()[3]
+
+
+def global_width() -> int:
+    """The cluster-wide shard width: host_count() x device_count() —
+    the denominator of the validator chunking on a multi-host mesh."""
+    st = _resolved_state()
+    return st[0] * st[2].hosts
+
+
+def is_global_mesh(mesh) -> bool:
+    """True when `mesh` spans devices of more than one process — the
+    sharded plane's mode discriminator (a narrowed guard-ladder rung on
+    a multi-host cluster is a LOCAL mesh, so it runs bridged even on
+    accelerators where the primary mesh is global)."""
+    try:
+        return len({d.process_index for d in mesh.devices.flat}) > 1
+    except Exception:  # noqa: BLE001 — fake/test meshes: not global
+        return False
 
 
 def narrowed(width: int):
-    """A cached 1-D "data" Mesh over the first `width` resolved devices —
-    the D/2 … 2 rungs of ops.guard's fallback ladder. Returns None when
-    `width` <= 1 (callers take the single-device `_fused_dispatch` path)
-    or when fewer than `width` devices are usable. Cached per width so
+    """A cached 1-D "data" Mesh over the first `width` LOCAL devices —
+    the D/2 … 2 rungs of ops.guard's fallback ladder. On a multi-host
+    cluster every host narrows its OWN width (the rung meshes are local;
+    cross-host combines stay on the HostLink), so device loss degrades
+    per-host before anything falls native. Returns None when `width` <= 1
+    (callers take the single-device `_fused_dispatch` path) or when fewer
+    than `width` devices are usable. Cached per width so
     `sharded_plane._build_steps`'s lru_cache keys stay stable across
     retries — every retry at width W reuses ONE Mesh object and its
     compiled sharded executables."""
@@ -119,10 +520,9 @@ def narrowed(width: int):
     with _lock:
         if width in _narrowed:
             return _narrowed[width]
-    devices = _discover()
+    devices = _discover(local=_dist_client is not None)
     if len(devices) < width:
         return None
-    import numpy as np
     from jax.sharding import Mesh
 
     m = Mesh(np.asarray(devices[:width]), axis_names=("data",))
@@ -132,13 +532,24 @@ def narrowed(width: int):
 
 
 def invalidate() -> None:
-    """Drop every cached mesh (primary and narrowed) so the next dispatch
-    re-probes the topology. ops.guard calls this after classifying a
-    device-lost failure: the device set may genuinely have changed, and a
-    stale Mesh over a dead chip would fail every retry."""
+    """Drop every cached mesh (primary and narrowed) AND advance the
+    host epoch so the next dispatch re-probes the topology and
+    re-negotiates cluster membership. ops.guard calls this after
+    classifying a device-lost failure: the device set may genuinely have
+    changed, and a stale Mesh over a dead chip — or a distributed
+    topology pinning shards to a dead PROCESS — would fail every retry.
+    Peers that invalidate together meet at the new epoch's join barrier
+    and rebuild the multi-host plane; if the peers are really gone the
+    liveness timeout expires and this host degrades to a correct
+    standalone topology (the `mesh_host_degraded` health rule surfaces
+    that state)."""
+    global _host_epoch
     with _lock:
         _resolved.clear()
         _narrowed.clear()
+        if _dist_client is not None or _test_topology \
+                or os.environ.get(PROCESS_COUNT_ENV):
+            _host_epoch += 1
 
 
 def set_override(n: int | None) -> None:
@@ -152,10 +563,30 @@ def set_override(n: int | None) -> None:
     reset_for_testing()
 
 
+def set_host_topology_for_testing(hosts: int, host_index: int, mode: str,
+                                  link=None) -> None:
+    """Install a fake multi-host topology (unit tests / the loopback
+    harness): the next resolve skips the env spec and jax.distributed
+    entirely and reports this shape. hosts <= 1 clears the override."""
+    with _lock:
+        _test_topology.clear()
+        if hosts > 1:
+            _test_topology.append(
+                (HostTopology(int(hosts), int(host_index), str(mode),
+                              int(hosts)), link))
+        _resolved.clear()
+        _narrowed.clear()
+
+
 def reset_for_testing() -> None:
-    """Drop the cached mesh (tests flip DEVICES_ENV between cases). The
+    """Drop the cached mesh and any test topology override, and rewind
+    the host epoch (tests flip the env knobs between cases; the real
+    coordination client — which cannot be re-initialized — is kept). The
     sharded _build_steps lru_cache keys on the Mesh object, so a reset
     also makes subsequent slots recompile — production never resets."""
+    global _host_epoch
     with _lock:
         _resolved.clear()
         _narrowed.clear()
+        _test_topology.clear()
+        _host_epoch = 0

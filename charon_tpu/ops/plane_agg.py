@@ -96,7 +96,17 @@ _verify_backlog = metrics.gauge(
 # slots are not being promoted onto the sharded plane.
 _shard_width = metrics.gauge(
     "ops_sigagg_shard_width",
-    "Devices the current sigagg slot's validator axis is sharded over")
+    "Devices the current sigagg slot's validator axis is sharded over "
+    "(PER-HOST width on a multi-host mesh)")
+
+# Per-host twin of ops_sigagg_shard_width, labelled by host index: on a
+# multi-host mesh every host sets its own row, so a scrape across the
+# cluster shows which host narrowed its rung after a device loss (the
+# guard ladder narrows per-host). Single-host nodes show one row, host="0".
+_host_shard_width = metrics.gauge(
+    "ops_sigagg_host_shard_width",
+    "Per-host devices the current sigagg slot's validator axis is sharded "
+    "over, labelled by mesh host index", ("host",))
 
 # Whole slots queued in the pipeline (dispatched, finish not yet consumed)
 # — the serving layer's backpressure signal: core/coalesce estimates drain
@@ -889,6 +899,9 @@ def _fused_dispatch(layout, pks, msgs):
         state = _fused_dispatch_impl(layout, pks, msgs)
         span.attrs["outcome"] = state[0]
         _shard_width.set(1.0)
+        from . import mesh as mesh_mod
+
+        _host_shard_width.set(1.0, str(mesh_mod.host_index()))
         return state
 
 
@@ -2209,13 +2222,15 @@ def hash_to_g2_planes(msgs):
     return hx, hy
 
 
-def _device_pairing_check(S, live) -> bool:
+def _device_pairing_check(S, live, plan=None) -> bool:
     """One batched device dispatch for a slot's verification: H(m) limb
     planes from the upgraded cache (bucketed device h2c on the miss set),
     every pair's Miller loop on its own batch lane, a single final
     exponentiation on the RLC-folded Fq12 product. The signature pair
     rides as (−g1, S) — negation folded into the G1 y-coordinate. Shards
-    the pair axis across the mesh when one is up."""
+    the pair axis across the mesh when one is up; a multi-host `plan`
+    (the dispatching slot's HostPlan) keys the cluster verify's exchange
+    on that slot's sequence number."""
     from ..crypto.curve import to_affine
     from . import pairing as pairing_mod
 
@@ -2242,7 +2257,8 @@ def _device_pairing_check(S, live) -> bool:
     if mesh is not None:
         from . import sharded_plane
 
-        return sharded_plane.sharded_pairing_check(p_x, p_y, q_x, q_y, mesh)
+        return sharded_plane.sharded_pairing_check(p_x, p_y, q_x, q_y, mesh,
+                                                   plan=plan)
     return pairing_mod.pairing_check_planes(p_x, p_y, q_x, q_y)
 
 
@@ -2270,7 +2286,7 @@ def _native_pairing_finish(S, live, hash_fn=None) -> bool:
         b"".join(g1_pts), b"".join(g2_pts), bytes(negs))
 
 
-def _pairing_finish(S, group_points, hash_fn=None) -> bool:
+def _pairing_finish(S, group_points, hash_fn=None, plan=None) -> bool:
     """Multi-pairing over host Jacobians: S = Σ rᵢ·sigᵢ (G2) and per
     distinct message m its P_m = Σ rᵢ·pkᵢ (G1). The whole check is the
     "verify" phase of ops_device_dispatch_seconds: one batched device
@@ -2278,7 +2294,10 @@ def _pairing_finish(S, group_points, hash_fn=None) -> bool:
     degrading through guard.note_verify_fallback to the native
     ct_pairing_check rung on a device-class failure — same verdicts
     either way, split by ops_pairing_total{path}. A caller-injected
-    hash_fn (test paths) always takes the native rung."""
+    hash_fn (test paths) always takes the native rung. `plan` is the
+    dispatching slot's sharded_plane.HostPlan: threaded into the device
+    check so a multi-host cluster verify exchanges under the slot's own
+    sequence tag (worker threads race; tags must not be call-ordered)."""
     with _dispatch_hist.observe_time("verify"):
         live = []
         for m, P in group_points:
@@ -2297,7 +2316,7 @@ def _pairing_finish(S, group_points, hash_fn=None) -> bool:
 
             if guard.BREAKER.state != guard.OPEN:
                 try:
-                    ok = _device_pairing_check(S, live)
+                    ok = _device_pairing_check(S, live, plan=plan)
                 except Exception as exc:  # degrade to the native rung
                     reason = guard.classify(exc)
                     if reason == "input":

@@ -213,13 +213,17 @@ class PlaneStore:
         """Memoize a DEVICE-RESIDENT sharded derivation of a pubkey set —
         the sharded plane's per-device pk parse stacks, placed with a
         NamedSharding across the mesh by `build()`. Keyed on the full-set
-        digest plus the shard geometry (D, Vd, Vp), so a mesh-width or
-        bucket change builds a fresh placement while the steady state
-        (static cluster set, fixed mesh) is pure hits: zero host parse
-        AND zero host→device pk transfer per slot. Same LRU/pinning as
-        the device planes; counted under kind="device". Tests that
-        rebuild the mesh between cases must also swap in a fresh STORE —
-        a cached entry holds arrays committed to the old mesh's devices.
+        digest plus the caller's shard geometry — (D, Vd, Vp) on one
+        host, (W, Vd, Vp, hosts, host_index) on a multi-host topology
+        (the host_index keeps two hosts' DIFFERENT chunk ranges from
+        colliding, and preserves the exact single-host key when hosts is
+        1) — so a mesh-width, bucket or membership change builds a fresh
+        placement while the steady state (static cluster set, fixed mesh)
+        is pure hits: zero host parse AND zero host→device pk transfer
+        per slot. Same LRU/pinning as the device planes; counted under
+        kind="device". Tests that rebuild the mesh between cases must
+        also swap in a fresh STORE — a cached entry holds arrays
+        committed to the old mesh's devices.
         """
         key = (self.digest(pks), "sharded") + tuple(geometry)
         with self._lock:

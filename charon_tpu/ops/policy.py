@@ -88,7 +88,7 @@ class SlotPolicy:
     pipeline_depth: int | None = None
     finish_workers: int | None = None
     # device plane shape/routing
-    sigagg_devices: int | None = None     # mesh clamp (0/None = auto)
+    sigagg_devices: int | None = None     # PER-HOST mesh clamp (0 = auto)
     device_verify: bool | None = None     # device pairing verify on/off
     field_plane: str | None = None        # "xla" | "pallas"
     h2c_cache_cap: int | None = None
@@ -215,7 +215,12 @@ def finish_workers_default() -> int:
 
 
 def sigagg_devices_override() -> int:
-    """The mesh shard-width clamp: >0 clamps, 0 = no override (auto)."""
+    """The mesh shard-width clamp: >0 clamps, 0 = no override (auto).
+    PER-HOST on a multi-host cluster — every process applies the clamp to
+    its own local devices, so the cluster width is hosts × this value
+    (the `jax.distributed` coordinates themselves are Config/CLI-level
+    topology, not a tunable slot-shaping knob, and deliberately do NOT
+    flow through SlotPolicy)."""
     pol = _installed
     if pol is not None and pol.sigagg_devices is not None:
         return max(0, pol.sigagg_devices)

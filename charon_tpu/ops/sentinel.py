@@ -36,6 +36,12 @@ module makes the invariant observable and enforced:
 
 Benches and dryruns call compiles_summary() after their run to emit the
 `compiles: {warmup: N, steady: 0}` JSON-tail key the budget tests assert on.
+
+The sentinel is PER-PROCESS by design: on a multi-host mesh every host
+process installs its own and the steady-state invariant must hold on each
+host independently (the multihost dryrun asserts steady == 0 in every
+worker's tail). Cross-host HostLink waits are network time, not compiles
+— they never arm or strike anything here.
 """
 
 from __future__ import annotations

@@ -1,4 +1,4 @@
-"""Multi-chip sharding of the PRODUCTION crypto plane.
+"""Multi-chip (and multi-HOST) sharding of the PRODUCTION crypto plane.
 
 The single-chip fused sigagg path (ops/plane_agg.threshold_aggregate_and_
 verify) data-parallelizes over a `jax.sharding.Mesh` axis "data": validators
@@ -19,6 +19,30 @@ scales over ICI: per-chip work is embarrassingly parallel, the single
 all_gather moves E·LIMBS·TW ints per chip, and every kernel is the
 identical pallas plane kernel the single-chip path uses.
 
+Multi-host operation (ops/mesh.py resolves the topology) threads a
+:class:`HostPlan` through the three stages. Validators chunk over the
+CLUSTER width W = hosts × per-host width; each host packs, dispatches and
+reads back ONLY its own contiguous chunk range (its addressable shards).
+Two modes:
+
+  * ``"global"`` (accelerators): the Mesh spans every host's devices, so
+    the EC-add butterfly and the verify all_gather above run over the
+    global mesh unchanged — the reduced sums come back replicated on
+    every host and only the emitted aggregate bytes (plus a validity
+    flag) cross the HostLink at finish.
+  * ``"bridged"`` (XLA:CPU, which cannot execute multiprocess
+    computations): each host reduces over its LOCAL mesh and the
+    per-host partial sums cross the HostLink as raw limb planes; the
+    cross-host EC combine is one extra lane-concatenated `_host_fold`,
+    and the cluster verify exchanges per-chunk Fq12 products that fold
+    IN-GRAPH through the single-final-exp finish
+    (pairing.fold_chunks_is_one) — identical verdicts on every host.
+
+A global device fence (HostLink barrier keyed by the slot's dispatch-
+assigned sequence number) separates execute from drain, so no host races
+ahead of a peer's in-flight device work and a dead peer surfaces as one
+classified barrier timeout that rides the guard ladder.
+
 Production entry: the module is split along the SAME three-stage seam as
 plane_agg — `sharded_dispatch` (host pack + async dispatch, the "pack"
 phase), `sharded_readback` (device fence + per-shard transfer, "execute"/
@@ -35,7 +59,9 @@ path (bit-identical aggregate bytes, identical RLC decision).
 
 from __future__ import annotations
 
+import dataclasses
 import functools
+import threading
 
 import numpy as np
 
@@ -57,6 +83,72 @@ _shard_hist = metrics.histogram(
     "per-shard readback transfer", ("phase",),
     buckets=(0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25,
              0.5, 1, 2.5, 5))
+
+
+@dataclasses.dataclass(frozen=True)
+class HostPlan:
+    """The multi-host coordinates of ONE slot, frozen at dispatch.
+
+    Threaded through the state tuple into readback/finish/verify so every
+    cross-host exchange of the slot — the device fence, the finish
+    payload, the verify fold — keys on the SAME dispatch-assigned
+    sequence number regardless of which pipeline worker thread runs the
+    stage (stage-3 workers race; exchange tags must not depend on call
+    order). hosts == 1 is the single-host passthrough: no link, no
+    exchanges, byte-for-byte the pre-multi-host behaviour."""
+
+    hosts: int
+    host_index: int
+    mode: str        # "local" | "bridged" | "global"
+    seq: int
+    link: object     # mesh.HostLink when hosts > 1
+
+
+_LOCAL_PLAN = HostPlan(1, 0, "local", 0, None)
+
+_seq_lock = threading.Lock()
+_seq_state: list = [None, 0]  # [link identity, next slot sequence]
+
+
+def _next_seq(link) -> int:
+    """Dispatch-order slot sequence, scoped to one HostLink (a rebuilt
+    link — new membership epoch — restarts at 0 on every host together).
+    Dispatch runs in SPMD submission order under the pipeline lock, so
+    the counters advance in lockstep across hosts."""
+    with _seq_lock:
+        if _seq_state[0] is not link:
+            _seq_state[0] = link
+            _seq_state[1] = 0
+        seq = _seq_state[1]
+        _seq_state[1] += 1
+        return seq
+
+
+def _host_plan(mesh) -> HostPlan:
+    """The HostPlan for a slot dispatched over `mesh` right now. A
+    narrowed guard-ladder rung on a multi-host cluster is a LOCAL mesh,
+    so it plans bridged mode even where the primary mesh is global —
+    per-host width narrows while the cluster combine stays on the
+    HostLink."""
+    from . import mesh as mesh_mod
+
+    if mesh_mod.host_count() <= 1:
+        return _LOCAL_PLAN
+    link = mesh_mod.host_link()
+    if link is None:
+        return _LOCAL_PLAN
+    mode = "global" if mesh_mod.is_global_mesh(mesh) else "bridged"
+    return HostPlan(mesh_mod.host_count(), mesh_mod.host_index(), mode,
+                    _next_seq(link), link)
+
+
+def _plan_width(mesh, plan) -> int:
+    """PER-HOST shard width under `plan` (the global mesh carries every
+    host's devices; bridged/local meshes are already host-local)."""
+    D = mesh.devices.size
+    if plan.mode == "global" and plan.hosts > 1:
+        return D // plan.hosts
+    return D
 
 
 def _chunk_plane_inputs(batches, Vp: int, T: int):
@@ -95,6 +187,10 @@ def _build_steps(mesh, G: int, T: int, Wv: int):
     all-reduce. Split three ways because XLA's compile time is superlinear
     in graph size and the pieces compile (and persistent-cache)
     independently; intermediates stay sharded on the devices between them.
+    On a multi-host GLOBAL mesh, D below is the cluster width and the
+    step-3 butterfly's neighbor exchanges span hosts over ICI/DCN; on a
+    bridged mesh D is the host-local width and step 3 produces per-host
+    partial sums the finish stage combines over the HostLink.
     """
     try:  # jax >= 0.6 promoted shard_map to the top level
         from jax import shard_map
@@ -191,14 +287,35 @@ def _build_steps(mesh, G: int, T: int, Wv: int):
     return step1, step2, step3
 
 
-def sharded_dispatch(batches, pks, msgs, mesh, rs=None):
+def _placer(mesh, plan):
+    """Placement function for dispatch operands: plain device_put with the
+    "data" NamedSharding on a host-local mesh; on a multi-host GLOBAL mesh
+    each host contributes only its D local rows and
+    `jax.make_array_from_process_local_data` assembles the W-row global
+    array without any cross-host data movement (the rows are already
+    where they belong — placement-correct by construction)."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    shard = NamedSharding(mesh, P("data"))
+    if plan.mode == "global" and plan.hosts > 1:
+        def place(a):
+            a = np.asarray(a)
+            return jax.make_array_from_process_local_data(
+                shard, a, (a.shape[0] * plan.hosts,) + a.shape[1:])
+        return place
+    return lambda a: jax.device_put(jnp.asarray(a), shard)
+
+
+def sharded_dispatch(batches, pks, msgs, mesh, rs=None, plan=None):
     """Stage 1 of a sharded slot: host pack + async dispatch over mesh
     axis "data"; returns the pending state plane_agg._fused_readback /
     _fused_host_finish (and with them SigAggPipeline) complete. Same
     contract and trust preconditions as plane_agg._fused_dispatch —
     everything here is host work + enqueue (the "pack" phase of
-    ops_device_dispatch_seconds); NOTHING syncs on the device, so the
-    pipeline lock may cover this whole body (LINT-TPU-007).
+    ops_device_dispatch_seconds); NOTHING syncs on the device or the
+    HostLink, so the pipeline lock may cover this whole body
+    (LINT-TPU-007). On a multi-host topology (`plan` defaults to the
+    resolved ops.mesh one) this host packs ONLY its own chunk range.
 
     Pubkey validation — infinity rejection + subgroup membership, which
     RLC soundness requires — runs through plane_agg.validate_pk_set:
@@ -209,15 +326,18 @@ def sharded_dispatch(batches, pks, msgs, mesh, rs=None):
     _g1_subgroup_jit for ~6 min on the driver host — MULTICHIP_r04.json
     rc=124). An invalid/∞/out-of-subgroup pubkey degrades to the
     "sharded_bad_pk" state — aggregates still computed, all_valid=False
-    at finish — bit-identical to the single-device bad_pk contract."""
+    at finish — bit-identical to the single-device bad_pk contract (and
+    identical on every host: the full set is validated everywhere)."""
     V = len(batches)
     if not (V == len(pks) == len(msgs)):
         raise ValueError("length mismatch")
     if V == 0:
         return ("sharded_empty",)
-    D = mesh.devices.size
+    if plan is None:
+        plan = _host_plan(mesh)
+    D = _plan_width(mesh, plan)
     with tracer.start_span("ops/sharded_dispatch", validators=V,
-                           shards=D) as span, \
+                           shards=D, hosts=plan.hosts) as span, \
             PA._dispatch_hist.observe_time("pack"):
         faults.check("sigagg.pack")
         try:
@@ -225,68 +345,81 @@ def sharded_dispatch(batches, pks, msgs, mesh, rs=None):
         except ValueError:
             span.attrs["outcome"] = "sharded_bad_pk"
             return ("sharded_bad_pk", [dict(b) for b in batches])
-        state = _sharded_dispatch_impl(batches, pks, msgs, mesh, rs, span)
+        state = _sharded_dispatch_impl(batches, pks, msgs, mesh, rs, span,
+                                       plan)
         span.attrs["outcome"] = state[0]
         PA._shard_width.set(float(D))
+        PA._host_shard_width.set(float(D), str(plan.host_index))
         return state
 
 
-def _sharded_dispatch_impl(batches, pks, msgs, mesh, rs, span):
-    from jax.sharding import NamedSharding, PartitionSpec as P
-
-    V, D = len(batches), mesh.devices.size
+def _sharded_dispatch_impl(batches, pks, msgs, mesh, rs, span, plan):
+    V = len(batches)
+    D = _plan_width(mesh, plan)    # per-host shard width
+    W = D * plan.hosts             # cluster-wide chunk count
+    h = plan.host_index
     T = max(len(b) for b in batches)
     if T == 0:
         raise ValueError("empty partial signature set")
-    Vd = -(-V // D)          # validators per device
+    Vd = -(-V // W)          # validators per device, cluster-wide
     Vp = PA._bucket_for_slots(Vd, T)   # padded per-device plane (T-slot
     #                                    combined width must be a bucket)
     Wv = Vp // PP.SUB
 
-    # ---- host-side parse, one chunk per device (timed per shard) ---------
+    # ---- host-side parse, one chunk per LOCAL device (timed per shard);
+    # global chunk c = h·D + d, so every host owns a contiguous validator
+    # range and host-ordered concatenation restores global order ---------
     stacks = []
     for d in range(D):
+        c = h * D + d
         with _shard_hist.observe_time("pack"):
             stacks.append(_chunk_plane_inputs(
-                batches[d * Vd:(d + 1) * Vd], Vp, T))
-        span.add_event("shard_pack", shard=d)
+                batches[c * Vd:(c + 1) * Vd], Vp, T))
+        span.add_event("shard_pack", shard=c)
     X0r, X1r, sgn, lmask, digits = (np.stack(a) for a in zip(*stacks))
 
     # the per-device pk parse stacks are a pure function of the (static)
-    # pubkey set and the shard geometry — built once per (digest, D, Vd,
-    # Vp) and held DEVICE-RESIDENT with NamedSharding placement in the
-    # PlaneStore, so steady-state slots skip both the whole-set byte parse
-    # and the host→device transfer of the pk planes
-    shard = NamedSharding(mesh, P("data"))
+    # pubkey set and the shard geometry — built once per (digest,
+    # geometry) and held DEVICE-RESIDENT with NamedSharding placement in
+    # the PlaneStore, so steady-state slots skip both the whole-set byte
+    # parse and the host→device transfer of the pk planes. The geometry
+    # key keeps the exact single-host shape when hosts == 1 (bit-stable
+    # cache reuse) and adds (hosts, host_index) otherwise.
+    place = _placer(mesh, plan)
 
     def _parse_pk_chunks():
         pk_chunks = [PA._parse_compressed(
-            [bytes(p) for p in pks[d * Vd:(d + 1) * Vd]]
+            [bytes(p) for p in pks[(h * D + d) * Vd:(h * D + d + 1) * Vd]]
             or [b"\xc0" + bytes(47)],
             48, "G1", False, Vp) for d in range(D)]
-        host = (np.stack([PA._raw_to_plane(c[0], Vp) for c in pk_chunks]),
-                np.stack([c[2] for c in pk_chunks]),
-                np.stack([c[3] for c in pk_chunks]))
-        return tuple(jax.device_put(jnp.asarray(a), shard) for a in host)
+        host = (np.stack([PA._raw_to_plane(pc[0], Vp) for pc in pk_chunks]),
+                np.stack([pc[2] for pc in pk_chunks]),
+                np.stack([pc[3] for pc in pk_chunks]))
+        return tuple(place(a) for a in host)
 
     from . import plane_store
 
+    geometry = ((D, Vd, Vp) if plan.hosts == 1
+                else (W, Vd, Vp, plan.hosts, plan.host_index))
     pkXr, pk_sgn, pk_lmask = plane_store.STORE.sharded_entry(
-        [bytes(p) for p in pks], (D, Vd, Vp), _parse_pk_chunks)
+        [bytes(p) for p in pks], geometry, _parse_pk_chunks)
 
-    # RLC randomizers: global per validator, chunked per device; padding
-    # lanes carry zero (infinity contributions)
+    # RLC randomizers: per validator, chunked per device; padding lanes
+    # carry zero (infinity contributions). Hosts need NO cross-host
+    # agreement on rs — validator i's rᵢ weights both its signature and
+    # its pubkey side, and both live on i's owner host.
     if rs is None:
         rs = PA.sample_randomizers(V)
     rdig = np.stack([
         PP.scalars_to_digitplanes(
-            rs[d * Vd:(d + 1) * Vd], Vp, nbits=PA.RLC_BITS)
+            rs[(h * D + d) * Vd:(h * D + d + 1) * Vd], Vp,
+            nbits=PA.RLC_BITS)
         for d in range(D)])
 
     # distinct-message groups (global, static per compile, padded to a
     # power of two with empty groups like plane_agg._group_masks so the
-    # sharded graph specializes on O(log) G values); per-device lane masks
-    # select the group's validators in the chunk
+    # sharded graph specializes on O(log) G values); the mask is built
+    # over the CLUSTER chunk axis then sliced to this host's rows
     groups: dict[bytes, list[int]] = {}
     for i, m in enumerate(msgs):
         groups.setdefault(bytes(m), []).append(i)
@@ -294,26 +427,27 @@ def _sharded_dispatch_impl(batches, pks, msgs, mesh, rs, span):
     while G < len(groups):
         G *= 2
     group_keys = list(groups.keys()) + [b""] * (G - len(groups))
-    gmask = np.zeros((D, G, PP.SUB, Vp // PP.SUB), bool)
+    gmask = np.zeros((W, G, PP.SUB, Vp // PP.SUB), bool)
     for g, idxs in enumerate(groups.values()):
         for i in idxs:
-            d, loc = i // Vd, i % Vd
-            gmask[d, g, loc // (Vp // PP.SUB), loc % (Vp // PP.SUB)] = True
+            c, loc = i // Vd, i % Vd
+            gmask[c, g, loc // (Vp // PP.SUB), loc % (Vp // PP.SUB)] = True
+    gmask = gmask[h * D:(h + 1) * D]
 
     step1, step2, step3 = _build_steps(mesh, G, T, Wv)
-    a1 = [jax.device_put(jnp.asarray(a), shard)
-          for a in (X0r, X1r, sgn, lmask, digits)]
+    a1 = [place(a) for a in (X0r, X1r, sgn, lmask, digits)]
     (ok, pok, xs, sign, inf,
      RXs, RYs, RZs, pXs, pYs, pZs) = step1(*a1, pkXr, pk_sgn, pk_lmask)
-    a2 = [jax.device_put(jnp.asarray(a), shard) for a in (rdig, gmask)]
+    a2 = [place(a) for a in (rdig, gmask)]
     SX, SY, SZ, PX, PY, PZ = step3(*step2(RXs, RYs, RZs, pXs, pYs, pZs, *a2))
     return ("sharded_pending", V, D, Vd, group_keys,
-            (ok, pok, xs, sign, inf), (SX, SY, SZ, PX, PY, PZ))
+            (ok, pok, xs, sign, inf), (SX, SY, SZ, PX, PY, PZ), plan)
 
 
-def _shards_by_index(arr, D):
-    """One addressable shard per mesh position along axis 0, ordered by
-    global index, or None when the layout is not the expected 1-D "data"
+def _shards_by_index(arr, D, offset: int = 0):
+    """One addressable shard per LOCAL mesh position along axis 0, ordered
+    by global index (minus `offset`, the first row this host owns on a
+    global mesh), or None when the layout is not the expected 1-D "data"
     sharding (callers fall back to a wholesale device_get)."""
     try:
         shards = list(arr.addressable_shards)
@@ -322,6 +456,8 @@ def _shards_by_index(arr, D):
         parts = [None] * D
         for s in shards:
             idx = s.index[0].start if s.index else None
+            if idx is not None:
+                idx -= offset
             if idx is None or not 0 <= idx < D or parts[idx] is not None:
                 return None
             parts[idx] = s
@@ -334,21 +470,29 @@ def sharded_readback(state, span=None):
     """Stage 2→3 boundary of a sharded slot: block on the mesh-wide work
     ("execute" phase) then transfer results shard by shard ("drain") so
     each device's readback is individually timed (ops_sigagg_shard_seconds
-    {phase="transfer"} + shard_transfer span events). "sharded_bad_pk"/
-    "sharded_empty" states pass through untouched."""
+    {phase="transfer"} + shard_transfer span events). On a multi-host
+    topology the local fence is followed by the GLOBAL device fence — a
+    HostLink barrier keyed by the slot's sequence number — so no host
+    drains before every host's device work is done, and a dead peer
+    surfaces here as one classified barrier timeout that rides the guard
+    ladder. Each host transfers ONLY its addressable shards.
+    "sharded_bad_pk"/"sharded_empty" states pass through untouched."""
     if state[0] in ("sharded_bad_pk", "sharded_empty"):
         if span is not None:
             span.attrs["outcome"] = state[0]
         return state
-    _tag, V, D, Vd, group_keys, shard_outs, red_outs = state
+    _tag, V, D, Vd, group_keys, shard_outs, red_outs, plan = state
     with PA._dispatch_hist.observe_time("execute"):
         jax.block_until_ready(shard_outs)
         jax.block_until_ready(red_outs)
+        if plan.hosts > 1 and plan.link is not None:
+            plan.link.barrier(f"slot/{plan.seq}/fence")
     if span is not None:
         span.add_event("device_fence")
     faults.check("sigagg.readback")
+    offset = plan.host_index * D if plan.mode == "global" else 0
     with PA._dispatch_hist.observe_time("drain"):
-        per = [_shards_by_index(a, D) for a in shard_outs]
+        per = [_shards_by_index(a, D, offset) for a in shard_outs]
         if all(p is not None for p in per):
             cols = [[None] * D for _ in shard_outs]
             for d in range(D):
@@ -356,13 +500,18 @@ def sharded_readback(state, span=None):
                     for i in range(len(shard_outs)):
                         cols[i][d] = np.asarray(per[i][d].data)
                 if span is not None:
-                    span.add_event("shard_transfer", shard=d)
+                    span.add_event("shard_transfer", shard=offset + d)
             host_shards = tuple(np.concatenate(c, axis=0) for c in cols)
+        elif plan.mode == "global" and plan.hosts > 1:
+            # a global array we cannot read shard-by-shard is a topology
+            # change mid-slot — let the guard ladder re-resolve
+            raise RuntimeError("unexpected shard layout on global mesh")
         else:
             host_shards = tuple(np.asarray(a)
                                 for a in jax.device_get(shard_outs))
         host_reds = tuple(np.asarray(a) for a in jax.device_get(red_outs))
-    return ("sharded_host", V, D, Vd, group_keys, host_shards, host_reds)
+    return ("sharded_host", V, D, Vd, group_keys, host_shards, host_reds,
+            plan)
 
 
 def sharded_host_finish(hstate, hash_fn=None):
@@ -372,41 +521,108 @@ def sharded_host_finish(hstate, hash_fn=None):
     return out, verify()
 
 
+def _cat_lanes(arrs):
+    """Stack per-host partial-sum planes on the fold lane axis: each host
+    ships (E, LIMBS, ...) limb planes; reshaping to (E, LIMBS, lanes) and
+    concatenating makes the cross-host EC combine ONE extra `_host_fold`
+    over hosts × lanes points — same group element as a global-mesh
+    reduction (fold order changes the Jacobian representative, never the
+    point, and the emitted aggregate bytes are per-validator anyway)."""
+    return np.concatenate(
+        [np.asarray(a).reshape(a.shape[0], a.shape[1], -1) for a in arrs],
+        axis=-1)
+
+
+def _exchange_finish(out_local, valid, host_reds, group_keys, plan):
+    """The finish-stage HostLink exchange: every host publishes its
+    validity flag + emitted aggregate bytes (and, in bridged mode, its
+    per-host RLC partial-sum planes) under the slot's sequence tag, and
+    reconstructs the CLUSTER result — host-ordered aggregate bytes, the
+    folded S = Σ rᵢ·sigᵢ and per-group P_m points. Raises the same
+    "invalid point" ValueError as the local path when ANY host saw an
+    invalid point, so all hosts take the same error path."""
+    from . import mesh as mesh_mod
+
+    payload = {"valid": np.asarray([1 if valid else 0], np.uint8),
+               "emit": np.frombuffer(b"".join(out_local), np.uint8)}
+    if plan.mode != "global":
+        SX, SY, SZ, PX, PY, PZ = host_reds
+        payload.update(
+            sx=np.asarray(SX), sy=np.asarray(SY), sz=np.asarray(SZ),
+            px=np.asarray(PX), py=np.asarray(PY), pz=np.asarray(PZ))
+    blobs = plan.link.exchange(f"slot/{plan.seq}/finish",
+                               mesh_mod.pack_arrays(**payload))
+    decoded = [mesh_mod.unpack_arrays(b) for b in blobs]
+    if not all(int(d["valid"][0]) for d in decoded):
+        raise ValueError("invalid point in sharded load")
+    out: list[bytes] = []
+    for d in decoded:
+        blob = d["emit"].tobytes()
+        out.extend(blob[i * 96:(i + 1) * 96]
+                   for i in range(len(blob) // 96))
+    if plan.mode == "global":
+        # the in-graph butterfly already spanned hosts — the reduced sums
+        # came back replicated; only the bytes needed exchanging
+        SX, SY, SZ, PX, PY, PZ = host_reds
+        S = PP._host_fold(SX, SY, SZ, 2)
+        pts = [(m, PA._unembed_g1(PP._host_fold(PX[g], PY[g], PZ[g], 2)))
+               for g, m in enumerate(group_keys)]
+        return out, S, pts
+    S = PP._host_fold(_cat_lanes([d["sx"] for d in decoded]),
+                      _cat_lanes([d["sy"] for d in decoded]),
+                      _cat_lanes([d["sz"] for d in decoded]), 2)
+    pts = [(m, PA._unembed_g1(PP._host_fold(
+        _cat_lanes([d["px"][g] for d in decoded]),
+        _cat_lanes([d["py"][g] for d in decoded]),
+        _cat_lanes([d["pz"][g] for d in decoded]), 2)))
+        for g, m in enumerate(group_keys)]
+    return out, S, pts
+
+
 def sharded_host_emit(hstate, hash_fn=None):
     """Stage 3, emit half — validity check, per-chunk byte emission and
     RLC host folds (the "finish" phase). Returns (aggregates,
     verify_thunk); the thunk runs the slot's pairing verification through
     PA._pairing_finish (the separately-timed "verify" phase, itself
-    sharded over the mesh via sharded_pairing_check when one is up). The
-    heavy parts release the GIL so the pipeline's stage-3 workers overlap
-    both halves with the next slot's pack and the in-flight execute.
-    bad_pk degrades exactly like the single-device path: aggregates
-    computed, all_valid=False."""
+    sharded over the mesh via sharded_pairing_check when one is up, with
+    the slot's HostPlan threaded through so a multi-host verify exchanges
+    under the SAME sequence tag). The heavy parts release the GIL so the
+    pipeline's stage-3 workers overlap both halves with the next slot's
+    pack and the in-flight execute. bad_pk degrades exactly like the
+    single-device path: aggregates computed, all_valid=False."""
     if hstate[0] == "sharded_empty":
         return [], lambda: True
     if hstate[0] == "sharded_bad_pk":
         layout = PA._layout_slots(hstate[1])
         RX, RY, RZ, V, Vp = PA._aggregate_plane(None, layout)
         return PA._serialize_aggregates(RX, RY, RZ, V), lambda: False
-    _tag, V, D, Vd, group_keys, host_shards, host_reds = hstate
+    _tag, V, D, Vd, group_keys, host_shards, host_reds, plan = hstate
     with PA._dispatch_hist.observe_time("finish"):
         ok, pok, xs, sign, inf = host_shards
-        if not (ok.all() and pok.all()):
-            raise ValueError("invalid point in sharded load")
+        valid = bool(ok.all() and pok.all())
         out: list[bytes] = []
-        for d in range(D):
-            n_local = min(Vd, max(0, V - d * Vd))
-            if n_local:
-                out.extend(PA._g2_emit_bytes(
-                    xs[d], sign[d].reshape(-1), inf[d].reshape(-1),
-                    n_local))
-        SX, SY, SZ, PX, PY, PZ = host_reds
-        S = PP._host_fold(SX, SY, SZ, 2)
-        pts = [(m, PA._unembed_g1(PP._host_fold(PX[g], PY[g], PZ[g], 2)))
-               for g, m in enumerate(group_keys)]
+        if valid:
+            for d in range(D):
+                c = plan.host_index * D + d
+                n_local = min(Vd, max(0, V - c * Vd))
+                if n_local:
+                    out.extend(PA._g2_emit_bytes(
+                        xs[d], sign[d].reshape(-1), inf[d].reshape(-1),
+                        n_local))
+        if plan.hosts > 1 and plan.link is not None:
+            out, S, pts = _exchange_finish(out, valid, host_reds,
+                                           group_keys, plan)
+        else:
+            if not valid:
+                raise ValueError("invalid point in sharded load")
+            SX, SY, SZ, PX, PY, PZ = host_reds
+            S = PP._host_fold(SX, SY, SZ, 2)
+            pts = [(m, PA._unembed_g1(PP._host_fold(PX[g], PY[g], PZ[g],
+                                                    2)))
+                   for g, m in enumerate(group_keys)]
     # _pairing_finish times itself as the "verify" phase — kept out of the
     # "finish" window so the two stay separately attributable
-    return out, lambda: PA._pairing_finish(S, pts, hash_fn)
+    return out, lambda: PA._pairing_finish(S, pts, hash_fn, plan=plan)
 
 
 def threshold_aggregate_and_verify_sharded(
@@ -432,7 +648,9 @@ def _build_verify_step(mesh, Bd: int):
     them into one local Fq12 partial; the partials are all_gather'd (tiny
     — 12 Fq elements per device) and folded in-graph, and the single
     final exponentiation runs on the replicated product. Same verdict as
-    pairing._compiled_pairing_check on one chip."""
+    pairing._compiled_pairing_check on one chip. On a multi-host GLOBAL
+    mesh the all_gather spans hosts, so the cross-host Fq12 fold stays
+    in-graph."""
     try:  # jax >= 0.6 promoted shard_map to the top level
         from jax import shard_map
     except ImportError:  # jax 0.4.x: experimental module, `check_rep` kwarg
@@ -477,7 +695,9 @@ def _build_miller_fold_step(mesh, Bd: int):
     loops + local fold, all_gather, in-graph cross-device fold — but NO
     final exponentiation. Returns the chunk's replicated Fq12 product so
     a >TILE-per-device pair set folds across chunks before the single
-    final exp (pairing.fold_chunks_is_one)."""
+    final exp (pairing.fold_chunks_is_one). Also the per-host kernel of
+    the bridged cluster verify (_sharded_check_multihost), where the
+    cross-HOST products fold through the same finish graph."""
     try:
         from jax import shard_map
     except ImportError:
@@ -516,7 +736,31 @@ def _build_miller_fold_step(mesh, Bd: int):
     ))
 
 
-def _sharded_check_chunked(p_x, p_y, q_x, q_y, mesh) -> bool:
+def _verify_placer(mesh, plan):
+    """Input placement for the verify kernels: plain jnp.asarray except on
+    a multi-host GLOBAL mesh, where each host contributes its own
+    contiguous pair rows and make_array_from_process_local_data assembles
+    the global operand (every host holds the full pair set, so slicing is
+    free and placement-correct)."""
+    if plan is None or plan.hosts <= 1 or plan.mode != "global":
+        return jnp.asarray
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    shard = NamedSharding(mesh, P("data"))
+    W = mesh.devices.size
+    D = W // plan.hosts
+    lo_dev = plan.host_index * D
+
+    def place(a):
+        a = np.asarray(a)
+        rows = a.shape[0] // W
+        lo = lo_dev * rows
+        return jax.make_array_from_process_local_data(
+            shard, a[lo:lo + D * rows], a.shape)
+    return place
+
+
+def _sharded_check_chunked(p_x, p_y, q_x, q_y, mesh, plan=None) -> bool:
     """Pair sets too wide for one sharded dispatch (per-device bucket
     would exceed MAX_PAIR_TILE): successive D·TILE-pair sharded chunk
     dispatches, folded cross-chunk through the single-final-exp finish
@@ -527,9 +771,50 @@ def _sharded_check_chunked(p_x, p_y, q_x, q_y, mesh) -> bool:
     D = mesh.devices.size
     span = D * pairing_mod.MAX_PAIR_TILE
     arrs = tuple(np.asarray(a) for a in (p_x, p_y, q_x, q_y))
+    place = _verify_placer(mesh, plan)
     parts = []
     for s in range(0, n, span):
         chunk = tuple(a[s:s + span] for a in arrs)
+        m = chunk[0].shape[0]
+        Bd = pairing_mod._bucket_pairs(-(-m // D))
+        total = D * Bd
+
+        def pad(a, total=total, m=m):
+            if total == m:
+                return a
+            return np.concatenate([a, np.repeat(a[:1], total - m, axis=0)])
+
+        mask = np.zeros(total, dtype=bool)
+        mask[:m] = True
+        parts.append(_build_miller_fold_step(mesh, Bd)(
+            *(place(pad(a)) for a in chunk), place(mask)))
+    return pairing_mod.fold_chunks_is_one(parts)
+
+
+def _sharded_check_multihost(p_x, p_y, q_x, q_y, mesh, plan) -> bool:
+    """Bridged-mode CLUSTER verify: the pair axis is chunked contiguously
+    across hosts; each host Miller-loops and locally folds ONLY its range
+    over its local mesh (re-chunked past MAX_PAIR_TILE exactly like
+    _sharded_check_chunked), the per-chunk Fq12 products cross the
+    HostLink under the slot's sequence tag, and EVERY host folds the
+    full host-ordered product set in-graph through the single-final-exp
+    finish (pairing.fold_chunks_is_one). The cross-host Fq12 fold stays
+    in-graph — only ~12 Fq elements per chunk ride the wire — and all
+    hosts agree on the verdict by construction (pairing
+    multiplicativity: Π over hosts of Π over local pairs)."""
+    from . import mesh as mesh_mod
+    from . import pairing as pairing_mod
+
+    n = p_x.shape[0]
+    per = -(-n // plan.hosts)
+    lo = min(n, plan.host_index * per)
+    hi = min(n, (plan.host_index + 1) * per)
+    arrs = tuple(np.asarray(a) for a in (p_x, p_y, q_x, q_y))
+    D = mesh.devices.size
+    span = D * pairing_mod.MAX_PAIR_TILE
+    parts = []
+    for s in range(lo, hi, span):
+        chunk = tuple(a[s:min(s + span, hi)] for a in arrs)
         m = chunk[0].shape[0]
         Bd = pairing_mod._bucket_pairs(-(-m // D))
         total = D * Bd
@@ -544,10 +829,26 @@ def _sharded_check_chunked(p_x, p_y, q_x, q_y, mesh) -> bool:
         mask[:m] = True
         parts.append(_build_miller_fold_step(mesh, Bd)(
             *(pad(a) for a in chunk), jnp.asarray(mask)))
-    return pairing_mod.fold_chunks_is_one(parts)
+    payload = {"n": np.asarray([len(parts)], np.int64)}
+    for i, f in enumerate(parts):
+        for j, c in enumerate((*f[0], *f[1])):
+            payload[f"p{i}c{j}"] = np.asarray(c)
+    blobs = plan.link.exchange(f"slot/{plan.seq}/verify",
+                               mesh_mod.pack_arrays(**payload))
+    all_parts = []
+    for hb, blob in enumerate(blobs):
+        if hb == plan.host_index:
+            all_parts.extend(parts)
+            continue
+        d = mesh_mod.unpack_arrays(blob)
+        for i in range(int(d["n"][0])):
+            cs = [jnp.asarray(d[f"p{i}c{j}"]) for j in range(6)]
+            all_parts.append(((cs[0], cs[1], cs[2]),
+                              (cs[3], cs[4], cs[5])))
+    return pairing_mod.fold_chunks_is_one(all_parts)
 
 
-def sharded_pairing_check(p_x, p_y, q_x, q_y, mesh) -> bool:
+def sharded_pairing_check(p_x, p_y, q_x, q_y, mesh, plan=None) -> bool:
     """Π e(Pᵢ, Qᵢ) == 1 with the pair axis sharded over mesh axis "data"
     — the mesh-wide analogue of pairing.pairing_check_planes (same plane
     layout, same masked lane-0 padding, same verdict). Pads the pair axis
@@ -555,27 +856,34 @@ def sharded_pairing_check(p_x, p_y, q_x, q_y, mesh) -> bool:
     typical slot (a handful of messages) each device Miller-loops two
     lanes and the collective moves one Fq12 per chip. When the per-device
     bucket would exceed MAX_PAIR_TILE the check runs chunked
-    (_sharded_check_chunked) with a bit-identical verdict."""
+    (_sharded_check_chunked) with a bit-identical verdict. A multi-host
+    `plan` routes bridged topologies through the cluster verify
+    (_sharded_check_multihost); on a global mesh the in-graph all_gather
+    already spans hosts and only input placement changes."""
     from . import pairing as pairing_mod
 
     n = p_x.shape[0]
     if n == 0:
         return True
+    if plan is not None and plan.hosts > 1 and plan.mode != "global" \
+            and plan.link is not None:
+        return _sharded_check_multihost(p_x, p_y, q_x, q_y, mesh, plan)
     D = mesh.devices.size
     Bd = pairing_mod._bucket_pairs(-(-n // D))
     if Bd > pairing_mod.MAX_PAIR_TILE:
-        return _sharded_check_chunked(p_x, p_y, q_x, q_y, mesh)
+        return _sharded_check_chunked(p_x, p_y, q_x, q_y, mesh, plan)
     total = D * Bd
 
     def pad(a):
         a = np.asarray(a)
         if total == n:
-            return jnp.asarray(a)
-        return jnp.asarray(
-            np.concatenate([a, np.repeat(a[:1], total - n, axis=0)]))
+            return a
+        return np.concatenate([a, np.repeat(a[:1], total - n, axis=0)])
 
     mask = np.zeros(total, dtype=bool)
     mask[:n] = True
+    place = _verify_placer(mesh, plan)
     kernel = _build_verify_step(mesh, Bd)
-    ok = kernel(pad(p_x), pad(p_y), pad(q_x), pad(q_y), jnp.asarray(mask))
+    ok = kernel(place(pad(p_x)), place(pad(p_y)), place(pad(q_x)),
+                place(pad(q_y)), place(mask))
     return bool(np.asarray(ok).reshape(-1)[0])

@@ -220,6 +220,92 @@ class ComposeCluster:
         return merged
 
 
+@dataclass
+class ComposeMeshCluster:
+    """Multi-process `jax.distributed` MESH harness: N coordinated worker
+    processes forming one crypto-plane cluster (2 × N-device CPU in CI;
+    TPU-ready by construction — the same env contract points the workers
+    at real hosts). Unlike ComposeCluster this does not run full nodes:
+    each process runs a caller-chosen argv (typically the multihost
+    dryrun worker in __graft_entry__.py) with the ops/mesh coordination
+    env — CHARON_TPU_COORDINATOR / _PROCESS_ID / _PROCESS_COUNT — plus a
+    forced XLA:CPU backend carrying `n_devices` host-platform devices, so
+    the cluster topology is hosts × n_devices. Process 0's address is the
+    jax.distributed coordinator; _free_ports picks it collision-free."""
+
+    dir: Path
+    n_hosts: int = 2
+    n_devices: int = 2            # per-host XLA:CPU device count
+    env_extra: dict = field(default_factory=dict)
+    procs: list = field(default_factory=list)
+    coordinator: str = ""
+
+    @classmethod
+    def prepare(cls, dir, n_hosts: int = 2, n_devices: int = 2,
+                **kw) -> "ComposeMeshCluster":
+        self = cls(Path(dir), n_hosts, n_devices, **kw)
+        self.dir.mkdir(parents=True, exist_ok=True)
+        self.coordinator = f"127.0.0.1:{_free_ports(1)[0]}"
+        return self
+
+    def host_env(self, host_index: int) -> dict:
+        """The environment one worker process runs under — the SAME
+        variables a production multi-host deployment sets per node."""
+        env = dict(os.environ)
+        env.update({k: str(v) for k, v in self.env_extra.items()})
+        env["JAX_PLATFORMS"] = "cpu"
+        flags = [f for f in env.get("XLA_FLAGS", "").split()
+                 if not f.startswith("--xla_force_host_platform_device_count")]
+        flags.append(
+            f"--xla_force_host_platform_device_count={self.n_devices}")
+        env["XLA_FLAGS"] = " ".join(flags)
+        env["CHARON_TPU_COORDINATOR"] = self.coordinator
+        env["CHARON_TPU_PROCESS_ID"] = str(host_index)
+        env["CHARON_TPU_PROCESS_COUNT"] = str(self.n_hosts)
+        return env
+
+    def start(self, argv_for_host) -> None:
+        """Spawn every worker; `argv_for_host(h)` returns process h's
+        argv. Output goes to per-host log FILES (pipes would fill and
+        block a worker mid-slot)."""
+        for h in range(self.n_hosts):
+            logf = open(self.dir / f"host{h}.log", "wb")
+            self.procs.append(subprocess.Popen(
+                argv_for_host(h), env=self.host_env(h),
+                cwd=str(Path(__file__).resolve().parents[2]),
+                stdout=logf, stderr=subprocess.STDOUT))
+            logf.close()
+        _log.info("compose mesh cluster started", hosts=self.n_hosts,
+                  devices=self.n_devices, coordinator=self.coordinator)
+
+    def wait(self, timeout: float = 1500.0) -> list[int]:
+        """Block until every worker exits (or the shared deadline passes —
+        stragglers are killed and report rc −9). Returns rc per host."""
+        deadline = time.monotonic() + timeout
+        rcs = []
+        for p in self.procs:
+            left = max(0.1, deadline - time.monotonic())
+            try:
+                rcs.append(p.wait(timeout=left))
+            except subprocess.TimeoutExpired:
+                p.kill()
+                p.wait()
+                rcs.append(-9)
+        return rcs
+
+    def stop(self) -> None:
+        for p in self.procs:
+            if p.poll() is None:
+                p.kill()
+                p.wait()
+
+    def host_log(self, h: int) -> str:
+        try:
+            return (self.dir / f"host{h}.log").read_text(errors="replace")
+        except OSError:
+            return ""
+
+
 class SimulatedCrash(RuntimeError):
     """Raised by a ComposeDKG chaos hook to take one node down at a named
     ceremony point. Deliberately a plain RuntimeError: the guard taxonomy

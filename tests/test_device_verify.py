@@ -112,7 +112,7 @@ def test_pairing_finish_device_rung_and_counter(clean_verify_state,
     pk, S = _signed(11, msg)
     seen = {}
 
-    def fake_check(S_in, live):
+    def fake_check(S_in, live, plan=None):
         seen["pairs"] = len(live) + 1
         return True
 
@@ -128,7 +128,8 @@ def test_pairing_finish_device_rung_and_counter(clean_verify_state,
 def test_pairing_finish_times_verify_phase(clean_verify_state, monkeypatch):
     from charon_tpu.utils import metrics
 
-    monkeypatch.setattr(PA, "_device_pairing_check", lambda S, live: True)
+    monkeypatch.setattr(PA, "_device_pairing_check",
+                        lambda S, live, plan=None: True)
     msg = b"verify-phase"
     pk, S = _signed(12, msg)
 
@@ -149,7 +150,7 @@ def test_pairing_finish_device_failure_degrades_native(clean_verify_state,
     msg = b"degrade-me"
     pk, S = _signed(13, msg)
 
-    def boom(S_in, live):
+    def boom(S_in, live, plan=None):
         raise RuntimeError("simulated XLA failure")
 
     monkeypatch.setattr(PA, "_device_pairing_check", boom)
@@ -165,7 +166,7 @@ def test_pairing_finish_input_error_propagates(clean_verify_state,
     msg = b"bad-input"
     pk, S = _signed(14, msg)
 
-    def bad(S_in, live):
+    def bad(S_in, live, plan=None):
         raise ValueError("malformed point")
 
     monkeypatch.setattr(PA, "_device_pairing_check", bad)
@@ -207,7 +208,8 @@ def test_pairing_finish_custom_hash_fn_stays_native(clean_verify_state,
 
 
 def test_pairing_finish_degenerate_semantics(clean_verify_state, monkeypatch):
-    monkeypatch.setattr(PA, "_device_pairing_check", lambda S, live: True)
+    monkeypatch.setattr(PA, "_device_pairing_check",
+                        lambda S, live, plan=None: True)
     inf_g1 = jac_infinity(FqOps)
     inf_g2 = jac_infinity(Fq2Ops)
     # all-infinity: valid iff every pk side vanished too (no dispatch)

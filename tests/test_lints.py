@@ -468,6 +468,45 @@ def test_mesh_rule_exempts_the_seam_itself(tmp_path):
         tmp_path, "core/mesh.py", src)) == ["LINT-TPU-008"]
 
 
+def test_mesh_rule_flags_process_topology_and_distributed_init(tmp_path):
+    findings = lint_source(tmp_path, "core/x.py", """\
+        import jax
+
+        def boot(addr):
+            jax.distributed.initialize(coordinator_address=addr)
+
+        def me():
+            return jax.process_index()
+
+        def hosts():
+            return jax.process_count()
+    """)
+    assert rules_of(findings) == ["LINT-TPU-008"] * 3
+    assert "jax.distributed.initialize()" in findings[0].message
+    assert "configure_distributed" in findings[0].message
+    assert "jax.process_index()" in findings[1].message
+    assert "host_count" in findings[1].message
+
+
+def test_mesh_rule_multihost_seam_and_nonjax_distributed(tmp_path):
+    # ops/mesh.py owns jax.distributed; elsewhere a non-jax `distributed`
+    # attribute or a distributed method on another object is fine
+    assert lint_source(tmp_path, "ops/mesh.py", """\
+        import jax
+
+        def _ensure(spec):
+            jax.distributed.initialize(coordinator_address=spec.coordinator)
+            return jax.process_index(), jax.process_count()
+    """) == []
+    assert lint_source(tmp_path, "core/x.py", """\
+        import jax
+
+        def other(cluster):
+            cluster.distributed.initialize()
+            return cluster.process_count()
+    """) == []
+
+
 def test_planestore_rule_sanctions_sharded_entry_callback(tmp_path):
     # the sharded PK-plane memoization path: a decode inside a callback
     # handed to plane_store.STORE.sharded_entry is sanctioned exactly like
@@ -1031,7 +1070,7 @@ def test_self_check_whole_tree_against_baseline():
         cwd=REPO_ROOT, capture_output=True, text=True, timeout=300)
     report = json.loads(proc.stdout)
     assert report["version"] == 2
-    assert report["rules_version"] == 13
+    assert report["rules_version"] == 14
     # the concurrency-discipline rules must actually have run: the report's
     # per-rule counters enumerate every registered rule id
     assert "counts_by_rule" in report

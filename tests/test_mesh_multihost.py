@@ -1,0 +1,314 @@
+"""Multi-host topology seam unit tests (ops/mesh.py, in-process, no
+jax.distributed service): coordinator-config parsing, the single-process
+passthrough contract (process_count <= 1 must take the exact pre-multi-
+host code path with ZERO jax.distributed calls), HostLink exchange/
+barrier semantics over a fake coordination client, the invalidate()
+membership-epoch bump (PR-20 satellite bugfix), and the
+`mesh_host_degraded` health rule's truth table.
+
+The real 2-process wire is covered by tests/test_multihost_dryrun.py
+(slow tier): this file is the fast tier-1 guard for the seam's contracts.
+"""
+
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from charon_tpu.ops import mesh as mesh_mod
+from charon_tpu.utils.errors import CharonError
+
+_KNOB_ENVS = (mesh_mod.COORDINATOR_ENV, mesh_mod.PROCESS_ID_ENV,
+              mesh_mod.PROCESS_COUNT_ENV, mesh_mod.DEVICES_ENV)
+
+
+@pytest.fixture
+def seam(monkeypatch):
+    # configure_distributed / set_override write os.environ DIRECTLY (they
+    # are the management seam), so monkeypatch alone can't restore — save
+    # and reinstate the knob envs by hand or they leak into the rest of
+    # the suite (a stray CHARON_TPU_PROCESS_COUNT would make every later
+    # pipeline test try to join a nonexistent cluster)
+    saved = {env: os.environ.get(env) for env in _KNOB_ENVS}
+    for env in _KNOB_ENVS:
+        monkeypatch.delenv(env, raising=False)
+    mesh_mod.reset_for_testing()
+    yield mesh_mod
+    for env, val in saved.items():
+        if val is None:
+            os.environ.pop(env, None)
+        else:
+            os.environ[env] = val
+    mesh_mod.reset_for_testing()
+
+
+# ---------------------------------------------------------------------------
+# distributed_spec parsing
+# ---------------------------------------------------------------------------
+
+
+def test_spec_none_when_count_unset_or_one(seam, monkeypatch):
+    # the gate: an unset/<=1 count returns None WITHOUT reading the
+    # coordinator knobs — garbage there must not matter
+    monkeypatch.setenv(mesh_mod.COORDINATOR_ENV, "definitely not host:port")
+    assert seam.distributed_spec() is None
+    monkeypatch.setenv(mesh_mod.PROCESS_COUNT_ENV, "1")
+    assert seam.distributed_spec() is None
+    monkeypatch.setenv(mesh_mod.PROCESS_COUNT_ENV, "0")
+    assert seam.distributed_spec() is None
+    monkeypatch.setenv(mesh_mod.PROCESS_COUNT_ENV, "  ")
+    assert seam.distributed_spec() is None
+
+
+@pytest.mark.parametrize("env_vals,needle", [
+    ({mesh_mod.PROCESS_COUNT_ENV: "two"}, "process count"),
+    ({mesh_mod.PROCESS_COUNT_ENV: "2"}, "host:port"),
+    ({mesh_mod.PROCESS_COUNT_ENV: "2",
+      mesh_mod.COORDINATOR_ENV: "localhost"}, "host:port"),
+    ({mesh_mod.PROCESS_COUNT_ENV: "2",
+      mesh_mod.COORDINATOR_ENV: ":1234"}, "host:port"),
+    ({mesh_mod.PROCESS_COUNT_ENV: "2",
+      mesh_mod.COORDINATOR_ENV: "localhost:http"}, "port is not an integer"),
+    ({mesh_mod.PROCESS_COUNT_ENV: "2",
+      mesh_mod.COORDINATOR_ENV: "localhost:70000"}, "port out of range"),
+    ({mesh_mod.PROCESS_COUNT_ENV: "2",
+      mesh_mod.COORDINATOR_ENV: "localhost:1234"}, "process id required"),
+    ({mesh_mod.PROCESS_COUNT_ENV: "2",
+      mesh_mod.COORDINATOR_ENV: "localhost:1234",
+      mesh_mod.PROCESS_ID_ENV: "zero"}, "process id"),
+    ({mesh_mod.PROCESS_COUNT_ENV: "2",
+      mesh_mod.COORDINATOR_ENV: "localhost:1234",
+      mesh_mod.PROCESS_ID_ENV: "2"}, "out of range"),
+    ({mesh_mod.PROCESS_COUNT_ENV: "2",
+      mesh_mod.COORDINATOR_ENV: "localhost:1234",
+      mesh_mod.PROCESS_ID_ENV: "-1"}, "out of range"),
+])
+def test_spec_parse_errors(seam, monkeypatch, env_vals, needle):
+    for k, v in env_vals.items():
+        monkeypatch.setenv(k, v)
+    with pytest.raises(CharonError) as exc:
+        seam.distributed_spec()
+    assert needle in str(exc.value)
+
+
+def test_spec_valid_parse(seam, monkeypatch):
+    monkeypatch.setenv(mesh_mod.PROCESS_COUNT_ENV, "3")
+    monkeypatch.setenv(mesh_mod.COORDINATOR_ENV, "10.0.0.1:7777")
+    monkeypatch.setenv(mesh_mod.PROCESS_ID_ENV, "2")
+    spec = seam.distributed_spec()
+    assert spec == mesh_mod.DistributedSpec("10.0.0.1:7777", 2, 3)
+
+
+def test_configure_distributed_roundtrip(seam, monkeypatch):
+    # count <= 1 is the explicit single-process opt-out: valid, spec None
+    assert seam.configure_distributed(process_count=1) is None
+    spec = seam.configure_distributed(
+        coordinator="127.0.0.1:1234", process_id=0, process_count=2)
+    assert spec == mesh_mod.DistributedSpec("127.0.0.1:1234", 0, 2)
+    # None fields stay unmanaged: a second call keeps the coordinator
+    assert seam.configure_distributed(process_id=1) == \
+        mesh_mod.DistributedSpec("127.0.0.1:1234", 1, 2)
+    with pytest.raises(CharonError):
+        seam.configure_distributed(coordinator="noport", process_id=0,
+                                   process_count=2)
+
+
+# ---------------------------------------------------------------------------
+# single-process passthrough: zero jax.distributed calls
+# ---------------------------------------------------------------------------
+
+
+def test_count_one_is_bit_identical_local_mesh(seam, monkeypatch):
+    import jax
+
+    monkeypatch.setenv(mesh_mod.DEVICES_ENV, "4")
+    seam.reset_for_testing()
+    base = seam.sigagg_mesh()
+    base_devices = list(base.devices.flat)
+    assert seam.device_count() == 4
+
+    def boom(*a, **k):  # pragma: no cover — the assert IS the test
+        raise AssertionError("jax.distributed touched on count<=1")
+
+    monkeypatch.setattr(jax.distributed, "initialize", boom)
+    monkeypatch.setenv(mesh_mod.PROCESS_COUNT_ENV, "1")
+    monkeypatch.setenv(mesh_mod.COORDINATOR_ENV, "garbage, never read")
+    seam.reset_for_testing()
+    m = seam.sigagg_mesh()
+    assert list(m.devices.flat) == base_devices
+    assert seam.device_count() == 4
+    assert seam.host_count() == 1 and seam.host_index() == 0
+    assert seam.host_mode() == "local" and seam.host_link() is None
+    assert seam.global_width() == 4
+    assert mesh_mod._mesh_hosts_g.value() == 1.0
+    assert mesh_mod._mesh_procs_g.value() == 0.0
+
+
+def test_fake_topology_and_gauges(seam):
+    seam.set_host_topology_for_testing(2, 1, "bridged")
+    assert seam.host_count() == 2
+    assert seam.host_index() == 1
+    assert seam.host_mode() == "bridged"
+    assert seam.host_link() is None
+    assert seam.global_width() == 2 * seam.device_count()
+    assert mesh_mod._mesh_hosts_g.value() == 2.0
+    assert mesh_mod._mesh_procs_g.value() == 2.0
+    # hosts <= 1 clears the override
+    seam.set_host_topology_for_testing(1, 0, "local")
+    assert seam.host_count() == 1 and seam.host_mode() == "local"
+
+
+def test_is_global_mesh_on_local_and_junk(seam):
+    seam.set_override(2)
+    try:
+        m = seam.sigagg_mesh()
+        assert m is not None and not seam.is_global_mesh(m)
+        assert not seam.is_global_mesh(None)
+        assert not seam.is_global_mesh(object())
+    finally:
+        seam.set_override(None)
+
+
+# ---------------------------------------------------------------------------
+# invalidate(): the membership-epoch bump (the PR-20 satellite bugfix —
+# it used to only reset the local device cache)
+# ---------------------------------------------------------------------------
+
+
+def test_invalidate_bumps_epoch_only_when_distributed(seam, monkeypatch):
+    assert mesh_mod._host_epoch == 0
+    seam.invalidate()  # single-host: cache drop only, no epoch churn
+    assert mesh_mod._host_epoch == 0
+    monkeypatch.setenv(mesh_mod.PROCESS_COUNT_ENV, "2")
+    seam.invalidate()
+    assert mesh_mod._host_epoch == 1
+    seam.invalidate()
+    assert mesh_mod._host_epoch == 2
+    seam.reset_for_testing()
+    assert mesh_mod._host_epoch == 0
+
+
+def test_invalidate_bumps_epoch_under_test_topology(seam):
+    seam.set_host_topology_for_testing(2, 0, "bridged")
+    seam.invalidate()
+    assert mesh_mod._host_epoch == 1
+
+
+# ---------------------------------------------------------------------------
+# HostLink over a fake coordination client
+# ---------------------------------------------------------------------------
+
+
+class _FakeCoord:
+    """In-process stand-in for the jax.distributed coordination service:
+    a shared KV store + counting barriers, same blocking semantics."""
+
+    def __init__(self, n_hosts: int):
+        self._n = n_hosts
+        self._kv: dict = {}
+        self._barriers: dict = {}
+        self._cv = threading.Condition()
+        self.set_keys: list = []
+
+    def key_value_set_bytes(self, key, val):
+        with self._cv:
+            self._kv[key] = bytes(val)
+            self.set_keys.append(key)
+            self._cv.notify_all()
+
+    def blocking_key_value_get_bytes(self, key, timeout_ms):
+        deadline = time.monotonic() + timeout_ms / 1000.0
+        with self._cv:
+            while key not in self._kv:
+                left = deadline - time.monotonic()
+                if left <= 0 or not self._cv.wait(left):
+                    raise TimeoutError(f"kv get timed out: {key}")
+            return self._kv[key]
+
+    def key_value_delete(self, key):
+        with self._cv:
+            self._kv.pop(key, None)
+
+    def wait_at_barrier(self, bid, timeout_ms):
+        deadline = time.monotonic() + timeout_ms / 1000.0
+        with self._cv:
+            self._barriers[bid] = self._barriers.get(bid, 0) + 1
+            self._cv.notify_all()
+            while self._barriers[bid] < self._n:
+                left = deadline - time.monotonic()
+                if left <= 0 or not self._cv.wait(left):
+                    raise TimeoutError(f"barrier timed out: {bid}")
+
+
+def test_hostlink_exchange_two_hosts():
+    coord = _FakeCoord(2)
+    links = [mesh_mod.HostLink(coord, 2, h, epoch=3) for h in range(2)]
+    results: dict = {}
+
+    def run(h):
+        results[h] = links[h].exchange("slot/7/finish", bytes([h]) * 4,
+                                       timeout_s=10)
+
+    ts = [threading.Thread(target=run, args=(h,)) for h in range(2)]
+    [t.start() for t in ts]
+    [t.join(timeout=30) for t in ts]
+    assert results[0] == results[1] == [b"\x00" * 4, b"\x01" * 4]
+    # keys deleted after the completion barrier; epoch scopes every key
+    assert coord._kv == {}
+    assert all(k.startswith("charon/3/x/slot/7/finish/")
+               for k in coord.set_keys)
+
+
+def test_hostlink_barrier_timeout_propagates():
+    link = mesh_mod.HostLink(_FakeCoord(2), 2, 0, epoch=0)
+    with pytest.raises(TimeoutError):
+        link.barrier("join", timeout_s=0.05)
+
+
+def test_pack_unpack_arrays_roundtrip():
+    arrays = {
+        "a": np.arange(12, dtype=np.uint32).reshape(3, 4),
+        "b": np.array([1.5, -2.25], dtype=np.float64),
+        "n": np.int64(7),
+        "flags": np.array([True, False]),
+    }
+    blob = mesh_mod.pack_arrays(**arrays)
+    out = mesh_mod.unpack_arrays(blob)
+    assert set(out) == set(arrays)
+    for k, v in arrays.items():
+        got = out[k]
+        assert got.dtype == np.asarray(v).dtype
+        assert np.array_equal(got, np.asarray(v))
+
+
+# ---------------------------------------------------------------------------
+# mesh_host_degraded health rule truth table
+# ---------------------------------------------------------------------------
+
+
+class _W:
+    def __init__(self, vals):
+        self._vals = vals
+
+    def gauge_sum(self, name):
+        return self._vals.get(name, 0.0)
+
+
+def test_mesh_host_degraded_rule():
+    from charon_tpu.app.health import default_checks
+
+    check = next(c for c in default_checks(3)
+                 if c.name == "mesh_host_degraded")
+    # never configured: healthy
+    assert not check.func(_W({"ops_mesh_hosts": 1.0}))
+    # full cluster up: healthy
+    assert not check.func(_W({"ops_mesh_hosts": 2.0,
+                              "ops_mesh_procs_configured": 2.0}))
+    # configured 2, running standalone: degraded
+    assert check.func(_W({"ops_mesh_hosts": 1.0,
+                          "ops_mesh_procs_configured": 2.0}))
+    # not yet resolved (hosts gauge 0): no verdict
+    assert not check.func(_W({"ops_mesh_hosts": 0.0,
+                              "ops_mesh_procs_configured": 2.0}))
